@@ -1,0 +1,37 @@
+"""AggregaThor-TPU: Byzantine-resilient distributed SGD, TPU-native.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the SysML'19
+AggregaThor framework (reference: LPD-EPFL/AggregaThor).  Instead of a
+TensorFlow-1 parameter-server cluster with a patched gRPC/MPI/UDP transport,
+training is a single-controller SPMD program over a `jax.sharding.Mesh`:
+
+- each of the ``n`` logical Byzantine-ML *workers* is a mesh slot (TPU core or
+  a shard group); per-worker gradients are computed in isolation under
+  ``shard_map`` (reference: graph.py:248-273);
+- the parameter server disappears: the robust Gradient Aggregation Rule (GAR)
+  runs jit-compiled on-device on an `(n, d)` view of the per-worker gradients
+  that is *dimension-sharded* — an ``all_to_all`` reshards from worker-sharded
+  to column-block-sharded, pairwise distances are reduced with a tiny ``psum``,
+  and coordinate-wise selection runs locally per block, so per-device memory
+  stays O(d) instead of O(n*d) (replaces tf_patches/ transports, see
+  SURVEY.md §2.6);
+- Byzantine behaviour is modeled explicitly by attack transforms applied to a
+  worker's own gradient slot before aggregation (implements the reference's
+  acknowledged TODO at runner.py:345), and the UDP lossy-transport semantics
+  (lost packets -> NaN coordinates, mpi_rendezvous_mgr.patch:833-841) map to a
+  deterministic NaN-masking "lossy link" simulator.
+
+Subpackages
+-----------
+- ``core``     flatten/unflatten machinery, schedules, optimizers, train state
+- ``gars``     the GAR registry and rules (numpy oracle / jnp / pallas tiers)
+- ``ops``      low-level kernels: Pallas TPU kernels + C++ host-native library
+- ``parallel`` mesh construction, worker isolation, distributed GAR engine,
+               attacks, lossy-link simulation
+- ``models``   experiment (model+dataset) plugins: mnist, cnnet, resnets, ...
+- ``obs``      logging-adjacent observability: eval TSV, checkpoints, metrics
+- ``cli``      the runner / deploy command-line entry points
+- ``utils``    context logging, class registry, key:value argument parsing
+"""
+
+__version__ = "0.1.0"

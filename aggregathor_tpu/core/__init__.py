@@ -1,0 +1,12 @@
+"""Training core: flatten machinery, schedules, optimizers, train state, step builder.
+
+Replaces the reference's graph-construction layer (reference: graph.py) with
+functional JAX equivalents: pytree ravel instead of per-variable concat
+(graph.py:144-199), optax instead of tf.train optimizers (graph.py:58-66),
+and a pure jitted step function instead of a replicated tf.Graph.
+"""
+
+from .flatten import FlatMap, flatten, inflate  # noqa: F401
+from .schedules import schedules, build_schedule  # noqa: F401
+from .optimizers import optimizers, build_optimizer  # noqa: F401
+from .train_state import TrainState  # noqa: F401

@@ -1,0 +1,64 @@
+"""Gradient flatten/inflate machinery.
+
+The GARs operate on 1-D gradient vectors: the reference concatenates every
+per-variable gradient into one flat tensor with a shared variable->offset
+"flatmap" so coordinates align across workers (reference: graph.py:144-199).
+In JAX the gradient is a pytree; ``jax.flatten_util.ravel_pytree`` gives the
+same coherent flattening for free (identical tree structure on every worker
+=> identical coordinate layout).  ``FlatMap`` additionally records per-leaf
+offsets/shapes, which powers per-layer GAR application (bounding the (n, d)
+matrices for LLM-scale models, see SURVEY.md §5) and diagnostics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FlatMap:
+    """Records the leaf layout of a flattened pytree (reference: graph.py:144-168).
+
+    Attributes:
+      treedef:  the pytree structure.
+      slices:   list of (path, offset, size, shape, dtype) per leaf, in
+                flattening order.
+      size:     total number of coordinates d.
+    """
+
+    def __init__(self, tree):
+        leaves_with_paths = jax.tree_util.tree_leaves_with_path(tree)
+        self.treedef = jax.tree_util.tree_structure(tree)
+        self.slices = []
+        offset = 0
+        for path, leaf in leaves_with_paths:
+            size = int(np.prod(np.shape(leaf))) if np.ndim(leaf) else 1
+            self.slices.append(
+                (jax.tree_util.keystr(path), offset, size, np.shape(leaf), np.result_type(leaf))
+            )
+            offset += size
+        self.size = offset
+
+    def inflate(self, flat):
+        """Slice a 1-D vector back into the recorded pytree shapes (reference: graph.py:182-199)."""
+        leaves = []
+        for _, offset, size, shape, dtype in self.slices:
+            leaves.append(jax.lax.dynamic_slice(flat, (offset,), (size,)).reshape(shape).astype(dtype))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def flatten(tree, dtype=jnp.float32):
+    """Flatten a pytree of arrays into one 1-D vector.
+
+    Returns (vector, flatmap); ``flatmap.inflate`` restores the structure.
+    The vector is cast to ``dtype`` (GARs aggregate in float32 regardless of
+    compute dtype, matching the reference's float/double kernels).
+    """
+    flatmap = FlatMap(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    vector = jnp.concatenate([jnp.ravel(leaf).astype(dtype) for leaf in leaves]) if leaves else jnp.zeros((0,), dtype)
+    return vector, flatmap
+
+
+def inflate(flat, flatmap):
+    """Module-level alias of ``FlatMap.inflate`` (reference: graph.py:182-199)."""
+    return flatmap.inflate(flat)

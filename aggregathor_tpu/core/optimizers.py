@@ -1,0 +1,52 @@
+"""Optimizer registry.
+
+Same five optimizers as the reference's registry (reference: graph.py:58-66):
+``sgd``, ``adam``, ``adadelta``, ``adagrad``, ``rmsprop``, with their tunables
+exposed as ``key:value`` args.  Each factory takes the learning-rate schedule
+(the aggregated gradient is applied once to one canonical parameter copy, so a
+single optax transform replaces the reference's PS-resident optimizer).
+"""
+
+import optax
+
+from ..utils import ClassRegister, parse_keyval
+
+optimizers = ClassRegister("optimizer")
+
+
+def _sgd(schedule, args):
+    kv = parse_keyval(args, {"momentum": 0.0, "nesterov": False})
+    momentum = kv["momentum"] if kv["momentum"] > 0.0 else None
+    return optax.sgd(schedule, momentum=momentum, nesterov=kv["nesterov"])
+
+
+def _adam(schedule, args):
+    kv = parse_keyval(args, {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+    return optax.adam(schedule, b1=kv["beta1"], b2=kv["beta2"], eps=kv["epsilon"])
+
+
+def _adadelta(schedule, args):
+    kv = parse_keyval(args, {"rho": 0.95, "epsilon": 1e-8})
+    return optax.adadelta(schedule, rho=kv["rho"], eps=kv["epsilon"])
+
+
+def _adagrad(schedule, args):
+    kv = parse_keyval(args, {"initial-accumulator": 0.1, "epsilon": 1e-7})
+    return optax.adagrad(schedule, initial_accumulator_value=kv["initial-accumulator"], eps=kv["epsilon"])
+
+
+def _rmsprop(schedule, args):
+    kv = parse_keyval(args, {"decay": 0.9, "momentum": 0.0, "epsilon": 1e-10})
+    return optax.rmsprop(schedule, decay=kv["decay"], momentum=kv["momentum"], eps=kv["epsilon"])
+
+
+optimizers.register("sgd", _sgd)
+optimizers.register("adam", _adam)
+optimizers.register("adadelta", _adadelta)
+optimizers.register("adagrad", _adagrad)
+optimizers.register("rmsprop", _rmsprop)
+
+
+def build_optimizer(name, schedule, args=None):
+    """Build an optax GradientTransformation from a registered name, schedule and key:value args."""
+    return optimizers.get(name)(schedule, args or [])

@@ -1,0 +1,63 @@
+"""Learning-rate schedule registry.
+
+Same three schedules as the reference's LR registry (reference:
+graph.py:51-57): ``fixed``, ``polynomial``, ``exponential``, built from typed
+``key:value`` args with the defaults of config.py.  Implemented as optax
+schedules (step -> rate), evaluated inside the jitted train step.
+"""
+
+import optax
+
+from .. import config
+from ..utils import ClassRegister, parse_keyval
+
+schedules = ClassRegister("learning-rate schedule")
+
+
+def _fixed(args):
+    kv = parse_keyval(args, {"initial-rate": config.default_learning_rate})
+    return optax.constant_schedule(kv["initial-rate"])
+
+
+def _polynomial(args):
+    kv = parse_keyval(
+        args,
+        {
+            "initial-rate": config.default_learning_rate,
+            "end-rate": config.default_end_learning_rate,
+            "decay-step": config.default_decay_step,
+            "power": 1.0,
+        },
+    )
+    return optax.polynomial_schedule(
+        init_value=kv["initial-rate"],
+        end_value=kv["end-rate"],
+        power=kv["power"],
+        transition_steps=kv["decay-step"],
+    )
+
+
+def _exponential(args):
+    kv = parse_keyval(
+        args,
+        {
+            "initial-rate": config.default_learning_rate,
+            "decay-step": config.default_decay_step,
+            "decay-rate": config.default_decay_rate,
+        },
+    )
+    return optax.exponential_decay(
+        init_value=kv["initial-rate"],
+        transition_steps=kv["decay-step"],
+        decay_rate=kv["decay-rate"],
+    )
+
+
+schedules.register("fixed", _fixed)
+schedules.register("polynomial", _polynomial)
+schedules.register("exponential", _exponential)
+
+
+def build_schedule(name, args=None):
+    """Build an optax schedule from its registered name and key:value args."""
+    return schedules.get(name)(args or [])

@@ -1,0 +1,32 @@
+"""Replicated train state.
+
+The reference keeps one canonical parameter copy on the parameter server
+(reference: graph.py:97-120).  The SPMD equivalent is a *replicated* pytree:
+every device holds identical params/optimizer state, and determinism of the
+aggregated gradient (all_gather + identical GAR computation) keeps the copies
+bit-identical — the PS semantics without a PS.
+"""
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax  # noqa: F401  (type provider for opt_state pytrees)
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Pure-pytree training state: parameters, optimizer state, step counter, PRNG key."""
+
+    step: jax.Array
+    params: object
+    opt_state: object
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params, tx, rng=None):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+        )

@@ -1,0 +1,90 @@
+"""Gradient Aggregation Rules (GARs) — the heart of the framework.
+
+A GAR reduces the ``(n, d)`` matrix of per-worker flattened gradients to one
+``(d,)`` aggregated gradient while tolerating up to ``f`` Byzantine rows
+(reference: aggregators/__init__.py:40-60).  The reference ships three
+implementation tiers per rule (numpy/py_func, pure-TF, C++ custom op); here
+the tiers are:
+
+- **jnp** (this package): jit-compiled XLA, the default on-device tier —
+  replaces both the pure-TF tier and the C++ CPU/GPU custom ops;
+- **oracle** (``gars/oracle.py``): plain numpy, reference-faithful semantics,
+  the cross-check used by the property tests (SURVEY.md §4);
+- **pallas** (``ops/``): hand-written TPU kernels for the O(n²·d) hot path;
+- **native** (``ops/native``): C++ host library via ctypes, parity with the
+  reference's ``aggregators/deprecated_native`` tier.
+
+TPU-first design note: every distance-based rule is factored into
+``selection_weights(dist2) -> W`` (tiny, O(n²) work, replicated) and a
+``W @ block`` combine (MXU matmul, works on *dimension-sharded* column blocks
+of the gradient matrix).  The distributed engine in ``parallel/`` exploits
+this: the (n, d) matrix never materializes on one device — blocks stay
+sharded, only the (n, n) distance matrix is psum-reduced.
+"""
+
+from ..utils import ClassRegister, import_directory
+
+gars = ClassRegister("GAR")
+
+
+def register(name, cls):
+    return gars.register(name, cls)
+
+
+def itemize():
+    return gars.itemize()
+
+
+def instantiate(name, nb_workers, nb_byz_workers, args=None):
+    """Build the GAR registered under ``name`` (reference: aggregators/__init__.py:66-70)."""
+    return gars.get(name)(nb_workers, nb_byz_workers, **(args or {}))
+
+
+class GAR:
+    """Base Gradient Aggregation Rule.
+
+    Subclasses implement ``aggregate_block``; ``aggregate`` is the dense
+    convenience entry that computes the distance matrix when needed.
+
+    Attributes:
+      coordinate_wise: True if the rule treats coordinates independently, so a
+        column block can be aggregated with no cross-block information.
+      needs_distances: True if ``aggregate_block`` requires the global (n, n)
+        pairwise squared-distance matrix (Krum/Bulyan family).
+    """
+
+    coordinate_wise = False
+    needs_distances = False
+
+    def __init__(self, nb_workers, nb_byz_workers, **args):
+        self.nb_workers = int(nb_workers)
+        self.nb_byz_workers = int(nb_byz_workers)
+        self.check()
+
+    def check(self):
+        """Validate the (n, f) relation; raise UserException when unsatisfiable."""
+        from ..utils import UserException
+
+        if self.nb_workers < 1:
+            raise UserException("GAR %r needs at least 1 worker" % type(self).__name__)
+        if self.nb_byz_workers < 0:
+            raise UserException("Negative declared Byzantine count")
+
+    def aggregate(self, grads):
+        """Dense tier: reduce the full (n, d) matrix to (d,)."""
+        from .common import pairwise_sq_distances
+
+        dist2 = pairwise_sq_distances(grads) if self.needs_distances else None
+        return self.aggregate_block(grads, dist2)
+
+    def aggregate_block(self, block, dist2=None):
+        """Blockwise tier: reduce an (n, d_block) column block to (d_block,).
+
+        ``dist2`` is the *global* (n, n) squared-distance matrix (already
+        reduced across blocks) when ``needs_distances`` is set.
+        """
+        raise NotImplementedError
+
+
+# Self-registering rule modules (reference: aggregators/__init__.py:76-85)
+import_directory(__name__, __path__, skip=("oracle",))

@@ -1,0 +1,29 @@
+"""Coordinate-wise mean ignoring non-finite coordinates.
+
+Exists to absorb the NaNs injected by a lossy transport on packet loss
+(reference: aggregators/average-nan.py:40-68 and the UDP NaN infill at
+tf_patches/patches/mpi_rendezvous_mgr.patch:833-841).
+
+Semantics per coordinate: mean of the finite values.  When *every* worker's
+coordinate is non-finite the reference's C++ computes 0/0 = NaN
+(deprecated_native/native.cpp:756-782); we deliberately output 0 instead — a
+NaN there would poison the parameters, and the case only arises when all n
+workers lose the same region.  The numpy oracle encodes the same choice.
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+
+
+class AverageNaNGAR(GAR):
+    coordinate_wise = True
+
+    def aggregate_block(self, block, dist2=None):
+        finite = jnp.isfinite(block)
+        total = jnp.sum(jnp.where(finite, block, 0.0), axis=0)
+        count = jnp.sum(finite, axis=0)
+        return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+
+
+register("average-nan", AverageNaNGAR)

@@ -1,0 +1,46 @@
+"""Averaged-median GAR: per coordinate, average the beta = n - f values
+closest to the (upper) median.
+
+Reference: aggregators/averaged-median.py:40-67 (beta = nbworkers - nbbyzwrks)
+backed by deprecated_native/native.cpp:714-747 (nth_element to the median,
+then nth_element by |x - median| and average of the first beta).
+
+Non-finite coordinates get +inf deviation so they are only selected when beta
+forces it (the reference's comparator leaves NaN ordering unspecified; the
+explicit mask makes this tier deterministic).
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+from .common import nonfinite_to_inf
+
+
+def averaged_median_columns(block, nb_rows, beta):
+    """Per-column averaged-median over the first axis: median, then mean of
+    the ``beta`` entries closest to it.  Shared with Bulyan's final phase."""
+    clean = nonfinite_to_inf(block)
+    median = jnp.sort(clean, axis=0)[nb_rows // 2]
+    deviation = jnp.abs(block - median[None, :])
+    deviation = jnp.where(jnp.isfinite(deviation), deviation, jnp.inf)
+    order = jnp.argsort(deviation, axis=0)[:beta]
+    closest = jnp.take_along_axis(block, order, axis=0)
+    return jnp.mean(closest, axis=0)
+
+
+class AveragedMedianGAR(GAR):
+    coordinate_wise = True
+
+    def __init__(self, nb_workers, nb_byz_workers, **args):
+        super().__init__(nb_workers, nb_byz_workers, **args)
+        self.beta = self.nb_workers - self.nb_byz_workers
+        if self.beta < 1:
+            from ..utils import UserException
+
+            raise UserException("averaged-median needs n - f >= 1 (got n=%d, f=%d)" % (nb_workers, nb_byz_workers))
+
+    def aggregate_block(self, block, dist2=None):
+        return averaged_median_columns(block, self.nb_workers, self.beta)
+
+
+register("averaged-median", AveragedMedianGAR)

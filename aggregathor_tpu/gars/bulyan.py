@@ -1,0 +1,81 @@
+"""Bulyan (of Multi-Krum) GAR.
+
+Reference: aggregators/bulyan.py:43-84 and native/op_bulyan/cpu.cpp:52-188.
+With m = n - f - 2, t = n - 2f - 2, b = t - 2f:
+
+1. Krum scoring pass with **distance pruning**: for each worker i only its
+   ``n - f - 2`` smallest distances contribute to score(i); the others are
+   zeroed so scores can be updated in O(n) when a worker is removed
+   (cpu.cpp:67-133).
+2. Selection loop, ``t`` rounds: round k emits the average of the
+   ``m - k`` smallest-scoring gradients (a Multi-Krum output), then removes
+   the single best-scoring gradient and decrements every score by its pruned
+   distance to the removed one (cpu.cpp:134-161).
+3. Averaged-median coordinate-wise over the t selections: median, then the
+   mean of the ``b`` values closest to it (cpu.cpp:163-187).
+
+TPU formulation: the reference's pruning trick is a *CPU* optimization
+(avoids re-sorting); here it is kept because it also makes every round's
+score update a vector subtraction.  All t selection rows are emitted as one
+(t, n) weight matrix, so the gradient-sized work is a single
+(t, n) x (n, d) MXU matmul plus the coordinate-wise phase — both of which
+apply unchanged to dimension-sharded column blocks.
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+from .averaged_median import averaged_median_columns
+from .common import nonfinite_to_inf, select_combine, selection_mean_weights
+
+
+class BulyanGAR(GAR):
+    needs_distances = True
+
+    def __init__(self, nb_workers, nb_byz_workers, **args):
+        super().__init__(nb_workers, nb_byz_workers, **args)
+        n, f = self.nb_workers, self.nb_byz_workers
+        self.nb_multikrum = n - f - 2       # m
+        self.nb_selections = n - 2 * f - 2  # t
+        self.nb_closest = self.nb_selections - 2 * f  # b
+        if self.nb_closest < 1:
+            from ..utils import UserException
+
+            raise UserException("bulyan needs n >= 4f + 3 (got n=%d, f=%d)" % (n, f))
+
+    def selection_weights(self, dist2):
+        """(t, n) weight matrix: row k averages the (m - k) smallest-scoring
+        workers after k removals, reproducing the reference's selection loop."""
+        n, f = self.nb_workers, self.nb_byz_workers
+        in_score = n - f - 2
+        clean = nonfinite_to_inf(dist2)
+        clean = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, clean)
+        # Row-wise distance pruning: keep each row's in_score smallest
+        # (ties to the lower column index), zero the rest (cpu.cpp:102-133).
+        idx = jnp.arange(n)
+        smaller = (clean[:, None, :] < clean[:, :, None]) | (
+            (clean[:, None, :] == clean[:, :, None]) & (idx[None, None, :] < idx[None, :, None])
+        )
+        ranks = jnp.sum(smaller, axis=-1)  # ranks[i, j] = rank of d(i,j) within row i
+        pruned = jnp.where(ranks < in_score, clean, 0.0)
+        scores = jnp.sum(pruned, axis=-1)
+        # Selection loop (t is small and static: unrolled at trace time).
+        rows = []
+        for k in range(self.nb_selections):
+            rows.append(selection_mean_weights(scores, self.nb_multikrum - k))
+            if k + 1 < self.nb_selections:
+                best = jnp.argmin(nonfinite_to_inf(scores))
+                scores = scores - pruned[:, best]
+                scores = scores.at[best].set(jnp.inf)
+        return jnp.stack(rows, axis=0)
+
+    def aggregate_block(self, block, dist2=None):
+        assert dist2 is not None, "bulyan requires the pairwise distance matrix"
+        selections = select_combine(self.selection_weights(dist2), block)
+        return averaged_median_columns(selections, self.nb_selections, self.nb_closest)
+
+
+register("bulyan", BulyanGAR)
+# Reference tier aliases (bulyan-py/co, aggregators/bulyan.py:92-97)
+register("bulyan-py", BulyanGAR)
+register("bulyan-co", BulyanGAR)

@@ -1,0 +1,112 @@
+"""Shared GAR numerics: distances, NaN conventions, rank selections.
+
+NaN conventions follow the reference: a non-finite pairwise distance counts as
++inf for scoring (reference: aggregators/krum.py:71-73), and non-finite
+coordinates sort *last* (as if +inf) in the coordinate-wise rules (reference:
+aggregators/deprecated_native/native.cpp:691-697).  XLA is instructed not to
+strip this handling by using explicit ``isfinite`` masking rather than NaN
+comparisons.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def nonfinite_to_inf(x):
+    """Replace every non-finite entry with +inf (NaN-last ordering convention)."""
+    return jnp.where(jnp.isfinite(x), x, jnp.inf)
+
+
+def centered_gram_sq_distances(g):
+    """Gram-form all-pairs squared distances of (n, d) rows, median-centered.
+
+    The Gram form ``|a|² + |b|² - 2·a·b`` is one MXU matmul but suffers
+    catastrophic cancellation when rows share a large common mode, so rows
+    are first centered by their coordinate-wise (NaN-ignoring) median —
+    distances are translation-invariant and the robust center keeps the
+    conditioning independent of Byzantine outliers.  Shared by the dense tier
+    below and the sharded engine's per-block partial distances.
+    """
+    center = jnp.nan_to_num(jnp.nanmedian(jnp.where(jnp.isfinite(g), g, jnp.nan), axis=0))
+    g = g - center[None, :]
+    sq_norms = jnp.sum(g * g, axis=-1)
+    gram = jax.lax.dot_general(g, g, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST)
+    return sq_norms[:, None] + sq_norms[None, :] - 2.0 * gram
+
+
+def pairwise_sq_distances(grads, direct_threshold=1 << 22):
+    """All-pairs squared L2 distances of the rows of an (n, d) matrix.
+
+    Two regimes:
+    - small n²·d (tests, tiny models): the direct broadcasted ``sum((a-b)²)``,
+      bitwise-faithful to the reference's CPU loop (op_krum/cpu.cpp:53-122);
+    - large d: the Gram form ``|a|² + |b|² - 2·a·b`` so the O(n²·d) work is a
+      single (n, d)x(d, n) matmul on the MXU.  The Gram form suffers
+      catastrophic cancellation when vectors share a large common mode, so
+      rows are first centered by their coordinate-wise (NaN-ignoring) median —
+      distances are translation-invariant and the robust center keeps the
+      conditioning independent of Byzantine outliers.
+
+    NaN rows propagate to NaN distances, which downstream scoring maps to
+    +inf, matching the reference's convention.  Accumulates in float32.
+    """
+    g = grads.astype(jnp.float32)
+    n, d = g.shape
+    if n * n * d <= direct_threshold:
+        diff = g[:, None, :] - g[None, :, :]
+        return jnp.sum(diff * diff, axis=-1)
+    dist2 = centered_gram_sq_distances(g)
+    return jnp.maximum(dist2, 0.0)  # clamp matmul-form negatives; NaN passes through
+
+
+def smallest_k_sum(values, k, axis=-1):
+    """Sum of the k smallest entries along ``axis`` (non-finite counts as +inf)."""
+    if axis != -1:
+        raise ValueError("smallest_k_sum supports axis=-1 only")
+    clean = nonfinite_to_inf(values)
+    return jnp.sum(jnp.sort(clean, axis=axis)[..., :k], axis=axis)
+
+
+def smallest_k_mask(scores, k):
+    """Boolean (n,) mask of the k smallest scores (ties broken by lowest index).
+
+    Non-finite scores count as +inf.  Implemented with a rank comparison so it
+    lowers to pure vector ops (no gather/scatter) — cheap on TPU.
+    """
+    clean = nonfinite_to_inf(scores)
+    n = clean.shape[0]
+    idx = jnp.arange(n)
+    # rank(i) = number of entries strictly smaller, plus earlier-index ties
+    smaller = (clean[None, :] < clean[:, None]) | ((clean[None, :] == clean[:, None]) & (idx[None, :] < idx[:, None]))
+    ranks = jnp.sum(smaller, axis=1)
+    return ranks < k
+
+
+def selection_mean_weights(scores, k):
+    """(n,) weights averaging the k smallest-scoring rows: mask / k."""
+    return smallest_k_mask(scores, k).astype(jnp.float32) / float(k)
+
+
+def select_combine(weights, block):
+    """Weighted row combination that ignores NaNs in *unselected* rows.
+
+    ``weights @ block`` alone would propagate NaN from rows with weight 0
+    (0 x NaN = NaN), which would let an excluded Byzantine/NaN worker poison
+    the output.  The reference's gather-then-mean never touches unselected
+    rows (krum.py:93); to reproduce that with matmuls: sanitize non-finite
+    entries to 0 for the combine, then re-poison exactly the coordinates
+    where a row with *nonzero* weight was non-finite.
+
+    Args:
+      weights: (n,) or (t, n) selection weights.
+      block:   (n, d_block) gradient rows.
+    Returns:
+      (d_block,) or (t, d_block) combined rows, NaN-faithful.
+    """
+    w = weights if weights.ndim == 2 else weights[None, :]
+    finite = jnp.isfinite(block)
+    safe = jnp.where(finite, block, 0.0)
+    out = w.astype(jnp.float32) @ safe.astype(jnp.float32)
+    touched = (jnp.abs(w) > 0).astype(jnp.float32) @ (~finite).astype(jnp.float32)
+    out = jnp.where(touched > 0, jnp.nan, out)
+    return out if weights.ndim == 2 else out[0]

@@ -1,0 +1,54 @@
+"""Multi-Krum GAR.
+
+Reference: aggregators/krum.py:45-158 and native/op_krum/cpu.cpp:53-122.
+Per worker i: score(i) = sum of its ``n - f - 2`` smallest pairwise squared
+distances (non-finite distance counts as +inf, krum.py:71-73); the output is
+the average of the ``m = n - f - 2`` smallest-scoring gradients (krum.py:93).
+
+TPU formulation: the (n, n) distance matrix comes from one Gram matmul
+(``common.pairwise_sq_distances``); scoring is an O(n²) sort; the final
+average is a (1, n) x (n, d) matmul of selection weights against the gradient
+matrix — so the whole rule is MXU work plus a tiny replicated sort, and
+``aggregate_block`` applies unchanged to dimension-sharded column blocks.
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+from .common import nonfinite_to_inf, select_combine, selection_mean_weights, smallest_k_sum
+
+
+def krum_scores(dist2, nb_workers, nb_byz_workers):
+    """(n,) Multi-Krum scores from the (n, n) squared-distance matrix."""
+    clean = nonfinite_to_inf(dist2)
+    clean = jnp.where(jnp.eye(nb_workers, dtype=bool), jnp.inf, clean)
+    return smallest_k_sum(clean, nb_workers - nb_byz_workers - 2, axis=-1)
+
+
+class KrumGAR(GAR):
+    needs_distances = True
+
+    def __init__(self, nb_workers, nb_byz_workers, **args):
+        super().__init__(nb_workers, nb_byz_workers, **args)
+        self.nb_selected = self.nb_workers - self.nb_byz_workers - 2
+        if self.nb_selected < 1:
+            from ..utils import UserException
+
+            raise UserException("krum needs n >= f + 3 (got n=%d, f=%d)" % (nb_workers, nb_byz_workers))
+
+    def selection_weights(self, dist2):
+        """(n,) averaging weights over the m smallest-scoring workers."""
+        scores = krum_scores(dist2, self.nb_workers, self.nb_byz_workers)
+        return selection_mean_weights(scores, self.nb_selected)
+
+    def aggregate_block(self, block, dist2=None):
+        assert dist2 is not None, "krum requires the pairwise distance matrix"
+        return select_combine(self.selection_weights(dist2), block)
+
+
+register("krum", KrumGAR)
+# Reference tier aliases (krum-py/tf/co, aggregators/krum.py:166-169): all map
+# to the jit tier — tier choice is an XLA backend concern here, not an API one.
+register("krum-py", KrumGAR)
+register("krum-tf", KrumGAR)
+register("krum-co", KrumGAR)

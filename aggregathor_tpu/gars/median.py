@@ -1,0 +1,23 @@
+"""Coordinate-wise median GAR.
+
+Reference: aggregators/median.py:40-68 backed by the C++ ``nth_element`` with
+non-finite values ordered last (deprecated_native/native.cpp:678-704): the
+median is the element at index ``n // 2`` of the ascending order with
+non-finite treated as +inf (i.e. the upper median for even n).
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+from .common import nonfinite_to_inf
+
+
+class MedianGAR(GAR):
+    coordinate_wise = True
+
+    def aggregate_block(self, block, dist2=None):
+        ordered = jnp.sort(nonfinite_to_inf(block), axis=0)
+        return ordered[self.nb_workers // 2]
+
+
+register("median", MedianGAR)

@@ -1,0 +1,63 @@
+"""Experiments: model + dataset plugins.
+
+An experiment bundles a model family with its input pipeline and evaluation
+metrics, mirroring the reference's ``_Experiment`` contract —
+``__init__(args)``, per-worker ``losses``, ``accuracy`` returning a dict of
+name -> value (reference: experiments/__init__.py:40-71) — re-expressed
+functionally for JAX:
+
+- ``init(rng)``                  -> parameter pytree (one canonical copy;
+                                    sharing across workers is automatic since
+                                    SPMD replicates params, the equivalent of
+                                    the reference's AUTO_REUSE variable scopes,
+                                    experiments/mnist.py:83-104)
+- ``loss(params, batch)``        -> scalar (per-worker; vmapped by the engine)
+- ``metrics(params, batch)``     -> dict name -> (sum, count) accumulators
+- ``make_train_iterator(...)``   -> infinite worker-major batch iterator
+- ``make_eval_iterator(...)``    -> finite epoch over the held-out split
+
+Experiments self-register by name at import time (reference:
+experiments/__init__.py:76-85).
+"""
+
+from ..utils import ClassRegister, import_directory
+
+experiments = ClassRegister("experiment")
+
+
+def register(name, cls):
+    return experiments.register(name, cls)
+
+
+def itemize():
+    return experiments.itemize()
+
+
+def instantiate(name, args=None):
+    """Build the experiment registered under ``name`` from key:value args."""
+    return experiments.get(name)(args or [])
+
+
+class Experiment:
+    """Base experiment (see module docstring for the contract)."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def init(self, rng):
+        raise NotImplementedError
+
+    def loss(self, params, batch):
+        raise NotImplementedError
+
+    def metrics(self, params, batch):
+        raise NotImplementedError
+
+    def make_train_iterator(self, nb_workers, seed=0):
+        raise NotImplementedError
+
+    def make_eval_iterator(self, nb_workers):
+        raise NotImplementedError
+
+
+import_directory(__name__, __path__, skip=("datasets",))

@@ -1,0 +1,158 @@
+"""Input pipelines: real data when present, deterministic synthetic otherwise.
+
+The reference pulls MNIST through keras' downloader
+(experiments/mnist.py:51-81) and CIFAR-10 from TF-Slim TFRecords on local
+disk (experiments/cnnet.py:115-146).  This environment has zero egress, so
+each loader first looks for a local ``.npz`` file (search order: the
+``AGGREGATHOR_DATA`` env dir, ``~/.aggregathor/data``, ``./data``) and
+otherwise *derives a deterministic synthetic stand-in*: class-conditional
+Gaussian images whose per-class means are fixed random templates.  The
+synthetic sets are honestly learnable (a linear model separates them), which
+is exactly what the convergence smoke tests need, and every consumer is told
+which flavour it got via ``.synthetic``.
+
+File formats accepted: ``mnist.npz`` with x_train/y_train/x_test/y_test (the
+keras layout), ``cifar10.npz`` with the same keys.
+
+All pipelines are numpy-side (host) and hand worker-major device batches to
+the engine; on TPU the transfer is one host->device copy per step, the
+equivalent of the reference's dataset-on-task-CPU placement (graph.py:248-252).
+"""
+
+import os
+
+import numpy as np
+
+from ..utils import info, warning
+
+
+def _data_dirs():
+    dirs = []
+    env = os.environ.get("AGGREGATHOR_DATA")
+    if env:
+        dirs.append(env)
+    dirs.append(os.path.expanduser("~/.aggregathor/data"))
+    dirs.append(os.path.join(os.getcwd(), "data"))
+    return dirs
+
+
+def _find_npz(basename):
+    for dirname in _data_dirs():
+        path = os.path.join(dirname, basename)
+        if os.path.isfile(path):
+            return path
+    return None
+
+
+class ArrayDataset:
+    """An in-memory labeled dataset split into train/test."""
+
+    def __init__(self, x_train, y_train, x_test, y_test, nb_classes, synthetic):
+        self.x_train = x_train
+        self.y_train = y_train
+        self.x_test = x_test
+        self.y_test = y_test
+        self.nb_classes = nb_classes
+        self.synthetic = synthetic
+
+
+def _synthetic_classification(name, shape, nb_classes, nb_train, nb_test, seed, separation=2.0):
+    """Class-conditional Gaussians around fixed random unit templates."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(size=(nb_classes,) + shape).astype(np.float32)
+    templates /= np.linalg.norm(templates.reshape(nb_classes, -1), axis=1).reshape((-1,) + (1,) * len(shape))
+
+    def make(count, split_seed):
+        r = np.random.default_rng(split_seed)
+        labels = r.integers(0, nb_classes, size=count)
+        noise = r.normal(size=(count,) + shape).astype(np.float32)
+        images = separation * templates[labels] + noise
+        return images.astype(np.float32), labels.astype(np.int32)
+
+    x_train, y_train = make(nb_train, seed + 1)
+    x_test, y_test = make(nb_test, seed + 2)
+    warning(
+        "Dataset %r not found on disk; using a deterministic synthetic stand-in "
+        "(drop an %s.npz under $AGGREGATHOR_DATA to use real data)" % (name, name)
+    )
+    return ArrayDataset(x_train, y_train, x_test, y_test, nb_classes, synthetic=True)
+
+
+def _load_npz(path, shape, scale):
+    data = np.load(path)
+    def prep(x):
+        x = x.astype(np.float32) / scale
+        return x.reshape((x.shape[0],) + shape)
+    info("Loaded dataset from %s" % path)
+    return ArrayDataset(
+        prep(data["x_train"]), data["y_train"].astype(np.int32).ravel(),
+        prep(data["x_test"]), data["y_test"].astype(np.int32).ravel(),
+        nb_classes=int(data["y_train"].max()) + 1, synthetic=False,
+    )
+
+
+def load_mnist():
+    """28x28x1 digits in [0, 1]; real file or synthetic stand-in."""
+    path = _find_npz("mnist.npz")
+    if path:
+        return _load_npz(path, (28, 28, 1), 255.0)
+    return _synthetic_classification("mnist", (28, 28, 1), 10, nb_train=8192, nb_test=2048, seed=7)
+
+
+def load_cifar10():
+    """32x32x3 images in [0, 1]; real file or synthetic stand-in."""
+    path = _find_npz("cifar10.npz")
+    if path:
+        return _load_npz(path, (32, 32, 3), 255.0)
+    return _synthetic_classification("cifar10", (32, 32, 3), 10, nb_train=8192, nb_test=2048, seed=11)
+
+
+def load_imagenet_standin(image_size=224, nb_classes=1000):
+    """Synthetic ImageNet-shaped data (the slims experiments' scale axis)."""
+    return _synthetic_classification(
+        "imagenet%d" % image_size, (image_size, image_size, 3), nb_classes,
+        nb_train=4096, nb_test=512, seed=13,
+    )
+
+
+class WorkerBatchIterator:
+    """Infinite iterator of worker-major batches [n_workers, batch, ...].
+
+    Each worker draws its own i.i.d. sample stream (the reference gives each
+    task its own dataset pipeline, graph.py:224-233); a per-worker seed keeps
+    streams independent and runs reproducible.
+    """
+
+    def __init__(self, x, y, nb_workers, batch_size, seed=0, transform=None):
+        self.x, self.y = x, y
+        self.nb_workers = nb_workers
+        self.batch_size = batch_size
+        # one stream per worker: worker w's sample sequence is a function of
+        # (seed, w) only, independent of nb_workers or other workers
+        self.rngs = [np.random.default_rng([seed, w]) for w in range(nb_workers)]
+        self.transform = transform
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        idx = np.stack([rng.integers(0, self.x.shape[0], size=self.batch_size) for rng in self.rngs])
+        flat = idx.reshape(-1)
+        bx = self.x[flat].reshape((self.nb_workers, self.batch_size) + self.x.shape[1:])
+        by = self.y[flat].reshape(self.nb_workers, self.batch_size)
+        if self.transform is not None:
+            bx, by = self.transform(bx, by)
+        return {"image": bx, "label": by}
+
+
+def eval_batches(x, y, nb_workers, batch_size):
+    """Finite worker-major pass over an eval split (pads by wrapping)."""
+    per_step = nb_workers * batch_size
+    total = x.shape[0]
+    for start in range(0, total, per_step):
+        idx = np.arange(start, start + per_step) % total
+        # mark wrapped duplicates so metric counts stay exact
+        valid = (np.arange(start, start + per_step) < total)
+        bx = x[idx].reshape((nb_workers, batch_size) + x.shape[1:])
+        by = y[idx].reshape(nb_workers, batch_size)
+        yield {"image": bx, "label": by, "valid": valid.reshape(nb_workers, batch_size)}

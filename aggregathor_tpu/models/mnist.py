@@ -1,0 +1,70 @@
+"""MNIST MLP experiment: 784-100-10 dense ReLU classifier.
+
+Parity with the reference's mnist experiment (experiments/mnist.py:83-148):
+same topology (one hidden layer of 100 ReLU units), sparse softmax
+cross-entropy per-worker loss, full-test-set top-1 accuracy, default batch 32.
+Expressed as a flax.linen module; variable sharing across workers is implicit
+(replicated params), replacing tf.get_variable + AUTO_REUSE.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..utils import parse_keyval
+from . import Experiment, register
+from .datasets import WorkerBatchIterator, eval_batches, load_mnist
+
+
+class MLP(nn.Module):
+    hidden: int = 100
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, name="hidden")(x))
+        return nn.Dense(self.classes, name="logits")(x)
+
+
+class MNISTExperiment(Experiment):
+    def __init__(self, args):
+        super().__init__(args)
+        kv = parse_keyval(args, {"batch-size": 32, "eval-batch-size": 256, "hidden": 100})
+        self.batch_size = kv["batch-size"]
+        self.eval_batch_size = kv["eval-batch-size"]
+        self.model = MLP(hidden=kv["hidden"])
+        self.dataset = load_mnist()
+
+    def init(self, rng):
+        sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+        return self.model.init(rng, sample)
+
+    def loss(self, params, batch):
+        logits = self.model.apply(params, batch["image"])
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]))
+
+    def metrics(self, params, batch):
+        logits = self.model.apply(params, batch["image"])
+        hit = (jnp.argmax(logits, axis=-1) == batch["label"]).astype(jnp.float32)
+        valid = batch.get("valid")
+        if valid is not None:
+            hit = hit * valid
+            count = jnp.sum(valid)
+            xent = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]) * valid
+        else:
+            count = jnp.float32(hit.shape[0])
+            xent = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
+        return {"accuracy": (jnp.sum(hit), count), "cross-entropy": (jnp.sum(xent), count)}
+
+    def make_train_iterator(self, nb_workers, seed=0):
+        return WorkerBatchIterator(
+            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed
+        )
+
+    def make_eval_iterator(self, nb_workers):
+        return eval_batches(self.dataset.x_test, self.dataset.y_test, nb_workers, self.eval_batch_size)
+
+
+register("mnist", MNISTExperiment)

@@ -1,0 +1,44 @@
+"""Data-poisoning MNIST experiment.
+
+Parity with the reference's ``mnistAttack`` (experiments/mnistAttack.py:51-92,
+138-140): the *training* stream is malformed — severity 1 multiplies inputs
+by -100; severity 2 multiplies by -1e12 and applies independent random
+permutations to inputs and labels (destroying their correspondence).  The
+reference hardwires severity 2 in ``losses``; here severity is a key:value
+arg defaulting to 2.  Evaluation data stays clean, so accuracy measures what
+the poisoned workers did to the model.
+"""
+
+import numpy as np
+
+from ..utils import parse_keyval
+from . import register
+from .datasets import WorkerBatchIterator
+from .mnist import MNISTExperiment
+
+
+class MNISTAttackExperiment(MNISTExperiment):
+    def __init__(self, args):
+        super().__init__(args)
+        self.severity = parse_keyval(args, {"severity": 2})["severity"]
+
+    def _poison(self, images, labels):
+        if self.severity <= 1:
+            return images * np.float32(-100.0), labels
+        flat_img = images.reshape(-1, *images.shape[2:])
+        flat_lab = labels.reshape(-1)
+        rng = np.random.default_rng(int(flat_lab.sum()) % (2**31))
+        img_perm = rng.permutation(flat_img.shape[0])
+        lab_perm = rng.permutation(flat_lab.shape[0])
+        poisoned = (flat_img[img_perm] * np.float32(-1e12)).reshape(images.shape)
+        shuffled = flat_lab[lab_perm].reshape(labels.shape)
+        return poisoned, shuffled
+
+    def make_train_iterator(self, nb_workers, seed=0):
+        return WorkerBatchIterator(
+            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size,
+            seed=seed, transform=self._poison,
+        )
+
+
+register("mnistAttack", MNISTAttackExperiment)

@@ -1,0 +1,158 @@
+"""Byzantine gradient attacks.
+
+The reference plumbs ``--attack/--attack-args/--nb-real-byz-workers`` through
+the CLI but leaves the gradient-attack hook an acknowledged TODO
+(runner.py:145-155, 345); its only in-repo adversary is the data-poisoning
+``mnistAttack`` experiment.  This module implements the hook for real.
+
+Threat model (SURVEY.md §7 hard part (e)): the first ``r`` global worker slots
+are Byzantine.  Two attack families:
+
+- **local** attacks read only the attacker's own gradient slot — honest
+  modeling of an isolated malicious worker (applied inside the worker's
+  shard_map scope, before any collective);
+- **omniscient** attacks model the classic strongest adversary that sees all
+  honest gradients and coordinates the coalition (Fall of Empires, A Little
+  Is Enough).  These are applied to the gathered column block, where
+  coordinate-wise honest statistics are available blockwise.
+
+Both families are deterministic functions of (gradient(s), worker index, PRNG
+key) so runs are reproducible.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import ClassRegister, parse_keyval
+
+attacks = ClassRegister("attack")
+
+
+def register(name, cls):
+    return attacks.register(name, cls)
+
+
+def itemize():
+    return attacks.itemize()
+
+
+def instantiate(name, nb_workers, nb_byz_workers, args=None):
+    return attacks.get(name)(nb_workers, nb_byz_workers, args or [])
+
+
+class Attack:
+    """Base attack. ``omniscient`` selects which hook the engine calls."""
+
+    omniscient = False
+
+    def __init__(self, nb_workers, nb_byz_workers, args):
+        self.nb_workers = int(nb_workers)
+        self.nb_byz_workers = int(nb_byz_workers)
+
+    def apply_local(self, grad, key):
+        """Transform one Byzantine worker's own (d,) gradient."""
+        raise NotImplementedError
+
+    def apply_matrix(self, matrix, byz_mask, key):
+        """Transform the (n, d_block) gathered block; rows where ``byz_mask``
+        is True belong to the coalition (omniscient attacks only)."""
+        raise NotImplementedError
+
+
+class SignFlipAttack(Attack):
+    """Submit -scale times the true gradient (classic reversed-gradient attacker)."""
+
+    def __init__(self, nb_workers, nb_byz_workers, args):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        self.scale = parse_keyval(args, {"scale": 1.0})["scale"]
+
+    def apply_local(self, grad, key):
+        return -self.scale * grad
+
+
+class ZeroAttack(Attack):
+    """Submit the zero vector (silent freeloader / stalling attacker)."""
+
+    def apply_local(self, grad, key):
+        return jnp.zeros_like(grad)
+
+
+class GaussianAttack(Attack):
+    """Submit pure Gaussian noise of tunable deviation."""
+
+    def __init__(self, nb_workers, nb_byz_workers, args):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        self.deviation = parse_keyval(args, {"deviation": 100.0})["deviation"]
+
+    def apply_local(self, grad, key):
+        return self.deviation * jax.random.normal(key, grad.shape, grad.dtype)
+
+
+class InfAttack(Attack):
+    """Submit non-finite values (what a crashed/lossy worker degenerates to;
+    pairs with the NaN-absorbing GARs, average-nan.py parity)."""
+
+    def apply_local(self, grad, key):
+        return jnp.full_like(grad, jnp.nan)
+
+
+class EmpireAttack(Attack):
+    """'Fall of Empires' (Xie et al. 2019): the coalition submits
+    -epsilon x mean(honest gradients), reversing the aggregate direction
+    while staying inside the honest cloud for small epsilon."""
+
+    omniscient = True
+
+    def __init__(self, nb_workers, nb_byz_workers, args):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        self.epsilon = parse_keyval(args, {"epsilon": 1.1})["epsilon"]
+
+    def apply_matrix(self, matrix, byz_mask, key):
+        honest = ~byz_mask
+        count = jnp.maximum(jnp.sum(honest), 1)
+        mean = jnp.sum(jnp.where(honest[:, None], matrix, 0.0), axis=0) / count
+        forged = -self.epsilon * mean
+        return jnp.where(byz_mask[:, None], forged[None, :], matrix)
+
+
+class LittleAttack(Attack):
+    """'A Little Is Enough' (Baruch et al. 2019): the coalition shifts the
+    honest mean by z standard deviations per coordinate — small enough to
+    evade distance-based detection, large enough to bias the aggregate.
+    ``z`` defaults to the paper's quantile formula from (n, f)."""
+
+    omniscient = True
+
+    def __init__(self, nb_workers, nb_byz_workers, args):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        kv = parse_keyval(args, {"z": 0.0, "negative": True})
+        if kv["z"] > 0.0:
+            self.z = kv["z"]
+        else:
+            n, f = self.nb_workers, self.nb_byz_workers
+            s = n // 2 + 1 - f  # supporters needed for majority
+            phi = max(min((n - f - s) / max(n - f, 1), 1.0 - 1e-6), 1e-6)
+            self.z = math.sqrt(2.0) * _erfinv(2.0 * phi - 1.0)
+        self.sign = -1.0 if kv["negative"] else 1.0
+
+    def apply_matrix(self, matrix, byz_mask, key):
+        honest = ~byz_mask
+        count = jnp.maximum(jnp.sum(honest), 1)
+        mean = jnp.sum(jnp.where(honest[:, None], matrix, 0.0), axis=0) / count
+        var = jnp.sum(jnp.where(honest[:, None], (matrix - mean[None, :]) ** 2, 0.0), axis=0) / count
+        forged = mean + self.sign * self.z * jnp.sqrt(var)
+        return jnp.where(byz_mask[:, None], forged[None, :], matrix)
+
+
+def _erfinv(x):
+    return float(jax.scipy.special.erfinv(jnp.float64(x) if jax.config.jax_enable_x64 else jnp.float32(x)))
+
+
+register("signflip", SignFlipAttack)
+register("zero", ZeroAttack)
+register("gaussian", GaussianAttack)
+register("inf", InfAttack)
+register("empire", EmpireAttack)
+register("little", LittleAttack)

@@ -1,0 +1,226 @@
+"""The robust SPMD training engine.
+
+One jitted step function replaces the reference's entire per-step distributed
+dance (worker gradient push over gRPC/MPI/UDP -> PS-side GAR -> variable
+update, SURVEY.md §3.1).  Dataflow per step, for ``n`` logical workers over a
+``W``-device ``worker`` mesh axis (k = n/W workers per device):
+
+1.  **Isolated worker gradients** — the batch arrives worker-sharded; each
+    device vmaps its k workers' forward/backward.  Gradients are flattened to
+    (k, d) with the coherent pytree layout (core/flatten.py).
+2.  **Local Byzantine attack / lossy link** — transforms that only read the
+    worker's own slot run here, before any collective (honest threat model).
+3.  **Reshard worker->dimension** — ``all_to_all`` turns the implicit (n, d)
+    gradient matrix into per-device column blocks (n, d/W).  This is the
+    engine's key memory move: no device ever holds n gradients, per-device
+    footprint stays O(d) (SURVEY.md §7 hard part (b)).
+4.  **Omniscient attacks** — coalition attacks needing honest statistics
+    (coordinate-wise mean/std) apply blockwise on the gathered rows.
+5.  **Distances** — Krum/Bulyan need the (n, n) squared-distance matrix: each
+    device computes its block's partial Gram contribution, one O(n²) ``psum``
+    completes it (vs the reference's O(n²·d) PS-side loop, op_krum/cpu.cpp).
+6.  **Blockwise GAR** — every rule reduces its column block locally
+    (selection weights are identical on all devices by construction).
+7.  **Gather + update** — ``all_gather`` restores the aggregated (d,) vector;
+    the optax update applies identically on every device, keeping parameters
+    replicated — the PS's "one canonical copy" without a PS (train_state.py).
+
+Wire cost: one all_to_all (d floats out/in per device) + one O(n²) psum + one
+all_gather (d floats) ≈ 2x a ring allreduce — the minimum for robust
+aggregation, since the GAR provably needs per-worker gradients, not their sum
+(SURVEY.md §2.6).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import optax
+
+from .. import config
+from ..core.flatten import FlatMap
+from ..core.train_state import TrainState
+from ..gars.common import centered_gram_sq_distances
+from ..utils import UserException
+from .mesh import worker_axis
+
+
+def _partial_pairwise_sq_distances(block):
+    """Per-block contribution to the (n, n) squared-distance matrix.
+
+    Direct difference form on the (n, d_block) block would cost O(n²·d_block)
+    memory, so the shared centered-Gram helper is used; psum across blocks
+    then yields the same convention as the dense tier (NaN anywhere -> NaN
+    entry; per-block median centering is a valid translation per block).
+    """
+    return centered_gram_sq_distances(block.astype(jnp.float32))
+
+
+class RobustEngine:
+    """Builds jitted robust train/eval steps over a (worker, model) mesh."""
+
+    def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None):
+        self.mesh = mesh
+        self.gar = gar
+        self.nb_workers = int(nb_workers)
+        self.nb_real_byz = int(nb_real_byz)
+        self.attack = attack
+        self.lossy_link = lossy_link
+        self.nb_devices = mesh.shape[worker_axis]
+        if self.nb_workers % self.nb_devices != 0:
+            raise UserException(
+                "nb_workers (%d) must be a multiple of the worker mesh axis (%d)"
+                % (self.nb_workers, self.nb_devices)
+            )
+        self.workers_per_device = self.nb_workers // self.nb_devices
+        if self.nb_real_byz > self.nb_workers:
+            raise UserException("More real Byzantine workers than workers")
+        if attack is not None and self.nb_real_byz == 0:
+            raise UserException("An attack needs --nb-real-byz-workers > 0 to have anyone to run it")
+
+    # ------------------------------------------------------------------ #
+
+    def _worker_gradients(self, params, batch_shard, loss_fn, key):
+        """vmap the local k workers' loss/grad; returns ((k,) losses, (k, d) grads, flatmap)."""
+
+        def one(worker_batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, worker_batch)
+            return loss, grads
+
+        losses, grads = jax.vmap(one)(batch_shard)
+        k = self.workers_per_device
+        leaves = jax.tree_util.tree_leaves(grads)
+        gvecs = jnp.concatenate([leaf.reshape(k, -1).astype(jnp.float32) for leaf in leaves], axis=1)
+        flatmap = FlatMap(jax.tree_util.tree_map(lambda g: g[0], grads))
+        return losses, gvecs, flatmap
+
+    def _perturb_local(self, gvecs, key):
+        """Apply local attack + lossy link to each local worker's own slot."""
+        k = self.workers_per_device
+        didx = jax.lax.axis_index(worker_axis)
+        out = []
+        for j in range(k):
+            gidx = didx * k + j
+            g = gvecs[j]
+            wkey = jax.random.fold_in(key, gidx)
+            if self.attack is not None and not self.attack.omniscient:
+                forged = self.attack.apply_local(g, jax.random.fold_in(wkey, 1))
+                g = jnp.where(gidx < self.nb_real_byz, forged, g)
+            if self.lossy_link is not None:
+                g = self.lossy_link.apply(g, jax.random.fold_in(wkey, 2), gidx)
+            out.append(g)
+        return jnp.stack(out, axis=0)
+
+    def _reshard_to_blocks(self, gvecs, d):
+        """(k, d) worker-sharded -> (n, d_block) dimension-sharded column block."""
+        W, k = self.nb_devices, self.workers_per_device
+        blk = -(-d // W)
+        padded = jnp.pad(gvecs, ((0, 0), (0, W * blk - d)))
+        pieces = padded.reshape(k, W, blk).transpose(1, 0, 2)  # (W, k, blk)
+        if W == 1:
+            gathered = pieces
+        else:
+            gathered = jax.lax.all_to_all(pieces, worker_axis, split_axis=0, concat_axis=0, tiled=True)
+            gathered = gathered.reshape(W, k, blk)
+        return gathered.reshape(self.nb_workers, blk)
+
+    def _aggregate_block(self, block, key):
+        """Omniscient attack, distances (psum), blockwise GAR -> (d_block,)."""
+        if self.attack is not None and self.attack.omniscient:
+            byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
+            block = self.attack.apply_matrix(block, byz_mask, key)
+        dist2 = None
+        if self.gar.needs_distances:
+            partial = _partial_pairwise_sq_distances(block)
+            dist2 = jax.lax.psum(partial, worker_axis) if self.nb_devices > 1 else partial
+            dist2 = jnp.maximum(dist2, 0.0)
+        return self.gar.aggregate_block(block, dist2)
+
+    # ------------------------------------------------------------------ #
+
+    def build_step(self, loss_fn, tx):
+        """Build the jitted robust training step.
+
+        Args:
+          loss_fn: (params, worker_batch) -> scalar loss.
+          tx: optax GradientTransformation.
+        Returns:
+          step(state, batch) -> (state, metrics) with ``batch`` pytrees of
+          leading dimension nb_workers (worker-major), sharded over the mesh.
+        """
+        W = self.nb_devices
+
+        def body(state, batch):
+            key = jax.random.fold_in(state.rng, state.step)
+            losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn, key)
+            gvecs = self._perturb_local(gvecs, key)
+            d = gvecs.shape[-1]
+            block = self._reshard_to_blocks(gvecs, d)
+            agg_block = self._aggregate_block(block, key)
+            if W > 1:
+                agg = jax.lax.all_gather(agg_block, worker_axis, axis=0).reshape(-1)[:d]
+            else:
+                agg = agg_block[:d]
+            agg_tree = flatmap.inflate(agg)
+            updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            total_loss = jax.lax.psum(jnp.sum(losses), worker_axis) if W > 1 else jnp.sum(losses)
+            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+            metrics = {
+                "total_loss": total_loss,
+                "grad_norm": jnp.linalg.norm(agg),
+            }
+            return new_state, metrics
+
+        sharded = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(worker_axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def build_eval(self, metric_fn):
+        """Build the jitted evaluation step.
+
+        Args:
+          metric_fn: (params, worker_batch) -> dict name -> (sum, count).
+        Returns:
+          eval_step(state, batch) -> dict name -> mean over the whole batch.
+        """
+        W = self.nb_devices
+
+        def body(state, batch):
+            sums = jax.vmap(lambda b: metric_fn(state.params, b))(batch)
+            folded = jax.tree_util.tree_map(lambda x: jnp.sum(x, axis=0), sums)
+            if W > 1:
+                folded = jax.lax.psum(folded, worker_axis)
+            return {name: total / jnp.maximum(count, 1) for name, (total, count) in folded.items()}
+
+        sharded = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(worker_axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    # ------------------------------------------------------------------ #
+
+    def shard_batch(self, batch):
+        """Device_put a worker-major batch pytree with the worker sharding."""
+        spec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
+        return jax.device_put(batch, spec)
+
+    def replicate(self, tree):
+        """Device_put a pytree fully replicated over the mesh."""
+        spec = jax.sharding.NamedSharding(self.mesh, P())
+        return jax.device_put(tree, spec)
+
+    def init_state(self, params, tx, seed=0):
+        """Create a replicated TrainState."""
+        state = TrainState.create(params, tx, rng=jax.random.PRNGKey(seed))
+        return self.replicate(state)

@@ -1,0 +1,70 @@
+"""Lossy-link simulator: UDP packet-loss semantics as NaN masking.
+
+The reference's patched transport ships gradients in <=65000-byte UDP
+datagrams and fills lost packets with NaN bytes on the parameter server
+(mpi_rendezvous_mgr.patch:585-627, NaN fill at 833-841), with env knobs
+``USE_UDP`` / ``UDP_WORKERS`` (only the first k workers are lossy, and only
+for tensors above ~1 MB; patch:507-513).  ICI is reliable, so on TPU this
+becomes an explicit simulation: per step, each lossy worker drops whole
+"packets" (contiguous coordinate runs sized like a UDP datagram) i.i.d. with
+the configured rate, and dropped runs become NaN — which the NaN-aware GARs
+(average-nan, median, the +inf-distance convention of Krum/Bulyan) absorb,
+exactly the reference's failure mode.
+
+The ``clever`` mode reproduces ``CLEVER=1`` (patch:833-835): a lost packet
+keeps the previous step's value instead of NaN.  It requires the caller to
+supply the previous gradient via ``previous=``; the engine does not carry
+that state yet, so requesting ``clever:true`` through the engine raises
+instead of silently degrading to NaN infill.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import parse_keyval
+
+# 65000-byte datagrams of float32 coordinates (patch:555-573)
+PACKET_COORDS = 65000 // 4
+# UDP engages only above ~1 MB tensors in the reference (patch:507-513)
+MIN_LOSSY_COORDS = (1 << 20) // 4
+
+
+class LossyLink:
+    """Deterministic packet-loss NaN masking for the first ``nb_lossy`` workers."""
+
+    def __init__(self, nb_lossy, args=None):
+        kv = parse_keyval(args or [], {
+            "drop-rate": 0.01,
+            "packet-coords": PACKET_COORDS,
+            "min-coords": MIN_LOSSY_COORDS,
+            "clever": False,
+        })
+        self.nb_lossy = int(nb_lossy)
+        self.drop_rate = float(kv["drop-rate"])
+        self.packet_coords = int(kv["packet-coords"])
+        self.min_coords = int(kv["min-coords"])
+        self.clever = bool(kv["clever"])
+
+    def apply(self, grad, key, worker_index, previous=None):
+        """Mask lost packets of one worker's (d,) gradient.
+
+        Applies only when ``worker_index < nb_lossy`` and the gradient is
+        large enough to have used the lossy transport.  ``previous`` supplies
+        the stale infill for clever mode.
+        """
+        d = grad.shape[0]
+        if self.nb_lossy <= 0 or d < self.min_coords:
+            return grad
+        nb_packets = -(-d // self.packet_coords)
+        drops = jax.random.bernoulli(key, self.drop_rate, (nb_packets,))
+        mask = jnp.repeat(drops, self.packet_coords, total_repeat_length=nb_packets * self.packet_coords)[:d]
+        if self.clever and previous is None:
+            from ..utils import UserException
+
+            raise UserException(
+                "LossyLink clever:true needs the previous gradient (engine support pending); "
+                "use clever:false for NaN infill"
+            )
+        infill = previous if self.clever else jnp.full_like(grad, jnp.nan)
+        lossy = jnp.where(mask, infill, grad)
+        return jnp.where(worker_index < self.nb_lossy, lossy, grad)

@@ -1,0 +1,45 @@
+"""Device mesh construction.
+
+The reference greedily allocates TF devices to worker/ps/eval roles across
+tasks (cluster.py:147-221).  On TPU the device topology is static and the
+allocation problem collapses to axis sizing: an ``n_workers``-wide ``worker``
+axis (data parallelism across Byzantine workers) optionally times a ``model``
+axis (tensor parallelism within each worker, for models that shard).
+
+``jax.make_mesh`` lays axes out so that the fastest-varying axis rides ICI
+neighbours; multi-host (DCN) meshes come from JAX's multi-process runtime
+(`jax.distributed.initialize`) with the same axis names — nothing in the
+engine changes between one chip and a multi-host pod.
+"""
+
+import jax
+
+from .. import config
+
+worker_axis = config.worker_axis
+model_axis = config.model_axis
+
+
+def make_mesh(nb_workers=None, model_parallelism=1, devices=None):
+    """Build a Mesh with axes ``(worker, model)``.
+
+    Args:
+      nb_workers: size of the worker axis; defaults to all devices divided by
+        ``model_parallelism``.
+      model_parallelism: size of the tensor-parallel axis inside each worker.
+      devices: explicit device list (defaults to ``jax.devices()``).
+    Returns:
+      ``jax.sharding.Mesh`` with named axes (worker, model).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if nb_workers is None:
+        nb_workers = len(devices) // model_parallelism
+    need = nb_workers * model_parallelism
+    if need > len(devices):
+        from ..utils import UserException
+
+        raise UserException(
+            "Mesh needs %d devices (%d workers x %d model) but only %d are available"
+            % (need, nb_workers, model_parallelism, len(devices))
+        )
+    return jax.make_mesh((nb_workers, model_parallelism), (worker_axis, model_axis), devices=devices[:need])

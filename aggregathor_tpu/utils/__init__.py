@@ -1,0 +1,23 @@
+"""Shared utilities: context logging, class registry, key:value parsing.
+
+TPU-native re-design of the reference's ``tools/`` layer (reference:
+tools/__init__.py, tools/misc.py).  Only behaviourally relevant pieces are
+kept: the nested-context colored logger, the universal plugin registry and the
+typed ``key:value`` CLI sub-argument parser.  TF-specific helpers
+(trace_graph, device_from_tuple) are replaced by JAX-idiomatic equivalents in
+``obs``/``parallel``.
+"""
+
+from .logging import (  # noqa: F401
+    Context,
+    UserException,
+    trace,
+    info,
+    success,
+    warning,
+    error,
+    fatal,
+)
+from .registry import ClassRegister  # noqa: F401
+from .keyval import parse_keyval  # noqa: F401
+from .plugins import import_directory  # noqa: F401

@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-worker semantics are exercised the way the reference exercises a
+single-process cluster (reference: README.md:141-146) — here, n workers =
+n XLA virtual CPU devices.  Must run before jax initializes a backend.
+"""
+
+import os
+import sys
+
+# Overwrite (not setdefault): the surrounding environment may pin a TPU
+# platform, and tests must run on the virtual 8-device CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Installed pytest plugins (e.g. jaxtyping) import jax BEFORE this conftest
+# runs, so the env var alone can come too late; the config update below works
+# as long as no backend has been initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xA66)

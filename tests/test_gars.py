@@ -1,0 +1,132 @@
+"""GAR property and cross-tier equivalence tests (the pyramid of SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars
+from aggregathor_tpu.gars import oracle
+
+RULES = ["average", "average-nan", "median", "averaged-median", "krum", "bulyan"]
+ORACLES = {
+    "average": oracle.average,
+    "average-nan": oracle.average_nan,
+    "median": oracle.median,
+    "averaged-median": oracle.averaged_median,
+    "krum": oracle.krum,
+    "bulyan": oracle.bulyan,
+}
+
+
+def make_grads(rng, n=11, d=37, scale=1.0):
+    return rng.normal(size=(n, d)).astype(np.float32) * scale
+
+
+def params_for(rule):
+    # bulyan needs n >= 4f + 3; krum n >= f + 3
+    return {"bulyan": (11, 2), "krum": (11, 3)}.get(rule, (11, 3))
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_matches_numpy_oracle(rule, rng):
+    n, f = params_for(rule)
+    grads = make_grads(rng, n=n)
+    gar = gars.instantiate(rule, n, f)
+    got = np.asarray(gar.aggregate(grads))
+    want = ORACLES[rule](grads, f)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_permutation_equivariance(rule, rng):
+    """Shuffling workers must not change the aggregate (worker identity is meaningless)."""
+    n, f = params_for(rule)
+    grads = make_grads(rng, n=n)
+    gar = gars.instantiate(rule, n, f)
+    base = np.asarray(gar.aggregate(grads))
+    perm = rng.permutation(n)
+    shuffled = np.asarray(gar.aggregate(grads[perm]))
+    np.testing.assert_allclose(shuffled, base, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("rule", ["median", "averaged-median", "krum", "bulyan"])
+def test_byzantine_robustness(rule, rng):
+    """With f adversarial rows pushing a huge vector, the aggregate must stay
+    within the honest cloud (Byzantine-bound sanity; SURVEY.md §4)."""
+    n, f = params_for(rule)
+    grads = make_grads(rng, n=n)
+    attacked = grads.copy()
+    attacked[:f] = 1e6  # f colluding outliers
+    gar = gars.instantiate(rule, n, f)
+    out = np.asarray(gar.aggregate(attacked))
+    honest_max = np.abs(grads[f:]).max() * n
+    assert np.all(np.abs(out) <= honest_max), "%s leaked the Byzantine direction" % rule
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_average_consensus(rule, rng):
+    """When every worker submits the same gradient, every rule returns it."""
+    n, f = params_for(rule)
+    g = rng.normal(size=(37,)).astype(np.float32)
+    grads = np.tile(g, (n, 1))
+    gar = gars.instantiate(rule, n, f)
+    np.testing.assert_allclose(np.asarray(gar.aggregate(grads)), g, rtol=1e-5, atol=1e-6)
+
+
+def test_average_nan_ignores_nans(rng):
+    grads = make_grads(rng, n=8)
+    grads[0, :10] = np.nan
+    grads[3, 5:15] = np.inf
+    gar = gars.instantiate("average-nan", 8, 0)
+    got = np.asarray(gar.aggregate(grads))
+    want = oracle.average_nan(grads)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert np.all(np.isfinite(got))
+
+
+def test_median_nan_last(rng):
+    grads = make_grads(rng, n=7)
+    grads[2, :] = np.nan
+    gar = gars.instantiate("median", 7, 1)
+    got = np.asarray(gar.aggregate(grads))
+    want = oracle.median(grads)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("rule", ["krum", "bulyan"])
+def test_nan_worker_never_selected(rule, rng):
+    """A worker submitting NaNs has +inf distances, hence worst score, and must
+    not contaminate the output (krum.py:71-73 convention)."""
+    n, f = params_for(rule)
+    grads = make_grads(rng, n=n)
+    grads[1, :] = np.nan
+    gar = gars.instantiate(rule, n, f)
+    out = np.asarray(gar.aggregate(grads))
+    assert np.all(np.isfinite(out))
+    want = ORACLES[rule](grads, f)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_krum_selects_smallest_scores(rng):
+    n, f = 9, 2
+    grads = make_grads(rng, n=n)
+    scores = oracle.krum_scores(grads, f)
+    m = n - f - 2
+    selected = np.argsort(scores)[:m]
+    want = np.mean(grads[selected], axis=0)
+    gar = gars.instantiate("krum", n, f)
+    np.testing.assert_allclose(np.asarray(gar.aggregate(grads)), want, rtol=1e-4, atol=1e-5)
+
+
+def test_invalid_nf_relations():
+    from aggregathor_tpu.utils import UserException
+
+    with pytest.raises(UserException):
+        gars.instantiate("krum", 4, 2)  # needs n >= f + 3
+    with pytest.raises(UserException):
+        gars.instantiate("bulyan", 8, 2)  # needs n >= 4f + 3
+
+
+def test_registry_lists_all_rules():
+    names = gars.itemize()
+    for rule in RULES:
+        assert rule in names
