@@ -36,8 +36,12 @@ def itemize():
 
 
 def instantiate(name, nb_workers, nb_byz_workers, args=None):
-    """Build the GAR registered under ``name`` (reference: aggregators/__init__.py:66-70)."""
-    return gars.get(name)(nb_workers, nb_byz_workers, **(args or {}))
+    """Build the GAR registered under ``name`` (reference: aggregators/__init__.py:66-70).
+
+    ``args`` is a list of ``key:value`` strings, the same sub-argument
+    convention every other registry uses (attacks, optimizers, experiments).
+    """
+    return gars.get(name)(nb_workers, nb_byz_workers, args or [])
 
 
 class GAR:
@@ -56,9 +60,10 @@ class GAR:
     coordinate_wise = False
     needs_distances = False
 
-    def __init__(self, nb_workers, nb_byz_workers, **args):
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
         self.nb_workers = int(nb_workers)
         self.nb_byz_workers = int(nb_byz_workers)
+        self.args = list(args or [])
         self.check()
 
     def check(self):

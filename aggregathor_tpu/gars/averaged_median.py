@@ -21,8 +21,7 @@ def averaged_median_columns(block, nb_rows, beta):
     the ``beta`` entries closest to it.  Shared with Bulyan's final phase."""
     clean = nonfinite_to_inf(block)
     median = jnp.sort(clean, axis=0)[nb_rows // 2]
-    deviation = jnp.abs(block - median[None, :])
-    deviation = jnp.where(jnp.isfinite(deviation), deviation, jnp.inf)
+    deviation = nonfinite_to_inf(jnp.abs(block - median[None, :]))
     order = jnp.argsort(deviation, axis=0)[:beta]
     closest = jnp.take_along_axis(block, order, axis=0)
     return jnp.mean(closest, axis=0)
@@ -31,8 +30,8 @@ def averaged_median_columns(block, nb_rows, beta):
 class AveragedMedianGAR(GAR):
     coordinate_wise = True
 
-    def __init__(self, nb_workers, nb_byz_workers, **args):
-        super().__init__(nb_workers, nb_byz_workers, **args)
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
         self.beta = self.nb_workers - self.nb_byz_workers
         if self.beta < 1:
             from ..utils import UserException

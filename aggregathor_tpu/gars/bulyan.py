@@ -32,8 +32,8 @@ from .common import nonfinite_to_inf, select_combine, selection_mean_weights
 class BulyanGAR(GAR):
     needs_distances = True
 
-    def __init__(self, nb_workers, nb_byz_workers, **args):
-        super().__init__(nb_workers, nb_byz_workers, **args)
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
         n, f = self.nb_workers, self.nb_byz_workers
         self.nb_multikrum = n - f - 2       # m
         self.nb_selections = n - 2 * f - 2  # t

@@ -28,8 +28,8 @@ def krum_scores(dist2, nb_workers, nb_byz_workers):
 class KrumGAR(GAR):
     needs_distances = True
 
-    def __init__(self, nb_workers, nb_byz_workers, **args):
-        super().__init__(nb_workers, nb_byz_workers, **args)
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
         self.nb_selected = self.nb_workers - self.nb_byz_workers - 2
         if self.nb_selected < 1:
             from ..utils import UserException

@@ -81,7 +81,7 @@ class RobustEngine:
 
     # ------------------------------------------------------------------ #
 
-    def _worker_gradients(self, params, batch_shard, loss_fn, key):
+    def _worker_gradients(self, params, batch_shard, loss_fn):
         """vmap the local k workers' loss/grad; returns ((k,) losses, (k, d) grads, flatmap)."""
 
         def one(worker_batch):
@@ -153,7 +153,7 @@ class RobustEngine:
 
         def body(state, batch):
             key = jax.random.fold_in(state.rng, state.step)
-            losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn, key)
+            losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn)
             gvecs = self._perturb_local(gvecs, key)
             d = gvecs.shape[-1]
             block = self._reshard_to_blocks(gvecs, d)

@@ -41,10 +41,14 @@ def parse_keyval(pairs, defaults=None):
       dict of key -> typed value.
     """
     result = dict(defaults) if defaults else {}
+    seen = set()
     for pair in pairs or []:
         if ":" not in pair:
             raise log.UserException("Expected 'key:value' argument, got %r" % (pair,))
         key, value = pair.split(":", 1)
+        if key in seen:
+            raise log.UserException("Key %r had already been specified" % (key,))
+        seen.add(key)
         if defaults is not None and key in defaults and defaults[key] is not None:
             try:
                 result[key] = _coerce(value, defaults[key])

@@ -1,0 +1,81 @@
+"""Benchmark harness: robust training throughput, reference-protocol timing.
+
+Times BASELINE.json config 2 — the cnnet CIFAR-10 CNN under Multi-Krum with
+n=8 workers, f=2 declared Byzantine — on whatever accelerator is present, and
+prints ONE JSON line.  The metric follows the reference's own definition:
+steps/s EXCLUDING the first (compilation) step (reference: runner.py:595-597).
+
+The reference repository publishes no numbers (BASELINE.md), so
+``vs_baseline`` is reported against the driver-set north-star throughput of
+2000 steps/s (BASELINE.json "north_star").
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+import optax
+
+NORTH_STAR_STEPS_PER_S = 2000.0
+
+
+def main(nb_workers=8, nb_byz=2, batch_size=128, steps=30):
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    # One real chip hosts all n logical workers (vmapped); a pod spreads them.
+    nb_devices = max(d for d in range(1, len(devices) + 1) if nb_workers % d == 0)
+    mesh = make_mesh(nb_workers=nb_devices, devices=devices[:nb_devices])
+
+    experiment = models.instantiate("cnnet", ["batch-size:%d" % batch_size])
+    gar = gars.instantiate("krum", nb_workers, nb_byz)
+    engine = RobustEngine(mesh, gar, nb_workers)
+
+    tx = optax.sgd(1e-2)
+    params = experiment.init(jax.random.PRNGKey(0))
+    state = engine.init_state(params, tx)
+    step = engine.build_step(experiment.loss, tx)
+
+    it = experiment.make_train_iterator(nb_workers, seed=0)
+    batch = engine.shard_batch(next(it))
+
+    # First step = compile + run (excluded, like the reference's report)
+    t0 = time.perf_counter()
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["total_loss"])
+    first = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["total_loss"])
+    elapsed = time.perf_counter() - t0
+
+    steps_per_s = steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+                "value": round(steps_per_s, 3),
+                "unit": "steps/s",
+                "vs_baseline": round(steps_per_s / NORTH_STAR_STEPS_PER_S, 4),
+                "detail": {
+                    "platform": devices[0].platform,
+                    "nb_devices": nb_devices,
+                    "nb_workers": nb_workers,
+                    "nb_byz": nb_byz,
+                    "batch_size_per_worker": batch_size,
+                    "first_step_s": round(first, 3),
+                    "timed_steps": steps,
+                    "final_loss": float(np.asarray(metrics["total_loss"])),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
