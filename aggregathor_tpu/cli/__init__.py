@@ -1,0 +1,8 @@
+"""Command-line entry points: ``runner`` (training) and ``deploy`` (multi-host).
+
+Mirrors the reference's L7 deployment layer (deploy.py, runner.py) with an
+argument-compatible surface re-based on the SPMD engine: there is no cluster
+of tf.train.Servers to stand up — ``runner`` drives the whole synchronous
+robust-SGD program on the local mesh, and ``deploy`` initializes JAX's
+multi-process runtime so the same program spans hosts over ICI/DCN.
+"""

@@ -1,0 +1,98 @@
+"""Multi-host deployment: the reference's ``deploy.py`` re-based on JAX.
+
+The reference bootstraps a TF server per node over SSH/mpirun and wires a
+ClusterSpec of ps/worker/eval jobs (reference: deploy.py:190-309).  A JAX
+multi-host program needs none of that choreography: every host runs the SAME
+single-controller SPMD program; ``jax.distributed.initialize`` connects the
+hosts (coordinator + process ranks) and the global device mesh spans all of
+them over ICI/DCN.  This shim does exactly that and then hands over to the
+runner — deployment collapses from 329 lines of SSH plumbing to "initialize,
+then run".
+
+Usage, one invocation per host (what SLURM/GKE/`gcloud compute tpus ssh
+--worker=all` would issue)::
+
+  python3 -m aggregathor_tpu.cli.deploy \
+      --coordinator-address HOST0:1234 --num-processes 4 --process-id $RANK \
+      -- --experiment mnist --aggregator krum --nb-workers 32 ...
+
+On Cloud TPU the three flags can be omitted entirely
+(``jax.distributed.initialize`` auto-detects the pod topology from the TPU
+metadata); arguments after ``--`` go to the runner verbatim.
+
+``--local-simulate K`` instead forks K local processes that form a K-process
+CPU "cluster" on localhost — the single-machine deployment story of the
+reference (README.md:141-146) and the integration-test hook for the DCN path.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="aggregathor-tpu deploy", description="Multi-host bring-up for the runner"
+    )
+    parser.add_argument("--coordinator-address", default=None, help="host:port of process 0")
+    parser.add_argument("--num-processes", type=int, default=None, help="total process count")
+    parser.add_argument("--process-id", type=int, default=None, help="this process' rank")
+    parser.add_argument(
+        "--local-simulate", type=int, default=0, metavar="K",
+        help="fork K local CPU processes forming a cluster on localhost (single-machine parity)",
+    )
+    parser.add_argument("--port", type=int, default=7000, help="coordinator port (reference: tools/cluster.py:60)")
+    parser.add_argument("runner_args", nargs=argparse.REMAINDER, help="arguments after -- go to the runner")
+    return parser
+
+
+def _strip_separator(rest):
+    return rest[1:] if rest and rest[0] == "--" else rest
+
+
+def local_simulate(nb_processes, port, runner_args):
+    """Fork a K-process localhost cluster (CPU devices) running the runner."""
+    procs = []
+    for rank in range(nb_processes):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # one device per process: the cluster IS the mesh
+        cmd = [
+            sys.executable, "-m", "aggregathor_tpu.cli.deploy",
+            "--coordinator-address", "127.0.0.1:%d" % port,
+            "--num-processes", str(nb_processes),
+            "--process-id", str(rank),
+            "--",
+        ] + runner_args
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    for proc in procs:
+        code = proc.wait() or code
+    return code
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    runner_args = _strip_separator(args.runner_args)
+    if args.local_simulate > 0:
+        return local_simulate(args.local_simulate, args.port, runner_args)
+
+    import jax
+
+    kwargs = {}
+    if args.coordinator_address is not None:
+        kwargs = {
+            "coordinator_address": args.coordinator_address,
+            "num_processes": args.num_processes,
+            "process_id": args.process_id,
+        }
+    jax.distributed.initialize(**kwargs)
+
+    from . import runner
+
+    return runner.main(runner_args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,267 @@
+"""Training runner: the reference's ``runner.py`` re-based on the SPMD engine.
+
+Argument-compatible surface (reference: runner.py:80-231): experiment /
+aggregator selection with ``key:value`` sub-args, n/f worker counts and their
+sanity checks (runner.py:253-260), optimizer + learning-rate registries,
+l1/l2 regularization (graph.py:125-139), attack plumbing (implementing the
+TODO at runner.py:345), lossy-UDP worker simulation (deploy.py:119-122),
+evaluation / checkpoint / summary cadences (config.py:54-61), NaN-loss
+divergence abort (runner.py:570-574) and the end-of-run performance report
+with the first (compilation) step excluded (runner.py:586-598).
+
+What is *gone*, by design: cluster specs, job names, tf.train.Server
+plumbing — one SPMD program over a device mesh replaces the PS/worker
+process topology.  Multi-host runs wrap this same runner with
+``cli.deploy`` (jax.distributed) instead of SSH'd server processes.
+
+Example::
+
+  python3 -m aggregathor_tpu.cli.runner --experiment mnist --aggregator krum \
+      --nb-workers 8 --nb-decl-byz-workers 2 --max-step 2000 \
+      --learning-rate-args initial-rate:0.05 --evaluation-period 10
+"""
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="aggregathor-tpu runner", description="Byzantine-resilient SPMD training on TPU"
+    )
+    # Experiment / aggregation (reference: runner.py:94-137)
+    parser.add_argument("--experiment", required=True, help="experiment name (see models registry)")
+    parser.add_argument("--experiment-args", nargs="*", default=[], help="key:value experiment arguments")
+    parser.add_argument("--aggregator", required=True, help="GAR name (see gars registry)")
+    parser.add_argument("--aggregator-args", nargs="*", default=[], help="key:value GAR arguments")
+    parser.add_argument("--nb-workers", type=int, required=True, help="number n of logical workers")
+    parser.add_argument("--nb-decl-byz-workers", type=int, default=0, help="declared Byzantine count f")
+    parser.add_argument("--nb-real-byz-workers", type=int, default=0, help="actual attacking worker count")
+    parser.add_argument("--attack", default=None, help="gradient attack name (reference TODO runner.py:345)")
+    parser.add_argument("--attack-args", nargs="*", default=[], help="key:value attack arguments")
+    # Optimization (reference: runner.py:157-183)
+    parser.add_argument("--optimizer", default="sgd", help="optimizer name")
+    parser.add_argument("--optimizer-args", nargs="*", default=[], help="key:value optimizer arguments")
+    parser.add_argument("--learning-rate", default="fixed", help="learning-rate schedule name")
+    parser.add_argument("--learning-rate-args", nargs="*", default=[], help="key:value schedule arguments")
+    parser.add_argument("--l1-regularize", type=float, default=None, help="l1 loss regularization")
+    parser.add_argument("--l2-regularize", type=float, default=None, help="l2 loss regularization")
+    parser.add_argument("--max-step", type=int, default=None, help="train step count (default config.py)")
+    parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
+    # Cadences (reference: runner.py:184-215)
+    parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
+    parser.add_argument("--evaluation-delta", type=int, default=None, help="eval every this many steps")
+    parser.add_argument("--evaluation-period", type=float, default=None, help="eval every this many seconds")
+    parser.add_argument("--checkpoint-dir", default=None, help="checkpoint directory")
+    parser.add_argument("--checkpoint-base-name", default=None, help="checkpoint file base name")
+    parser.add_argument("--checkpoint-delta", type=int, default=None)
+    parser.add_argument("--checkpoint-period", type=float, default=None)
+    parser.add_argument("--checkpoint-keep", type=int, default=5, help="snapshots to keep")
+    parser.add_argument("--summary-dir", default=None, help="JSONL scalar summary directory")
+    parser.add_argument("--summary-delta", type=int, default=None)
+    parser.add_argument("--summary-period", type=float, default=None)
+    # Transport simulation + tracing (reference: deploy.py:119-122, runner.py:216-219)
+    parser.add_argument("--UDP", type=int, default=0, dest="udp", help="first k workers use the lossy link")
+    parser.add_argument("--UDP-args", nargs="*", default=[], dest="udp_args", help="key:value lossy-link arguments")
+    parser.add_argument("--trace", action="store_true", help="capture a jax.profiler trace of a few steps")
+    parser.add_argument("--trace-dir", default="trace", help="profiler trace output directory")
+    # Mesh (replaces cluster/job flags, reference: runner.py:81-93, 220-231)
+    parser.add_argument("--nb-devices", type=int, default=None, help="devices on the worker mesh axis")
+    parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
+    parser.add_argument("--stdout-to", default=None, help="replicate stdout to this file")
+    parser.add_argument("--stderr-to", default=None, help="replicate stderr to this file")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    # Heavy imports after the platform choice is pinned.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import config, gars, models
+    from ..core import build_optimizer, build_schedule
+    from ..obs import CadenceTrigger, Checkpoints, EvalFile, PerfReport, SummaryWriter
+    from ..parallel import RobustEngine, attacks, make_mesh
+    from ..parallel.lossy import LossyLink
+    from ..utils import Context, UserException, info, replicate_streams, warning
+
+    replicate_streams(args.stdout_to, args.stderr_to)
+
+    # Worker-count sanity (reference: runner.py:253-260)
+    n, f, r = args.nb_workers, args.nb_decl_byz_workers, args.nb_real_byz_workers
+    if n < 1:
+        raise UserException("Need at least 1 worker (got %d)" % n)
+    if r > n:
+        raise UserException("More real Byzantine workers (%d) than workers (%d)" % (r, n))
+    if r > f:
+        warning("More real Byzantine workers (%d) than declared (%d): the GAR bound is void" % (r, f))
+    if n <= 2 * f:
+        warning("n = %d <= 2f = %d: most GARs offer no guarantee at this ratio" % (n, 2 * f))
+
+    with Context("cluster"):
+        devices = jax.devices()
+        nb_devices = args.nb_devices
+        if nb_devices is None:
+            nb_devices = max(d for d in range(1, len(devices) + 1) if n % d == 0)
+        mesh = make_mesh(nb_workers=nb_devices, devices=devices[:nb_devices])
+        info(
+            "Mesh: %d x %s device(s), %d worker(s)/device"
+            % (nb_devices, devices[0].platform, n // nb_devices)
+        )
+
+    with Context("graph"):
+        experiment = models.instantiate(args.experiment, args.experiment_args)
+        gar = gars.instantiate(args.aggregator, n, f, args.aggregator_args)
+        attack = attacks.instantiate(args.attack, n, r, args.attack_args) if args.attack else None
+        lossy = LossyLink(args.udp, args.udp_args) if args.udp > 0 else None
+        engine = RobustEngine(mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy)
+
+        schedule = build_schedule(args.learning_rate, args.learning_rate_args)
+        tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
+
+        # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
+        base_loss, l1, l2 = experiment.loss, args.l1_regularize, args.l2_regularize
+
+        def loss_fn(params, batch):
+            loss = base_loss(params, batch)
+            leaves = jax.tree_util.tree_leaves(params)
+            if l1:
+                loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
+            if l2:
+                loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
+            return loss
+
+        params = experiment.init(jax.random.PRNGKey(args.seed))
+        state = engine.init_state(params, tx, seed=args.seed)
+        step_fn = engine.build_step(loss_fn, tx)
+        eval_fn = engine.build_eval_sums(experiment.metrics)
+
+    # Cadences with config.py defaults (reference: config.py:54-61)
+    def pick(value, default):
+        return default if value is None else value
+
+    eval_trigger = CadenceTrigger(
+        pick(args.evaluation_delta, config.default_evaluation_delta),
+        pick(args.evaluation_period, config.default_evaluation_period),
+    )
+    ckpt_trigger = CadenceTrigger(
+        pick(args.checkpoint_delta, config.default_checkpoint_delta),
+        pick(args.checkpoint_period, config.default_checkpoint_period),
+    )
+    summary_trigger = CadenceTrigger(
+        pick(args.summary_delta, config.default_summary_delta),
+        pick(args.summary_period, config.default_summary_period),
+    )
+    checkpoints = Checkpoints(
+        args.checkpoint_dir,
+        pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
+        args.checkpoint_keep,
+    ) if args.checkpoint_dir else None
+    eval_file = EvalFile(args.evaluation_file)
+    summaries = SummaryWriter(args.summary_dir)
+
+    # Auto-restore the latest checkpoint (reference: runner.py:514-525)
+    offstep = 0
+    if checkpoints is not None and checkpoints.can_restore():
+        with Context("restore"):
+            state, offstep = checkpoints.restore(jax.device_get(state))
+            state = engine.replicate(state)
+
+    max_step = pick(args.max_step, config.default_max_step)
+    train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
+
+    stop = {"requested": False}
+
+    def on_signal(signum, frame):
+        stop["requested"] = True
+        warning("Interrupted: finishing current step then shutting down")
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    def run_eval(step):
+        sums = None
+        for batch in experiment.make_eval_iterator(n):
+            folded = jax.device_get(eval_fn(state, engine.shard_batch(batch)))
+            if sums is None:
+                sums = folded
+            else:
+                sums = jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
+        metrics = {name: float(total) / max(float(count), 1.0) for name, (total, count) in sums.items()}
+        info("Evaluation at step %d: %s" % (step, "  ".join("%s=%.4f" % kv for kv in sorted(metrics.items()))))
+        eval_file.append(step, metrics)
+        return metrics
+
+    perf = PerfReport()
+    metrics = {}
+    diverged = False
+    with Context("train"):
+        step = offstep
+        trace_ctx = None
+        try:
+            while step < max_step and not stop["requested"]:
+                if args.trace and step == offstep + 2:  # skip compile + warmup step
+                    import jax.profiler
+
+                    trace_ctx = jax.profiler.trace(args.trace_dir)
+                    trace_ctx.__enter__()
+                batch = engine.shard_batch(next(train_iter))
+                perf.step_begin()
+                state, metrics = step_fn(state, batch)
+                total_loss = float(jax.device_get(metrics["total_loss"]))
+                perf.step_end()
+                step += 1
+                if trace_ctx is not None and step >= offstep + 5:
+                    trace_ctx.__exit__(None, None, None)
+                    trace_ctx = None
+                    info("Profiler trace written to %r" % args.trace_dir)
+                # NaN-loss divergence abort (reference: runner.py:570-574)
+                if not np.isfinite(total_loss):
+                    diverged = True
+                    raise UserException("Training diverged (non-finite loss at step %d)" % step)
+                if eval_trigger.should_fire(step):
+                    run_eval(step)
+                    eval_trigger.fired(step)
+                if checkpoints is not None and ckpt_trigger.should_fire(step):
+                    checkpoints.save(state, step)
+                    ckpt_trigger.fired(step)
+                if summary_trigger.should_fire(step):
+                    summaries.scalars(
+                        step,
+                        {
+                            "total_loss": total_loss,
+                            "grad_norm": float(jax.device_get(metrics["grad_norm"])),
+                            "learning_rate": float(schedule(step)),
+                            "steps_per_s": perf.steps_per_s_excl_first(),
+                        },
+                    )
+                    summary_trigger.fired(step)
+        finally:
+            if trace_ctx is not None:
+                trace_ctx.__exit__(None, None, None)
+            # Final fire of every daemon (reference: runner.py:356-494 at
+            # stop) — skipped on divergence: evaluating or checkpointing the
+            # NaN state would poison the next run's auto-restore.
+            if step > offstep and not diverged:
+                if eval_trigger.enabled:
+                    run_eval(step)
+                if checkpoints is not None:
+                    checkpoints.save(state, step)
+                if metrics:
+                    summaries.scalars(step, {"total_loss": float(jax.device_get(metrics["total_loss"]))})
+            eval_file.close()
+            summaries.close()
+            perf.report()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

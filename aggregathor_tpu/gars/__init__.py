@@ -59,11 +59,16 @@ class GAR:
 
     coordinate_wise = False
     needs_distances = False
+    #: typed key:value argument defaults accepted by this rule (strict: an
+    #: unknown key raises instead of being silently ignored)
+    ARG_DEFAULTS = {}
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
+        from ..utils import parse_keyval
+
         self.nb_workers = int(nb_workers)
         self.nb_byz_workers = int(nb_byz_workers)
-        self.args = list(args or [])
+        self.args = parse_keyval(args, self.ARG_DEFAULTS, strict=True)
         self.check()
 
     def check(self):

@@ -1,0 +1,20 @@
+"""Observability: cadenced side-duties of the training loop.
+
+The reference runs evaluation / checkpointing / summaries as polling daemon
+threads sharing the TF session (reference: runner.py:356-494, cadence knobs at
+config.py:54-61).  A jitted SPMD step has no session to share — the idiomatic
+translation is cadence *triggers* checked between steps on the host, firing
+the same step-delta / wall-period policies, plus a final fire at shutdown.
+
+- ``CadenceTrigger``  step-delta / wall-period firing policy
+- ``Checkpoints``     step-indexed train-state snapshots, auto-restore latest
+- ``EvalFile``        the reference's TSV evaluation log format
+- ``SummaryWriter``   JSONL scalar event log (summary-file parity)
+- ``PerfReport``      steps/s report, first (compilation) step excluded
+"""
+
+from .cadence import CadenceTrigger  # noqa: F401
+from .checkpoint import Checkpoints  # noqa: F401
+from .evalfile import EvalFile  # noqa: F401
+from .summaries import SummaryWriter  # noqa: F401
+from .perf import PerfReport  # noqa: F401

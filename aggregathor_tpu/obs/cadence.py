@@ -1,0 +1,38 @@
+"""Step-delta / wall-period cadence policy.
+
+Reference semantics (runner.py:356-494): each daemon fires when the step
+advanced by at least ``delta`` since the last firing, or when ``period``
+seconds of wall time passed, whichever criterion is enabled (negative
+disables); each also fires once more at coordinator stop.
+"""
+
+import time
+
+
+class CadenceTrigger:
+    """Fires on step-delta and/or wall-period, like the reference daemons."""
+
+    def __init__(self, delta=-1, period=-1.0):
+        self.delta = int(delta)
+        self.period = float(period)
+        self.last_step = None
+        self.last_time = time.monotonic()
+
+    @property
+    def enabled(self):
+        return self.delta >= 0 or self.period >= 0.0
+
+    def should_fire(self, step):
+        if not self.enabled:
+            return False
+        if self.last_step is None:
+            return True  # fire once at start (reference: wait-for-first-eval, runner.py:545)
+        if self.delta >= 0 and step - self.last_step >= self.delta:
+            return True
+        if self.period >= 0.0 and time.monotonic() - self.last_time >= self.period:
+            return True
+        return False
+
+    def fired(self, step):
+        self.last_step = int(step)
+        self.last_time = time.monotonic()

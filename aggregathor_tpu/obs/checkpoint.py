@@ -1,0 +1,78 @@
+"""Step-indexed train-state checkpoints.
+
+Parity with the reference's ``tools.Checkpoints`` (tools/tf.py:78-173):
+files ``<base>-<step>.ckpt`` in a directory, discovery by scanning and
+sorting by step, ``can_restore`` / ``restore`` (latest or a given step) /
+``save``, auto-restore of the latest at training start (runner.py:514-525).
+
+Snapshots are the full TrainState pytree (params, optimizer state, step, rng)
+serialized with ``flax.serialization`` (msgpack); restore deserializes into a
+freshly-initialized template state, so shape/dtype mismatches fail loudly.
+Writes are atomic (tmp file + rename) so a killed run never leaves a torn
+latest checkpoint.
+"""
+
+import os
+import re
+
+import flax.serialization
+import jax
+
+from ..utils import UserException, info
+
+
+class Checkpoints:
+    def __init__(self, directory, base_name="model", max_to_keep=5):
+        self.directory = directory
+        self.base_name = base_name
+        self.max_to_keep = int(max_to_keep)
+        self._pattern = re.compile(re.escape(base_name) + r"-(\d+)\.ckpt$")
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step):
+        return os.path.join(self.directory, "%s-%d.ckpt" % (self.base_name, step))
+
+    def steps(self):
+        """Sorted list of steps with an on-disk snapshot (tools/tf.py:92-102)."""
+        if not self.directory or not os.path.isdir(self.directory):
+            return []
+        found = []
+        for name in os.listdir(self.directory):
+            match = self._pattern.match(name)
+            if match:
+                found.append(int(match.group(1)))
+        return sorted(found)
+
+    def can_restore(self, step=None):
+        steps = self.steps()
+        return bool(steps) if step is None else step in steps
+
+    def restore(self, template_state, step=None):
+        """Restore into ``template_state``'s structure; latest step if None."""
+        steps = self.steps()
+        if not steps:
+            raise UserException("No checkpoint to restore in %r" % (self.directory,))
+        if step is None:
+            step = steps[-1]
+        elif step not in steps:
+            raise UserException("No checkpoint for step %d in %r" % (step, self.directory))
+        with open(self._path(step), "rb") as fd:
+            state = flax.serialization.from_bytes(template_state, fd.read())
+        info("Restored checkpoint at step %d from %r" % (step, self.directory))
+        return state, step
+
+    def save(self, state, step=None):
+        """Snapshot ``state``; prunes beyond ``max_to_keep`` oldest-first."""
+        if step is None:
+            step = int(jax.device_get(state.step))
+        data = flax.serialization.to_bytes(jax.device_get(state))
+        path = self._path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fd:
+            fd.write(data)
+        os.replace(tmp, path)
+        if self.max_to_keep > 0:
+            for old in self.steps()[: -self.max_to_keep]:
+                os.remove(self._path(old))
+        return path

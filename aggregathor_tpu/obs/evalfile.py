@@ -1,0 +1,27 @@
+"""Evaluation TSV log: ``walltime<TAB>step<TAB>name:value...`` per line.
+
+Same format as the reference's evaluation thread output (runner.py:184-187,
+394-399), so existing plotting scripts keep working.
+"""
+
+import time
+
+
+class EvalFile:
+    def __init__(self, path):
+        self.path = path
+        self._fd = open(path, "a") if path else None
+        self._start = time.time()
+
+    def append(self, step, metrics):
+        if self._fd is None:
+            return
+        fields = ["%.6f" % (time.time() - self._start), str(int(step))]
+        fields += ["%s:%s" % (name, float(value)) for name, value in sorted(metrics.items())]
+        self._fd.write("\t".join(fields) + "\n")
+        self._fd.flush()
+
+    def close(self):
+        if self._fd is not None:
+            self._fd.close()
+            self._fd = None

@@ -1,0 +1,50 @@
+"""Throughput accounting: the reference's end-of-run performance report.
+
+Reproduces runner.py:504-506, 561-569, 586-598: wall time split into
+"in-graph" (blocking on the device step) vs "off-graph" (host-side work
+between steps), steps/s including and excluding the first (compilation) step.
+"""
+
+import time
+
+from ..utils import info
+
+
+class PerfReport:
+    def __init__(self):
+        self.nb_steps = 0
+        self.first_step_s = 0.0
+        self.in_graph_s = 0.0
+        self.start = time.monotonic()
+        self._step_start = None
+
+    def step_begin(self):
+        self._step_start = time.monotonic()
+
+    def step_end(self):
+        elapsed = time.monotonic() - self._step_start
+        if self.nb_steps == 0:
+            self.first_step_s = elapsed
+        self.in_graph_s += elapsed
+        self.nb_steps += 1
+
+    def report(self):
+        total = time.monotonic() - self.start
+        off_graph = total - self.in_graph_s
+        info("Performance report:")
+        info("  steps                 %d" % self.nb_steps)
+        info("  total wall time       %.3f s" % total)
+        info("  in-graph time         %.3f s (%.1f%%)" % (self.in_graph_s, 100.0 * self.in_graph_s / max(total, 1e-9)))
+        info("  off-graph time        %.3f s (%.1f%%)" % (off_graph, 100.0 * off_graph / max(total, 1e-9)))
+        info("  first (compile) step  %.3f s" % self.first_step_s)
+        if self.nb_steps > 0:
+            info("  steps/s (all steps)   %.3f" % (self.nb_steps / max(total, 1e-9)))
+        if self.nb_steps > 1:
+            excl = (self.nb_steps - 1) / max(total - self.first_step_s, 1e-9)
+            info("  steps/s (excl. 1st)   %.3f" % excl)
+
+    def steps_per_s_excl_first(self):
+        total = time.monotonic() - self.start
+        if self.nb_steps <= 1:
+            return 0.0
+        return (self.nb_steps - 1) / max(total - self.first_step_s, 1e-9)

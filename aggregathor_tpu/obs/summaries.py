@@ -1,0 +1,36 @@
+"""Scalar summary events as JSONL.
+
+The reference writes TF summaries (learning rate, eval metrics) through a
+``FileWriter`` (graph.py:243, 291-292; runner.py:454-494).  The TF event-file
+format buys nothing without TensorBoard in the loop; the portable equivalent
+is one JSON object per event line — trivially greppable/plottable, and
+convertible to TF events offline if ever needed.
+"""
+
+import json
+import time
+
+
+class SummaryWriter:
+    def __init__(self, directory, run_name="run"):
+        self.path = None
+        self._fd = None
+        if directory:
+            import os
+
+            os.makedirs(directory, exist_ok=True)
+            self.path = os.path.join(directory, "%s-%d.jsonl" % (run_name, int(time.time())))
+            self._fd = open(self.path, "a")
+
+    def scalars(self, step, values):
+        if self._fd is None:
+            return
+        event = {"wall": time.time(), "step": int(step)}
+        event.update({name: float(value) for name, value in values.items()})
+        self._fd.write(json.dumps(event) + "\n")
+        self._fd.flush()
+
+    def close(self):
+        if self._fd is not None:
+            self._fd.close()
+            self._fd = None

@@ -182,13 +182,18 @@ class RobustEngine:
         )
         return jax.jit(sharded, donate_argnums=(0,))
 
-    def build_eval(self, metric_fn):
-        """Build the jitted evaluation step.
+    def build_eval_sums(self, metric_fn):
+        """Build the jitted evaluation step returning (sum, count) accumulators.
+
+        Exact full-split metrics need sums accumulated across *all* eval
+        batches before dividing (the reference evaluates the whole test set in
+        one graph pass, experiments/mnist.py:136-148; here the host loop
+        accumulates per-batch device sums instead).
 
         Args:
           metric_fn: (params, worker_batch) -> dict name -> (sum, count).
         Returns:
-          eval_step(state, batch) -> dict name -> mean over the whole batch.
+          eval_step(state, batch) -> dict name -> (sum, count) over the batch.
         """
         W = self.nb_devices
 
@@ -197,7 +202,7 @@ class RobustEngine:
             folded = jax.tree_util.tree_map(lambda x: jnp.sum(x, axis=0), sums)
             if W > 1:
                 folded = jax.lax.psum(folded, worker_axis)
-            return {name: total / jnp.maximum(count, 1) for name, (total, count) in folded.items()}
+            return folded
 
         sharded = jax.shard_map(
             body,
@@ -207,6 +212,16 @@ class RobustEngine:
             check_vma=False,
         )
         return jax.jit(sharded)
+
+    def build_eval(self, metric_fn):
+        """Like ``build_eval_sums`` but divides, returning per-batch means."""
+        eval_sums = self.build_eval_sums(metric_fn)
+
+        def means(state, batch):
+            folded = eval_sums(state, batch)
+            return {name: total / jnp.maximum(count, 1) for name, (total, count) in folded.items()}
+
+        return means
 
     # ------------------------------------------------------------------ #
 

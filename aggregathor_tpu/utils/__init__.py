@@ -17,6 +17,7 @@ from .logging import (  # noqa: F401
     warning,
     error,
     fatal,
+    replicate_streams,
 )
 from .registry import ClassRegister  # noqa: F401
 from .keyval import parse_keyval  # noqa: F401

@@ -30,13 +30,15 @@ def _coerce(value, default):
     return type(default)(value)
 
 
-def parse_keyval(pairs, defaults=None):
+def parse_keyval(pairs, defaults=None, strict=False):
     """Parse a list of ``"key:value"`` strings into a dict.
 
     Args:
       pairs:    iterable of ``key:value`` strings (value may contain ':').
       defaults: optional dict of typed defaults; parsed values are coerced to
                 the default's type, and missing keys take the default value.
+      strict:   reject keys not present in ``defaults`` (catches typo'd or
+                unsupported options instead of silently ignoring them).
     Returns:
       dict of key -> typed value.
     """
@@ -49,6 +51,11 @@ def parse_keyval(pairs, defaults=None):
         if key in seen:
             raise log.UserException("Key %r had already been specified" % (key,))
         seen.add(key)
+        if strict and key not in (defaults or {}):
+            raise log.UserException(
+                "Unknown key %r (accepted: %s)"
+                % (key, ", ".join(sorted(defaults)) if defaults else "none")
+            )
         if defaults is not None and key in defaults and defaults[key] is not None:
             try:
                 result[key] = _coerce(value, defaults[key])

@@ -99,3 +99,41 @@ def fatal(*args):
     """Log at fatal severity and raise UserException (clean exit path)."""
     _emit("fatal", "[fatal]", *args)
     raise UserException(" ".join(str(a) for a in args))
+
+
+class _Tee:
+    """Write-through to a primary stream plus a log file (reference: tools/misc.py:45-78).
+
+    Everything not overridden (fileno, buffer, encoding, ...) delegates to the
+    primary stream, so low-level consumers (subprocess, faulthandler, C-level
+    logging) keep working; only the text-mode ``write`` path is duplicated
+    into the file.
+    """
+
+    def __init__(self, primary, path):
+        self._primary = primary
+        self._file = open(path, "a")
+
+    def write(self, text):
+        count = self._primary.write(text)
+        self._file.write(text)
+        self._file.flush()
+        return count
+
+    def flush(self):
+        self._primary.flush()
+        self._file.flush()
+
+    def isatty(self):
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._primary, name)
+
+
+def replicate_streams(stdout_path=None, stderr_path=None):
+    """Tee stdout/stderr into files (the reference's ``--stdout-to/--stderr-to``)."""
+    if stdout_path:
+        sys.stdout = _Tee(sys.stdout, stdout_path)
+    if stderr_path:
+        sys.stderr = _Tee(sys.stderr, stderr_path)

@@ -1,0 +1,82 @@
+"""Tests for the observability layer: cadences, checkpoints, eval TSV."""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from aggregathor_tpu.core import TrainState
+from aggregathor_tpu.obs import CadenceTrigger, Checkpoints, EvalFile
+from aggregathor_tpu.utils import UserException
+
+
+def test_cadence_delta():
+    trig = CadenceTrigger(delta=10, period=-1.0)
+    assert trig.should_fire(0)  # fires once at start
+    trig.fired(0)
+    assert not trig.should_fire(9)
+    assert trig.should_fire(10)
+    trig.fired(10)
+    assert not trig.should_fire(19)
+    assert trig.should_fire(25)
+
+
+def test_cadence_disabled():
+    trig = CadenceTrigger(delta=-1, period=-1.0)
+    assert not trig.enabled
+    assert not trig.should_fire(0)
+
+
+def test_cadence_period():
+    trig = CadenceTrigger(delta=-1, period=0.0)
+    trig.fired(0)
+    assert trig.should_fire(1)  # period 0: every opportunity
+
+
+def _tiny_state(value=0.0):
+    params = {"w": np.full((3,), value, np.float32), "b": np.zeros((2,), np.float32)}
+    tx = optax.sgd(0.1)
+    return TrainState.create(params, tx), tx
+
+
+def test_checkpoints_roundtrip(tmp_path):
+    state, _ = _tiny_state(1.5)
+    ckpts = Checkpoints(str(tmp_path), "model", max_to_keep=2)
+    assert not ckpts.can_restore()
+    with pytest.raises(UserException):
+        ckpts.restore(state)
+    ckpts.save(state, 5)
+    state2, _ = _tiny_state(9.9)
+    restored, step = ckpts.restore(state2)
+    assert step == 5
+    np.testing.assert_allclose(np.asarray(restored.params["w"]), 1.5)
+
+
+def test_checkpoints_latest_and_prune(tmp_path):
+    state, _ = _tiny_state()
+    ckpts = Checkpoints(str(tmp_path), "model", max_to_keep=2)
+    for step in (3, 7, 11):
+        ckpts.save(state.replace(step=jax.numpy.int32(step)), step)
+    assert ckpts.steps() == [7, 11]  # pruned to 2, oldest dropped
+    _, step = ckpts.restore(state)
+    assert step == 11
+
+
+def test_eval_file_format(tmp_path):
+    path = str(tmp_path / "eval")
+    ef = EvalFile(path)
+    ef.append(42, {"accuracy": 0.5, "xent": 1.25})
+    ef.close()
+    with open(path) as fd:
+        fields = fd.read().strip().split("\t")
+    assert fields[1] == "42"
+    assert "accuracy:0.5" in fields
+    float(fields[0])  # walltime parses
+
+
+def test_eval_file_disabled():
+    ef = EvalFile(None)
+    ef.append(0, {"a": 1.0})  # no-op, no crash
+    ef.close()
