@@ -94,5 +94,16 @@ def main(argv=None):
     return runner.main(runner_args)
 
 
+def cli():
+    """Console entry: UserException -> clean error + exit(1) (reference: tools/__init__.py:232-258)."""
+    from ..utils import UserException, error
+
+    try:
+        return main()
+    except UserException as exc:
+        error(str(exc))
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(cli())

@@ -184,8 +184,10 @@ def main(argv=None):
         stop["requested"] = True
         warning("Interrupted: finishing current step then shutting down")
 
-    signal.signal(signal.SIGINT, on_signal)
-    signal.signal(signal.SIGTERM, on_signal)
+    previous_handlers = {
+        signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
+        signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
+    }
 
     def run_eval(step):
         sums = None
@@ -206,6 +208,21 @@ def main(argv=None):
     with Context("train"):
         step = offstep
         trace_ctx = None
+        # NaN divergence is checked with a ONE-STEP LAG: blocking on the
+        # current step's loss every iteration would serialize host and device
+        # and defeat async dispatch; checking the previous step's (by now
+        # materialized) loss keeps one step in flight with the same abort
+        # guarantee one step later (the reference checks synchronously only
+        # because sess.run already blocked, runner.py:570-574).
+        pending_loss = None
+
+        def check_divergence():
+            nonlocal diverged
+            value = float(jax.device_get(pending_loss))
+            if not np.isfinite(value):
+                diverged = True
+                raise UserException("Training diverged (non-finite loss around step %d)" % step)
+
         try:
             while step < max_step and not stop["requested"]:
                 if args.trace and step == offstep + 2:  # skip compile + warmup step
@@ -216,46 +233,51 @@ def main(argv=None):
                 batch = engine.shard_batch(next(train_iter))
                 perf.step_begin()
                 state, metrics = step_fn(state, batch)
-                total_loss = float(jax.device_get(metrics["total_loss"]))
+                if pending_loss is not None:
+                    check_divergence()
+                pending_loss = metrics["total_loss"]
                 perf.step_end()
                 step += 1
                 if trace_ctx is not None and step >= offstep + 5:
                     trace_ctx.__exit__(None, None, None)
                     trace_ctx = None
                     info("Profiler trace written to %r" % args.trace_dir)
-                # NaN-loss divergence abort (reference: runner.py:570-574)
-                if not np.isfinite(total_loss):
-                    diverged = True
-                    raise UserException("Training diverged (non-finite loss at step %d)" % step)
                 if eval_trigger.should_fire(step):
+                    check_divergence()
                     run_eval(step)
                     eval_trigger.fired(step)
                 if checkpoints is not None and ckpt_trigger.should_fire(step):
+                    check_divergence()
                     checkpoints.save(state, step)
                     ckpt_trigger.fired(step)
                 if summary_trigger.should_fire(step):
                     summaries.scalars(
                         step,
                         {
-                            "total_loss": total_loss,
+                            "total_loss": float(jax.device_get(metrics["total_loss"])),
                             "grad_norm": float(jax.device_get(metrics["grad_norm"])),
                             "learning_rate": float(schedule(step)),
                             "steps_per_s": perf.steps_per_s_excl_first(),
                         },
                     )
                     summary_trigger.fired(step)
+            if pending_loss is not None:
+                check_divergence()
         finally:
+            for signum, handler in previous_handlers.items():
+                signal.signal(signum, handler)
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
             # Final fire of every daemon (reference: runner.py:356-494 at
-            # stop) — skipped on divergence: evaluating or checkpointing the
-            # NaN state would poison the next run's auto-restore.
+            # stop) — skipped on divergence (evaluating or checkpointing the
+            # NaN state would poison the next run's auto-restore) and when
+            # the trigger already fired at this exact step.
             if step > offstep and not diverged:
-                if eval_trigger.enabled:
+                if eval_trigger.enabled and eval_trigger.last_step != step:
                     run_eval(step)
-                if checkpoints is not None:
+                if checkpoints is not None and ckpt_trigger.last_step != step:
                     checkpoints.save(state, step)
-                if metrics:
+                if metrics and summary_trigger.last_step != step:
                     summaries.scalars(step, {"total_loss": float(jax.device_get(metrics["total_loss"]))})
             eval_file.close()
             summaries.close()
@@ -263,5 +285,16 @@ def main(argv=None):
     return 0
 
 
+def cli():
+    """Console entry: UserException -> clean error + exit(1) (reference: tools/__init__.py:232-258)."""
+    from ..utils import UserException, error
+
+    try:
+        return main()
+    except UserException as exc:
+        error(str(exc))
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(cli())

@@ -1,0 +1,117 @@
+"""ResNet v1 family, TPU-first.
+
+Capability parity with the reference's vendored slim resnet_v1
+(external/slim/nets/resnet_v1.py:281+, including its resnet_v1_18 addition
+and the 34/50/101/152/200 depths from nets_factory.py:39-60) — written fresh
+as flax modules:
+
+- **GroupNorm instead of BatchNorm**: the robust-DP engine treats model state
+  as pure parameters (one canonical replicated copy, SURVEY.md §7 design
+  stance); BatchNorm's mutable batch statistics would either leak information
+  across Byzantine workers (shared stats) or desynchronize the replicas
+  (per-worker stats).  GroupNorm is stateless, batch-size independent, and
+  its normalization math fuses cleanly in XLA.
+- NHWC layout, 3x3/1x1 convs and the stride-2 downsampling exactly as in v1;
+  bfloat16-friendly (params float32, compute dtype configurable).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut (depths 18/34)."""
+
+    filters: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.stride, self.stride), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        y = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype, name="norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype, name="norm2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), (self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype, name="shortcut")(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype,
+                                    name="shortcut_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck (depths 50/101/152/200)."""
+
+    filters: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        out_filters = 4 * self.filters
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype, name="conv1")(x)
+        y = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype, name="norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.stride, self.stride), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype, name="norm2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_filters, (1, 1), use_bias=False, dtype=self.dtype, name="conv3")(y)
+        y = nn.GroupNorm(num_groups=min(32, out_filters), dtype=self.dtype, name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(out_filters, (1, 1), (self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype, name="shortcut")(residual)
+            residual = nn.GroupNorm(num_groups=min(32, out_filters), dtype=self.dtype,
+                                    name="shortcut_norm")(residual)
+        return nn.relu(residual + y)
+
+
+# depth -> (block class, stage sizes); nets_factory.py's resnet_v1 variants
+RESNET_DEPTHS = {
+    18: (BasicBlock, (2, 2, 2, 2)),
+    34: (BasicBlock, (3, 4, 6, 3)),
+    50: (BottleneckBlock, (3, 4, 6, 3)),
+    101: (BottleneckBlock, (3, 4, 23, 3)),
+    152: (BottleneckBlock, (3, 8, 36, 3)),
+    200: (BottleneckBlock, (3, 24, 36, 3)),
+}
+
+
+class ResNet(nn.Module):
+    """ResNet v1 classifier.
+
+    ``small_inputs`` switches the stem from the ImageNet 7x7/2 + 3x3/2-pool to
+    a CIFAR-style 3x3/1 conv (no pool), the standard adaptation for 32x32.
+    """
+
+    depth: int = 50
+    classes: int = 1000
+    small_inputs: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        block_cls, stages = RESNET_DEPTHS[self.depth]
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype, name="stem")(x)
+        else:
+            x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+        x = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="stem_norm")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, nb_blocks in enumerate(stages):
+            for block in range(nb_blocks):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = block_cls(64 * (2 ** stage), stride, self.dtype,
+                              name="stage%d_block%d" % (stage + 1, block))(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)

@@ -1,0 +1,40 @@
+"""VGG family (a/11, 16, 19), slims zoo parity.
+
+The reference's slims experiments expose ``vgg_a``, ``vgg_16``, ``vgg_19``
+through nets_factory (external/slim/nets/nets_factory.py:39-60).  Fresh flax
+implementation: conv3x3 stacks + 2x2 max-pool stages, classifier head as
+dense layers (the fully-convolutional head of the original is an inference
+optimization that buys nothing under jit).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# name -> convs per stage (stage filters are 64,128,256,512,512)
+VGG_STAGES = {
+    "vgg_a": (1, 1, 2, 2, 2),   # VGG-11
+    "vgg_16": (2, 2, 3, 3, 3),
+    "vgg_19": (2, 2, 4, 4, 4),
+}
+
+
+class VGG(nn.Module):
+    variant: str = "vgg_16"
+    classes: int = 1000
+    dense_units: int = 4096
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for stage, nb_convs in enumerate(VGG_STAGES[self.variant]):
+            filters = min(64 * (2 ** stage), 512)
+            for conv in range(nb_convs):
+                x = nn.Conv(filters, (3, 3), padding="SAME", dtype=self.dtype,
+                            name="stage%d_conv%d" % (stage + 1, conv))(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_units, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(self.dense_units, dtype=self.dtype, name="fc2")(x))
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)

@@ -1,0 +1,137 @@
+"""The model zoo: ``slim-<model>-<dataset>`` experiments.
+
+Parity with the reference's slims experiments (experiments/slims.py:193-196),
+which register every nets_factory network crossed with every locally present
+dataset.  Here the factory maps names to fresh flax builders (resnet v1
+family, vgg family) and the datasets are cifar10 and the ImageNet-shaped
+stand-in; the experiment names keep the reference's ``slim-`` prefix so
+driver scripts carry over unchanged.
+
+Args (same surface as slims.py:69-76): ``batch-size``, ``eval-batch-size``,
+``weight-decay``, ``label-smoothing``, ``labels-offset``, plus TPU-first
+``dtype`` (float32/bfloat16 compute) and ``image-size`` for the ImageNet
+stand-in.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..utils import parse_keyval
+from . import Experiment, register
+from .datasets import WorkerBatchIterator, eval_batches, load_cifar10, load_imagenet_standin
+from .resnet import RESNET_DEPTHS, ResNet
+from .vgg import VGG_STAGES, VGG
+
+
+def _make_factory():
+    factory = {}
+    for depth in RESNET_DEPTHS:
+        factory["resnet_v1_%d" % depth] = (
+            lambda classes, small, dtype, depth=depth: ResNet(
+                depth=depth, classes=classes, small_inputs=small, dtype=dtype
+            )
+        )
+    for variant in VGG_STAGES:
+        factory[variant] = (
+            lambda classes, small, dtype, variant=variant: VGG(
+                variant=variant, classes=classes, dense_units=512 if small else 4096, dtype=dtype
+            )
+        )
+    return factory
+
+
+MODEL_FACTORY = _make_factory()
+
+DATASETS = {
+    "cifar10": lambda kv: load_cifar10(),
+    "imagenet": lambda kv: load_imagenet_standin(image_size=kv["image-size"]),
+}
+
+
+class ZooExperiment(Experiment):
+    """One (model, dataset) pair from the factory."""
+
+    model_name = None
+    dataset_name = None
+
+    def __init__(self, args):
+        super().__init__(args)
+        kv = parse_keyval(
+            args,
+            {
+                "batch-size": 32,
+                "eval-batch-size": 64,
+                "weight-decay": 0.0,
+                "label-smoothing": 0.0,
+                "labels-offset": 0,
+                "image-size": 224,
+                "dtype": "float32",
+            },
+        )
+        self.batch_size = kv["batch-size"]
+        self.eval_batch_size = kv["eval-batch-size"]
+        self.weight_decay = kv["weight-decay"]
+        self.label_smoothing = kv["label-smoothing"]
+        self.labels_offset = kv["labels-offset"]
+        self.dataset = DATASETS[self.dataset_name](kv)
+        dtype = jnp.bfloat16 if kv["dtype"] == "bfloat16" else jnp.float32
+        classes = self.dataset.nb_classes - self.labels_offset
+        small = self.dataset.x_train.shape[1] <= 64
+        self.model = MODEL_FACTORY[self.model_name](classes, small, dtype)
+        self.sample_shape = self.dataset.x_train.shape[1:]
+
+    def init(self, rng):
+        sample = jnp.zeros((1,) + tuple(self.sample_shape), jnp.float32)
+        return self.model.init(rng, sample)
+
+    def _logits_labels(self, params, batch):
+        return self.model.apply(params, batch["image"]), batch["label"] - self.labels_offset
+
+    def loss(self, params, batch):
+        logits, labels = self._logits_labels(params, batch)
+        if self.label_smoothing > 0.0:
+            classes = logits.shape[-1]
+            soft = optax.smooth_labels(jax.nn.one_hot(labels, classes), self.label_smoothing)
+            loss = jnp.mean(optax.softmax_cross_entropy(logits, soft))
+        else:
+            loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+        if self.weight_decay > 0.0:
+            loss = loss + self.weight_decay * sum(
+                jnp.sum(p.astype(jnp.float32) ** 2) for p in jax.tree_util.tree_leaves(params)
+            )
+        return loss
+
+    def metrics(self, params, batch):
+        logits, labels = self._logits_labels(params, batch)
+        hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        valid = batch.get("valid")
+        if valid is not None:
+            hit = hit * valid
+            count = jnp.sum(valid)
+        else:
+            count = jnp.float32(hit.shape[0])
+        return {"accuracy": (jnp.sum(hit), count)}
+
+    def make_train_iterator(self, nb_workers, seed=0):
+        return WorkerBatchIterator(
+            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed
+        )
+
+    def make_eval_iterator(self, nb_workers):
+        return eval_batches(self.dataset.x_test, self.dataset.y_test, nb_workers, self.eval_batch_size)
+
+
+def _register_all():
+    for model_name in MODEL_FACTORY:
+        for dataset_name in DATASETS:
+            name = "slim-%s-%s" % (model_name, dataset_name)
+            cls = type(
+                "Zoo_%s_%s" % (model_name, dataset_name),
+                (ZooExperiment,),
+                {"model_name": model_name, "dataset_name": dataset_name},
+            )
+            register(name, cls)
+
+
+_register_all()

@@ -19,7 +19,11 @@ class SummaryWriter:
             import os
 
             os.makedirs(directory, exist_ok=True)
-            self.path = os.path.join(directory, "%s-%d.jsonl" % (run_name, int(time.time())))
+            # pid suffix: back-to-back runs in the same second must not
+            # interleave into one file
+            self.path = os.path.join(
+                directory, "%s-%d-%d.jsonl" % (run_name, int(time.time()), os.getpid())
+            )
             self._fd = open(self.path, "a")
 
     def scalars(self, step, values):
