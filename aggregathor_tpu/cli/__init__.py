@@ -6,3 +6,14 @@ of tf.train.Servers to stand up — ``runner`` drives the whole synchronous
 robust-SGD program on the local mesh, and ``deploy`` initializes JAX's
 multi-process runtime so the same program spans hosts over ICI/DCN.
 """
+
+
+def console_entry(main):
+    """Run a CLI main: UserException -> clean error + exit code 1 (reference: tools/__init__.py:232-258)."""
+    from ..utils import UserException, error
+
+    try:
+        return main()
+    except UserException as exc:
+        error(str(exc))
+        return 1

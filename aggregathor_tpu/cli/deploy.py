@@ -95,14 +95,9 @@ def main(argv=None):
 
 
 def cli():
-    """Console entry: UserException -> clean error + exit(1) (reference: tools/__init__.py:232-258)."""
-    from ..utils import UserException, error
+    from . import console_entry
 
-    try:
-        return main()
-    except UserException as exc:
-        error(str(exc))
-        return 1
+    return console_entry(main)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,104 @@
+"""Native-tier GAR registrations (``*-native``).
+
+The reference exposes each rule in several independently implemented tiers
+and registers the native ones only when the toolchain builds them
+(aggregators/krum.py:166-169).  Same here: every ``<rule>-native`` name wraps
+the C++ host library (ops/native) for the dense ``aggregate`` path, and is
+only registered when the library compiles on this host.
+
+The blockwise path (``aggregate_block``, used by the sharded engine) is
+inherited from the jnp tier: on-device aggregation is XLA's job — the native
+tier exists for host-side aggregation, CPU-only deployments, and as a second
+independent implementation for cross-checking (SURVEY.md §4 point 3).
+
+Inside ``jit`` the host call is bridged with ``jax.pure_callback``.
+
+Names register unconditionally; the C++ build/load is deferred to the first
+``instantiate`` of a native rule, so importing the package never spawns a
+compiler — a ``UserException`` at construction reports a missing toolchain.
+"""
+
+import numpy as np
+
+from . import register
+from .average import AverageGAR
+from .average_nan import AverageNaNGAR
+from .averaged_median import AveragedMedianGAR
+from .bulyan import BulyanGAR
+from .krum import KrumGAR
+from .median import MedianGAR
+from ..ops import native
+
+
+def _host_dtype(dtype):
+    return np.dtype(dtype) if np.dtype(dtype) in (np.float32, np.float64) else np.dtype(np.float64)
+
+
+class _NativeMixin:
+    """Defers the C++ build/load to rule construction time."""
+
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        try:
+            native.load()
+        except Exception as exc:
+            from ..utils import UserException
+
+            raise UserException(
+                "%s requires the native GAR library: %s" % (type(self).__name__, exc)
+            ) from exc
+
+
+def _dense(host_fn):
+    """Build an ``aggregate`` running ``host_fn(self, np_grads) -> (d,)``.
+
+    numpy input runs directly; jax input (traced or concrete) goes through
+    ``pure_callback`` so the native tier composes with jit.
+    """
+
+    def aggregate(self, grads):
+        if isinstance(grads, np.ndarray):
+            return host_fn(self, grads)
+        import jax
+
+        dtype = _host_dtype(grads.dtype)
+        result = jax.ShapeDtypeStruct((grads.shape[1],), dtype)
+        return jax.pure_callback(
+            lambda g: host_fn(self, np.asarray(g, dtype=dtype)), result, grads
+        )
+
+    return aggregate
+
+
+class NativeAverageGAR(_NativeMixin, AverageGAR):
+    aggregate = _dense(lambda self, g: native.average(g))
+
+
+class NativeAverageNaNGAR(_NativeMixin, AverageNaNGAR):
+    aggregate = _dense(lambda self, g: native.average_nan(g))
+
+
+class NativeMedianGAR(_NativeMixin, MedianGAR):
+    aggregate = _dense(lambda self, g: native.median(g))
+
+
+class NativeAveragedMedianGAR(_NativeMixin, AveragedMedianGAR):
+    aggregate = _dense(lambda self, g: native.averaged_median(g, self.nb_byz_workers))
+
+
+class NativeKrumGAR(_NativeMixin, KrumGAR):
+    aggregate = _dense(
+        lambda self, g: native.krum(g, self.nb_byz_workers, self.nb_selected)
+    )
+
+
+class NativeBulyanGAR(_NativeMixin, BulyanGAR):
+    aggregate = _dense(lambda self, g: native.bulyan(g, self.nb_byz_workers))
+
+
+register("average-native", NativeAverageGAR)
+register("average-nan-native", NativeAverageNaNGAR)
+register("median-native", NativeMedianGAR)
+register("averaged-median-native", NativeAveragedMedianGAR)
+register("krum-native", NativeKrumGAR)
+register("bulyan-native", NativeBulyanGAR)

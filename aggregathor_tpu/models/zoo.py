@@ -97,8 +97,12 @@ class ZooExperiment(Experiment):
         else:
             loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
         if self.weight_decay > 0.0:
+            # slim's l2_regularizer targets conv/fc kernels only, never norm
+            # scales or biases (slims.py:69-76) — rank>1 leaves here.
             loss = loss + self.weight_decay * sum(
-                jnp.sum(p.astype(jnp.float32) ** 2) for p in jax.tree_util.tree_leaves(params)
+                jnp.sum(p.astype(jnp.float32) ** 2)
+                for p in jax.tree_util.tree_leaves(params)
+                if jnp.ndim(p) > 1
             )
         return loss
 

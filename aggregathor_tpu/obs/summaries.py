@@ -7,8 +7,12 @@ is one JSON object per event line — trivially greppable/plottable, and
 convertible to TF events offline if ever needed.
 """
 
+import itertools
 import json
 import time
+
+
+_serial = itertools.count()
 
 
 class SummaryWriter:
@@ -19,12 +23,13 @@ class SummaryWriter:
             import os
 
             os.makedirs(directory, exist_ok=True)
-            # pid suffix: back-to-back runs in the same second must not
-            # interleave into one file
+            # pid disambiguates concurrent processes; the serial counter
+            # disambiguates back-to-back runs within one process and second.
             self.path = os.path.join(
-                directory, "%s-%d-%d.jsonl" % (run_name, int(time.time()), os.getpid())
+                directory,
+                "%s-%d-%d-%d.jsonl" % (run_name, int(time.time()), os.getpid(), next(_serial)),
             )
-            self._fd = open(self.path, "a")
+            self._fd = open(self.path, "x")
 
     def scalars(self, step, values):
         if self._fd is None:

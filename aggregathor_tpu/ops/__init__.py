@@ -1,0 +1,10 @@
+"""Kernel tiers below the jnp/XLA default.
+
+- ``ops.native`` — C++17 host library (threadpool + GAR kernels) loaded via
+  ctypes; the framework's equivalent of the reference's native op layer
+  (native/__init__.py, aggregators/deprecated_native/) for host-side
+  aggregation, oracles at scale, and environments without an accelerator.
+- ``ops.pallas_kernels`` — hand-written Pallas TPU kernels for the GAR hot
+  path (pairwise distances, coordinate-wise selection), replacing the
+  reference's CUDA/custom-op tier (native/op_krum, native/op_bulyan).
+"""

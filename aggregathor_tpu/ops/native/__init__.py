@@ -1,0 +1,200 @@
+"""Auto-built C++ host GAR library, loaded via ctypes.
+
+The framework's counterpart of the reference's self-compiling native layer:
+sources in this directory are compiled into one shared library on first
+import, with an mtime-based incremental rebuild (reference:
+native/__init__.py:190-206, aggregators/deprecated_native/__init__.py:43-68).
+The toolchain is plain ``c++ -std=c++17 -O3`` — no TF/TPU headers, because
+this tier is pure host code: the accelerator path is jnp/Pallas, and this
+library serves host-side aggregation, large-scale oracles, and CPU-only
+deployments.
+
+Public API (all take/return numpy arrays, float32 or float64, row-major):
+  ``average(g)  average_nan(g)  median(g)  averaged_median(g, f)``
+  ``pairwise_sq_distances(g)  krum(g, f, m=None)  bulyan(g, f)``
+plus ``available()`` / ``load()`` / ``build(force=...)`` and
+``num_threads()``.  Set ``AGTPU_NATIVE_CXX`` to override the compiler and
+``AGTPU_NUM_THREADS`` to bound the pool.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ("kernels.cpp", "threadpool.hpp")
+_LIBNAME = "libagtpu_host.so"
+
+_lib = None
+_load_error = None
+
+
+def _lib_path():
+    return os.path.join(_DIR, _LIBNAME)
+
+
+def _must_rebuild():
+    """True when the library is absent or older than any source (mtime check)."""
+    target = _lib_path()
+    if not os.path.exists(target):
+        return True
+    built = os.path.getmtime(target)
+    return any(os.path.getmtime(os.path.join(_DIR, src)) > built for src in _SOURCES)
+
+
+def build(force=False):
+    """Compile the shared library if stale; returns its path.
+
+    Atomic: compiles to a temp file in the same directory, then renames —
+    concurrent importers either see the old or the new complete library.
+    """
+    target = _lib_path()
+    if not force and not _must_rebuild():
+        return target
+    compiler = os.environ.get("AGTPU_NATIVE_CXX", "c++")
+    fd, tmp = tempfile.mkstemp(suffix=".so", prefix=".build-", dir=_DIR)
+    os.close(fd)
+    cmd = [
+        compiler, "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
+        "-Wall", "-Wextra",
+        os.path.join(_DIR, "kernels.cpp"),
+        "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "native build failed (%s):\n%s" % (" ".join(cmd), proc.stderr.strip())
+            )
+        os.replace(tmp, target)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return target
+
+
+def _declare(lib):
+    """Attach ctypes signatures for every exported symbol."""
+    i64 = ctypes.c_int64
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.agtpu_num_threads.restype = i64
+    lib.agtpu_num_threads.argtypes = []
+    for suffix, ptr in (("f32", f32p), ("f64", f64p)):
+        for name, extra in (
+            ("average", ()),
+            ("average_nan", ()),
+            ("median", ()),
+            ("averaged_median", (i64,)),
+            ("krum", (i64, i64)),
+            ("bulyan", (i64,)),
+        ):
+            fn = getattr(lib, "agtpu_%s_%s" % (name, suffix))
+            fn.restype = None
+            fn.argtypes = [ptr, i64, i64] + list(extra) + [ptr]
+        fn = getattr(lib, "agtpu_pairwise_sqdist_%s" % suffix)
+        fn.restype = None
+        fn.argtypes = [ptr, i64, i64, f64p]
+
+
+def load():
+    """Build if needed and load the library (cached); raises on failure."""
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    if _load_error is not None:
+        raise _load_error
+    try:
+        lib = ctypes.CDLL(build())
+        _declare(lib)
+    except Exception as exc:  # compiler missing, unsupported platform, ...
+        _load_error = RuntimeError("native GAR library unavailable: %s" % exc)
+        raise _load_error from exc
+    _lib = lib
+    return lib
+
+
+def available():
+    """True when the native library builds and loads on this host."""
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+def num_threads():
+    return int(load().agtpu_num_threads())
+
+
+# --------------------------------------------------------------------------- #
+# numpy wrappers
+
+def _prepare(grads):
+    """Contiguous 2-D float32/float64 view + (suffix, ctype) dispatch info."""
+    g = np.asarray(grads)
+    if g.ndim != 2:
+        raise ValueError("expected an (n, d) gradient matrix, got shape %r" % (g.shape,))
+    if g.dtype == np.float32:
+        suffix, ctype = "f32", ctypes.c_float
+    else:
+        g = g.astype(np.float64, copy=False)
+        suffix, ctype = "f64", ctypes.c_double
+    return np.ascontiguousarray(g), suffix, ctype
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _rowwise(name, grads, *extra):
+    lib = load()
+    g, suffix, ctype = _prepare(grads)
+    n, d = g.shape
+    out = np.empty(d, dtype=g.dtype)
+    fn = getattr(lib, "agtpu_%s_%s" % (name, suffix))
+    fn(_ptr(g, ctype), n, d, *[ctypes.c_int64(int(e)) for e in extra], _ptr(out, ctype))
+    return out
+
+
+def average(grads):
+    return _rowwise("average", grads)
+
+
+def average_nan(grads):
+    return _rowwise("average_nan", grads)
+
+
+def median(grads):
+    return _rowwise("median", grads)
+
+
+def averaged_median(grads, f):
+    return _rowwise("averaged_median", grads, f)
+
+
+def krum(grads, f, m=None):
+    n = np.asarray(grads).shape[0]
+    if m is None:
+        m = n - int(f) - 2
+    if not 1 <= int(m) <= n:
+        raise ValueError("krum selection size m=%d out of range [1, n=%d] (f=%d)" % (m, n, f))
+    return _rowwise("krum", grads, f, m)
+
+
+def bulyan(grads, f):
+    return _rowwise("bulyan", grads, f)
+
+
+def pairwise_sq_distances(grads):
+    """(n, n) float64 all-pairs squared distances (non-finite -> +inf)."""
+    lib = load()
+    g, suffix, ctype = _prepare(grads)
+    n, d = g.shape
+    out = np.empty((n, n), dtype=np.float64)
+    fn = getattr(lib, "agtpu_pairwise_sqdist_%s" % suffix)
+    fn(_ptr(g, ctype), n, d, _ptr(out, ctypes.c_double))
+    return out

@@ -1,0 +1,114 @@
+"""Native C++ tier: cross-check every rule against the numpy oracle.
+
+The reference's correctness strategy for its native kernels is redundant
+independent implementations (SURVEY.md §4 point 3); here the C++ library
+(ops/native) must agree with the numpy oracle (gars/oracle.py) on random,
+NaN-contaminated, and adversarial inputs — and the registered ``*-native``
+GARs must agree with their jnp-tier counterparts.
+"""
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu.gars import oracle
+from aggregathor_tpu.ops import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain on this host"
+)
+
+
+def _rand(n, d, seed, nan_frac=0.0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    g = rng.normal(size=(n, d)).astype(dtype)
+    if nan_frac:
+        mask = rng.random(size=g.shape) < nan_frac
+        g[mask] = np.nan
+    return g
+
+
+CASES = [
+    dict(n=7, d=33, seed=0, nan_frac=0.0),
+    dict(n=8, d=65, seed=1, nan_frac=0.1),
+    dict(n=15, d=17, seed=2, nan_frac=0.0),
+    dict(n=15, d=17, seed=3, nan_frac=0.3),
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_coordinate_rules_match_oracle(case):
+    g = _rand(case["n"], case["d"], case["seed"], case["nan_frac"])
+    f = 2
+    np.testing.assert_allclose(native.average(g), oracle.average(g), rtol=1e-12)
+    np.testing.assert_allclose(native.average_nan(g), oracle.average_nan(g), rtol=1e-12)
+    np.testing.assert_allclose(native.median(g), oracle.median(g), rtol=1e-12)
+    np.testing.assert_allclose(
+        native.averaged_median(g, f), oracle.averaged_median(g, f), rtol=1e-12
+    )
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_distance_rules_match_oracle(case):
+    g = _rand(case["n"], case["d"], case["seed"], case["nan_frac"])
+    n, f = case["n"], 2
+    np.testing.assert_allclose(
+        native.pairwise_sq_distances(g), oracle._pairwise_sq_distances(g), rtol=1e-10
+    )
+    np.testing.assert_allclose(native.krum(g, f), oracle.krum(g, f), rtol=1e-10)
+    if n - 4 * f - 2 >= 1:  # Bulyan feasibility: b = n - 4f - 2 >= 1
+        np.testing.assert_allclose(native.bulyan(g, f), oracle.bulyan(g, f), rtol=1e-10)
+
+
+def test_float32_dispatch():
+    g = _rand(9, 41, 7, dtype=np.float32)
+    out = native.krum(g, 2)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, oracle.krum(g, 2), rtol=1e-5)
+
+
+def test_byzantine_outlier_rejected():
+    """A huge-norm attacker row must not be selected by krum/bulyan."""
+    g = _rand(15, 29, 11)
+    g[0] = 1e8
+    f = 2
+    honest_mean = np.mean(g[1:], axis=0)
+    for out in (native.krum(g, f), native.bulyan(g, f)):
+        assert np.all(np.isfinite(out))
+        assert np.linalg.norm(out - honest_mean) < np.linalg.norm(g[0] - honest_mean) * 1e-3
+
+
+def test_registered_native_tier_matches_jnp_tier():
+    import jax.numpy as jnp
+
+    from aggregathor_tpu import gars
+
+    g = _rand(11, 23, 13, dtype=np.float32)
+    for name in ("average", "median", "averaged-median", "krum", "bulyan"):
+        a = gars.instantiate(name, 11, 2).aggregate(jnp.asarray(g))
+        b = gars.instantiate(name + "-native", 11, 2).aggregate(g)
+        np.testing.assert_allclose(np.asarray(a), b, rtol=2e-4, atol=1e-6)
+
+
+def test_native_tier_inside_jit():
+    """pure_callback bridge: the native dense path composes with jax.jit."""
+    import jax
+    import jax.numpy as jnp
+
+    from aggregathor_tpu import gars
+
+    g = _rand(9, 19, 17, dtype=np.float32)
+    rule = gars.instantiate("median-native", 9, 2)
+    out = jax.jit(rule.aggregate)(jnp.asarray(g))
+    np.testing.assert_allclose(np.asarray(out), oracle.median(g), rtol=1e-5)
+
+
+def test_threadpool_reports_workers():
+    assert native.num_threads() >= 1
+
+
+def test_rebuild_is_incremental(tmp_path):
+    """build() is a no-op when the library is newer than the sources."""
+    path = native.build()
+    mtime = native.os.path.getmtime(path)
+    assert native.build() == path
+    assert native.os.path.getmtime(path) == mtime
