@@ -19,8 +19,9 @@ from .common import nonfinite_to_inf
 def averaged_median_columns(block, nb_rows, beta):
     """Per-column averaged-median over the first axis: median, then mean of
     the ``beta`` entries closest to it.  Shared with Bulyan's final phase."""
-    clean = nonfinite_to_inf(block)
-    median = jnp.sort(clean, axis=0)[nb_rows // 2]
+    from .median import median_columns
+
+    median = median_columns(block, nb_rows)
     deviation = nonfinite_to_inf(jnp.abs(block - median[None, :]))
     order = jnp.argsort(deviation, axis=0)[:beta]
     closest = jnp.take_along_axis(block, order, axis=0)

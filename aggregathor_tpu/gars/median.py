@@ -12,12 +12,24 @@ from . import GAR, register
 from .common import nonfinite_to_inf
 
 
+def median_columns(block, nb_rows):
+    """(d,) per-column upper median, non-finite ordered last.
+
+    Returns the *original* value at the median slot (possibly NaN/inf, the
+    reference returns whatever ``nth_element`` lands on — native.cpp:678-704)
+    so every tier (jnp/oracle/native/pallas) agrees bit-for-bit on which
+    poison value reaches the optimizer.  jnp.argsort is stable, matching the
+    oracle's tie-breaking.
+    """
+    order = jnp.argsort(nonfinite_to_inf(block), axis=0)
+    return jnp.take_along_axis(block, order[nb_rows // 2][None, :], axis=0)[0]
+
+
 class MedianGAR(GAR):
     coordinate_wise = True
 
     def aggregate_block(self, block, dist2=None):
-        ordered = jnp.sort(nonfinite_to_inf(block), axis=0)
-        return ordered[self.nb_workers // 2]
+        return median_columns(block, self.nb_workers)
 
 
 register("median", MedianGAR)

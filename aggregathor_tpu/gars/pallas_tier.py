@@ -1,0 +1,61 @@
+"""Pallas-tier GAR registrations (``*-pallas``).
+
+Counterpart of the reference's ``-co`` custom-op tier (aggregators/krum.py:
+142-158, bulyan.py:68-84), re-targeted at the TPU: the coordinate-wise
+selection and the pairwise-distance streaming run as hand-written Pallas
+kernels (ops/pallas_kernels.py) instead of C++/CUDA.  Off-TPU the kernels
+execute in interpreter mode, so the tier is usable (slowly) everywhere and
+the CPU test suite exercises the exact kernel code path.
+
+The distance-based rules use the Pallas distance kernel on the dense path;
+their O(n²) scoring stays jnp (it is tiny and replicated).  Blockwise, the
+coordinate kernels apply per column block unchanged.
+"""
+
+from . import register
+from .average_nan import AverageNaNGAR
+from .averaged_median import AveragedMedianGAR
+from .bulyan import BulyanGAR
+from .krum import KrumGAR
+from .median import MedianGAR
+from .common import select_combine
+from ..ops import pallas_kernels as pk
+
+
+class PallasMedianGAR(MedianGAR):
+    def aggregate_block(self, block, dist2=None):
+        return pk.coordinate_median(block)
+
+
+class PallasAveragedMedianGAR(AveragedMedianGAR):
+    def aggregate_block(self, block, dist2=None):
+        return pk.coordinate_averaged_median(block, self.beta)
+
+
+class PallasAverageNaNGAR(AverageNaNGAR):
+    def aggregate_block(self, block, dist2=None):
+        return pk.average_nan_columns(block)
+
+
+class PallasKrumGAR(KrumGAR):
+    def aggregate(self, grads):
+        dist2 = pk.pairwise_sq_distances(grads)
+        return self.aggregate_block(grads, dist2)
+
+
+class PallasBulyanGAR(BulyanGAR):
+    def aggregate(self, grads):
+        dist2 = pk.pairwise_sq_distances(grads)
+        return self.aggregate_block(grads, dist2)
+
+    def aggregate_block(self, block, dist2=None):
+        assert dist2 is not None, "bulyan requires the pairwise distance matrix"
+        selections = select_combine(self.selection_weights(dist2), block)
+        return pk.coordinate_averaged_median(selections, self.nb_closest)
+
+
+register("median-pallas", PallasMedianGAR)
+register("averaged-median-pallas", PallasAveragedMedianGAR)
+register("average-nan-pallas", PallasAverageNaNGAR)
+register("krum-pallas", PallasKrumGAR)
+register("bulyan-pallas", PallasBulyanGAR)

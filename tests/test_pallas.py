@@ -1,0 +1,129 @@
+"""Pallas kernel tier: interpret-mode equivalence with the numpy oracle.
+
+The CPU suite runs every kernel in interpreter mode — the same kernel body
+that compiles on TPU — and cross-checks against gars/oracle.py, the same
+ground truth used by the jnp and native tiers (SURVEY.md §4 point 3).
+"""
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu.gars import oracle
+from aggregathor_tpu.ops import pallas_kernels as pk
+
+
+def _rand(n, d, seed, nan_frac=0.0):
+    rng = np.random.default_rng(seed)
+    g = rng.normal(size=(n, d)).astype(np.float32)
+    if nan_frac:
+        g[rng.random(size=g.shape) < nan_frac] = np.nan
+    return g
+
+
+CASES = [
+    dict(n=8, d=40, seed=0, nan_frac=0.0),
+    dict(n=8, d=300, seed=1, nan_frac=0.1),
+    dict(n=15, d=130, seed=2, nan_frac=0.0),
+    dict(n=16, d=7, seed=3, nan_frac=0.2),
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_coordinate_median(case):
+    g = _rand(**case)
+    out = np.asarray(pk.coordinate_median(g, block_d=128))
+    np.testing.assert_allclose(out, oracle.median(g), rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_coordinate_averaged_median(case):
+    g = _rand(**case)
+    f = 2
+    out = np.asarray(pk.coordinate_averaged_median(g, g.shape[0] - f, block_d=128))
+    np.testing.assert_allclose(out, oracle.averaged_median(g, f), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_average_nan_columns(case):
+    g = _rand(**case)
+    out = np.asarray(pk.average_nan_columns(g, block_d=128))
+    np.testing.assert_allclose(out, oracle.average_nan(g), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("use_mxu", [False, True])
+def test_pairwise_distances(use_mxu):
+    g = _rand(12, 500, 7)
+    out = np.array(pk.pairwise_sq_distances(g, block_d=128, use_mxu=use_mxu))
+    ref = oracle._pairwise_sq_distances(g.astype(np.float64))
+    np.fill_diagonal(out, 0.0)  # oracle pins the diagonal; kernels leave ~0
+    tol = 1e-4 if use_mxu else 1e-5
+    np.testing.assert_allclose(out, ref, rtol=tol, atol=tol)
+
+
+def test_pairwise_distances_nan_row():
+    g = _rand(8, 64, 9)
+    g[3, 10] = np.nan
+    out = np.asarray(pk.pairwise_sq_distances(g, block_d=128, use_mxu=False))
+    assert np.all(np.isnan(out[3, :3])) and np.all(np.isnan(out[:3, 3]))
+    finite_mask = np.ones((8, 8), bool)
+    finite_mask[3, :] = finite_mask[:, 3] = False
+    assert np.all(np.isfinite(out[finite_mask]))
+
+
+@pytest.mark.parametrize(
+    "name,f",
+    [("median-pallas", 2), ("averaged-median-pallas", 2), ("average-nan-pallas", 2),
+     ("krum-pallas", 2), ("bulyan-pallas", 1)],
+)
+def test_registered_pallas_tier_matches_jnp(name, f):
+    import jax.numpy as jnp
+
+    from aggregathor_tpu import gars
+
+    n = 11
+    g = _rand(n, 90, 21, nan_frac=0.05)
+    base = name.replace("-pallas", "")
+    a = np.asarray(gars.instantiate(base, n, f).aggregate(jnp.asarray(g)))
+    b = np.asarray(gars.instantiate(name, n, f).aggregate(jnp.asarray(g)))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, equal_nan=True)
+
+
+def test_majority_nan_column_tiers_agree():
+    """Median-slot non-finite: jnp, pallas, and oracle return the same value."""
+    import jax.numpy as jnp
+
+    from aggregathor_tpu import gars
+
+    g = _rand(5, 12, 5)
+    g[0:3, 4] = np.nan  # majority-NaN column: median slot is NaN
+    g[0:4, 7] = np.inf  # majority-inf column: median slot is +inf
+    ref = oracle.median(g)
+    jnp_out = np.asarray(gars.instantiate("median", 5, 1).aggregate(jnp.asarray(g)))
+    pls_out = np.asarray(gars.instantiate("median-pallas", 5, 1).aggregate(jnp.asarray(g)))
+    np.testing.assert_array_equal(np.isnan(jnp_out), np.isnan(ref))
+    np.testing.assert_array_equal(np.isnan(pls_out), np.isnan(ref))
+    mask = ~np.isnan(ref)
+    np.testing.assert_allclose(jnp_out[mask], ref[mask], rtol=1e-6)
+    np.testing.assert_allclose(pls_out[mask], ref[mask], rtol=1e-6)
+
+
+def test_gram_distance_nan_poisons_only_its_rows():
+    """Majority-NaN column must not poison the whole Gram distance matrix."""
+    g = _rand(12, 64, 6)
+    g[0:7, 10] = np.nan
+    out = np.array(pk.pairwise_sq_distances(g, block_d=128, use_mxu=True))
+    clean = np.ix_(range(7, 12), range(7, 12))
+    assert np.all(np.isfinite(out[clean]))
+    assert np.all(np.isnan(out[0, 7:]))
+
+
+def test_pallas_krum_rejects_outlier():
+    g = _rand(12, 200, 33)
+    g[0] = 1e6
+    from aggregathor_tpu import gars
+
+    out = np.asarray(gars.instantiate("krum-pallas", 12, 2).aggregate(g))
+    honest = np.mean(g[1:], axis=0)
+    # The selected-subset mean differs from the full honest mean by O(1);
+    # what matters is the attacker (distance ~1e6·sqrt(d)) was excluded.
+    assert np.linalg.norm(out - honest) < 1e-3 * np.linalg.norm(g[0] - honest)
