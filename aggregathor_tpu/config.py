@@ -29,4 +29,8 @@ thread_idle_delay = 1.0
 
 # Mesh axis names used throughout the parallel engine
 worker_axis = "worker"   # data-parallel Byzantine-worker axis
-model_axis = "model"     # optional tensor-parallel axis inside each worker
+pipe_axis = "pipe"       # pipeline-parallel stage axis inside each worker
+model_axis = "model"     # tensor-parallel axis inside each stage; sequence
+                         # parallelism (ring attention / Megatron-SP gathers)
+                         # and expert parallelism (MoE all_to_all) ride this
+                         # same axis in different ops, the standard TPU layout

@@ -17,29 +17,61 @@ import jax
 from .. import config
 
 worker_axis = config.worker_axis
+pipe_axis = config.pipe_axis
 model_axis = config.model_axis
 
 
-def make_mesh(nb_workers=None, model_parallelism=1, devices=None):
-    """Build a Mesh with axes ``(worker, model)``.
+def make_mesh(nb_workers=None, model_parallelism=1, pipeline_parallelism=1, devices=None):
+    """Build a Mesh with axes ``(worker, pipe, model)``.
 
     Args:
       nb_workers: size of the worker axis; defaults to all devices divided by
-        ``model_parallelism``.
-      model_parallelism: size of the tensor-parallel axis inside each worker.
+        ``model_parallelism * pipeline_parallelism``.
+      model_parallelism: size of the tensor-parallel axis inside each stage
+        (sequence and expert parallelism ride this axis too).
+      pipeline_parallelism: number of pipeline stages inside each worker.
       devices: explicit device list (defaults to ``jax.devices()``).
     Returns:
-      ``jax.sharding.Mesh`` with named axes (worker, model).
+      ``jax.sharding.Mesh`` with named axes (worker, pipe, model).
     """
     devices = list(devices if devices is not None else jax.devices())
+    per_worker = model_parallelism * pipeline_parallelism
     if nb_workers is None:
-        nb_workers = len(devices) // model_parallelism
-    need = nb_workers * model_parallelism
+        nb_workers = len(devices) // per_worker
+    need = nb_workers * per_worker
     if need > len(devices):
         from ..utils import UserException
 
         raise UserException(
-            "Mesh needs %d devices (%d workers x %d model) but only %d are available"
-            % (need, nb_workers, model_parallelism, len(devices))
+            "Mesh needs %d devices (%d workers x %d pipe x %d model) but only %d are available"
+            % (need, nb_workers, pipeline_parallelism, model_parallelism, len(devices))
         )
-    return jax.make_mesh((nb_workers, model_parallelism), (worker_axis, model_axis), devices=devices[:need])
+    return jax.make_mesh(
+        (nb_workers, pipeline_parallelism, model_parallelism),
+        (worker_axis, pipe_axis, model_axis),
+        devices=devices[:need],
+    )
+
+
+def factor_devices(n_devices):
+    """Split ``n_devices`` into (workers, pipe, model) axis sizes.
+
+    Used by the multi-chip dry run to always exercise every parallelism axis
+    the device count allows: the odd part widens the worker axis, then the
+    factors of two go round-robin to the axes that are still 1 — so even
+    counts always light up at least a second axis. 8 -> (2, 2, 2),
+    4 -> (2, 2, 1), 6 -> (3, 2, 1), 12 -> (3, 2, 2), 2 -> (2, 1, 1).
+    """
+    sizes = [1, 1, 1]
+    remaining = int(n_devices)
+    while remaining % 2 == 0:
+        remaining //= 2
+        sizes[0] *= 2
+    odd, twos = remaining, sizes[0]
+    sizes = [odd, 1, 1]
+    slot = 1 if odd > 1 else 0
+    while twos > 1:
+        sizes[slot] *= 2
+        twos //= 2
+        slot = (slot + 1) % 3
+    return tuple(sizes)

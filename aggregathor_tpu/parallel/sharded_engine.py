@@ -1,0 +1,285 @@
+"""Fully-sharded robust training engine: logical worker = submesh.
+
+The flat ``RobustEngine`` (engine.py) maps one Byzantine worker to one device
+slot and keeps parameters replicated — the right shape for the reference's
+CNN-scale experiments. This engine is the scale-out design for models that do
+not fit one chip: the mesh is (worker, pipe, model), each *logical worker*
+owns a (pipe x model) submesh running its own pipelined + tensor/sequence/
+expert-parallel replica (models/transformer.py), and robust aggregation runs
+directly on the *sharded* gradients:
+
+1.  ``loss_fn`` (built for shard_map, e.g. ``make_pipeline_loss``) computes
+    each worker group's loss with collectives over (pipe, model) only; grads
+    arrive naturally sharded: stage dim over ``pipe``, MLP/expert weights
+    over ``model``.
+2.  Gradients of *replicated* leaves are completed with a psum over exactly
+    the in-group axes the leaf does not shard (its PartitionSpec says which).
+3.  Per-worker perturbations (attack / lossy link) apply to the worker's own
+    local shard — the same honest threat model as the flat engine, just
+    expressed per-shard (a Byzantine worker corrupts all of its shards).
+4.  **Per-bucket robust aggregation**: for every parameter leaf (split per
+    layer when the leaf carries the scanned layer dim), one
+    ``all_gather`` over the ``worker`` axis yields the (n, d_bucket) row
+    matrix *for this shard only* — the full (n, d) matrix never exists
+    anywhere. Distance-based rules complete their (n, n) matrix with a psum
+    over ``model`` when the leaf's coordinates are sharded there. This is
+    per-layer Krum/Bulyan (BASELINE.md config 5) by construction.
+5.  With ``granularity='global'`` the per-leaf partial distances are instead
+    accumulated (scaled by 1/replication so the psum is exact) into one
+    global (n, n) matrix — the reference's whole-vector selection semantics
+    (graph.py:144-168 flattens everything into a single vector) at sharded
+    memory cost.
+6.  The aggregated shard is already laid out like the parameter, so the
+    optax update is local; worker-axis determinism (identical all_gather
+    results) keeps every worker group's parameters bit-identical — the PS
+    invariant, shard by shard.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import config
+from ..core.train_state import TrainState
+from ..gars.common import centered_gram_sq_distances
+from ..utils import UserException
+from .mesh import model_axis, pipe_axis, worker_axis
+
+_IN_GROUP_AXES = (pipe_axis, model_axis)
+
+
+def _is_spec(x):
+    return x is None or isinstance(x, P)
+
+
+def _spec_axis_names(spec):
+    names = set()
+    for entry in spec or ():
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.update(entry)
+        else:
+            names.add(entry)
+    return names
+
+
+def _replication_axes(spec):
+    """In-group mesh axes over which a leaf with this spec is replicated."""
+    names = _spec_axis_names(spec)
+    return tuple(a for a in _IN_GROUP_AXES if a not in names)
+
+
+class ShardedRobustEngine:
+    """Robust Byzantine-DP over logical workers that each span a submesh."""
+
+    def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer"):
+        self.mesh = mesh
+        self.gar = gar
+        self.nb_workers = mesh.shape[worker_axis]
+        self.nb_real_byz = int(nb_real_byz)
+        self.attack = attack
+        self.lossy_link = lossy_link
+        if granularity not in ("layer", "leaf", "global"):
+            raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
+        self.granularity = granularity
+        if gar.nb_workers != self.nb_workers:
+            raise UserException(
+                "GAR was built for n=%d but the mesh worker axis is %d" % (gar.nb_workers, self.nb_workers)
+            )
+        if self.nb_real_byz > self.nb_workers:
+            raise UserException("More real Byzantine workers than workers")
+        if attack is not None and self.nb_real_byz == 0:
+            raise UserException("An attack needs nb_real_byz > 0 to have anyone to run it")
+
+    # ------------------------------------------------------------------ #
+
+    def init_state(self, init_fn, specs, tx, seed=0):
+        """Create the sharded TrainState.
+
+        Args:
+          init_fn: key -> global parameter pytree (e.g. transformer.init_params).
+          specs:   matching pytree of PartitionSpecs (transformer.param_specs).
+          tx:      optax GradientTransformation.
+        """
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_is_spec)
+        params = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(seed))
+        with jax.set_mesh(self.mesh):  # optimizers that allocate (adam, ...) need the mesh
+            opt_state = jax.jit(tx.init)(params)  # shardings propagate from params
+        rep = NamedSharding(self.mesh, P())
+        return TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            params=params,
+            opt_state=opt_state,
+            rng=jax.device_put(jax.random.PRNGKey(seed), rep),
+        )
+
+    def shard_batch(self, batch):
+        """Device_put a worker-major batch pytree (leading dim = nb_workers)."""
+        return jax.device_put(batch, NamedSharding(self.mesh, P(worker_axis)))
+
+    # ------------------------------------------------------------------ #
+
+    def _perturb(self, g, spec, key, widx):
+        """Worker-local attack + lossy link on this worker's own shard."""
+        flat = g.reshape(-1)
+        if self.attack is not None and not self.attack.omniscient:
+            forged = self.attack.apply_local(flat, jax.random.fold_in(key, 1))
+            flat = jnp.where(widx < self.nb_real_byz, forged, flat)
+        if self.lossy_link is not None:
+            flat = self.lossy_link.apply(flat, jax.random.fold_in(key, 2), widx)
+        return flat.reshape(g.shape)
+
+    def _leaf_buckets(self, g, spec):
+        """Reshape a local leaf to (n_buckets, d_bucket) rows-to-be."""
+        if self.granularity == "layer" and spec is not None and len(spec) >= 2 and spec[0] == pipe_axis:
+            # Stage-stacked leaf (local stage dim 1, then the scanned layer
+            # dim): one bucket per layer.
+            return g.reshape(g.shape[0] * g.shape[1], -1)
+        return g.reshape(1, -1)
+
+    def _gather_rows(self, buckets):
+        """(Lb, d) local buckets -> (Lb, n, d) per-worker rows via all_gather."""
+        rows = jax.lax.all_gather(buckets, worker_axis)  # (n, Lb, d)
+        return jnp.swapaxes(rows, 0, 1)
+
+    def _apply_omniscient(self, rows, key):
+        if self.attack is None or not self.attack.omniscient:
+            return rows
+        byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
+        return jax.vmap(lambda m: self.attack.apply_matrix(m, byz_mask, key))(rows)
+
+    def _bucket_distances(self, rows, spec):
+        """(Lb, n, n) squared distances for this leaf's buckets (exact)."""
+        partial = jax.vmap(centered_gram_sq_distances)(rows.astype(jnp.float32))
+        if model_axis in _spec_axis_names(spec):
+            partial = jax.lax.psum(partial, model_axis)
+        return jnp.maximum(partial, 0.0)
+
+    def _replication_scale(self, spec):
+        scale = 1.0
+        for a in _replication_axes(spec):
+            scale /= self.mesh.shape[a]
+        return scale
+
+    # ------------------------------------------------------------------ #
+
+    def build_step(self, loss_fn, tx, state):
+        """Build the jitted sharded robust training step.
+
+        Args:
+          loss_fn: (params_local, worker_batch) -> scalar *local partial*
+            loss, written for shard_map (collectives over pipe/model
+            allowed); the sum over the worker group's devices must equal the
+            worker's batch loss (see models/transformer.make_pipeline_loss —
+            in-loss final psums would corrupt the gradients).
+          tx:      optax GradientTransformation.
+          state:   the TrainState from ``init_state`` (used for its layout).
+        Returns:
+          step(state, batch) -> (state, metrics); ``batch`` leaves lead with
+          the worker dim.
+        """
+        state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        param_specs = state_specs.params
+        gar = self.gar
+
+        def body(state, batch):
+            batch = jax.tree.map(lambda x: x[0], batch)  # strip worker block dim
+            key = jax.random.fold_in(state.rng, state.step)
+            widx = jax.lax.axis_index(worker_axis)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+
+            g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+            s_leaves = treedef.flatten_up_to(param_specs)
+
+            # (2) complete replicated-leaf grads within the worker group
+            g_leaves = [
+                jax.lax.psum(g, _replication_axes(s)) if _replication_axes(s) else g
+                for g, s in zip(g_leaves, s_leaves)
+            ]
+            # (3) per-worker perturbation of this worker's own shards
+            g_leaves = [
+                self._perturb(g, s, jax.random.fold_in(jax.random.fold_in(key, widx), i), widx)
+                for i, (g, s) in enumerate(zip(g_leaves, s_leaves))
+            ]
+
+            # (4/5) per-bucket robust aggregation over the worker axis
+            all_rows = []
+            for i, (g, s) in enumerate(zip(g_leaves, s_leaves)):
+                rows = self._gather_rows(self._leaf_buckets(g, s))
+                rows = self._apply_omniscient(rows, jax.random.fold_in(key, 10_000 + i))
+                all_rows.append(rows)
+
+            global_dist2 = None
+            if self.granularity == "global" and gar.needs_distances:
+                acc = jnp.zeros((self.nb_workers, self.nb_workers), jnp.float32)
+                for rows, s in zip(all_rows, s_leaves):
+                    partial = centered_gram_sq_distances(
+                        rows.reshape(self.nb_workers, -1).astype(jnp.float32)
+                    )
+                    acc = acc + partial * self._replication_scale(s)
+                global_dist2 = jnp.maximum(jax.lax.psum(acc, _IN_GROUP_AXES), 0.0)
+
+            agg_leaves = []
+            for rows, g, s in zip(all_rows, g_leaves, s_leaves):
+                if gar.needs_distances:
+                    if global_dist2 is not None:
+                        dist2 = jnp.broadcast_to(global_dist2, rows.shape[:1] + global_dist2.shape)
+                    else:
+                        dist2 = self._bucket_distances(rows, s)
+                    agg = jax.vmap(gar.aggregate_block)(rows, dist2)
+                else:
+                    agg = jax.vmap(lambda r: gar.aggregate_block(r, None))(rows)
+                agg_leaves.append(agg.reshape(g.shape).astype(g.dtype))
+            agg_tree = jax.tree_util.tree_unflatten(treedef, agg_leaves)
+
+            # (6) local optax update — layouts already match the parameters
+            updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+
+            sq = jnp.float32(0.0)
+            for agg, s in zip(agg_leaves, s_leaves):
+                sq = sq + jnp.sum(jnp.square(agg.astype(jnp.float32))) * self._replication_scale(s)
+            grad_norm = jnp.sqrt(jax.lax.psum(sq, _IN_GROUP_AXES))
+
+            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+            metrics = {
+                # loss is a local partial: sum the worker group, then workers
+                "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),
+                "grad_norm": grad_norm,
+            }
+            return new_state, metrics
+
+        sharded = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(state_specs, P(worker_axis)),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def build_eval(self, loss_fn, state):
+        """Jitted eval: mean of the sharded loss over the worker axis.
+
+        Built once from ``state``'s layout (like ``build_step``) so repeated
+        cadenced evals hit the jit cache instead of recompiling.
+        """
+        specs = jax.tree.map(lambda a: a.sharding.spec, state)
+
+        def body(state, batch):
+            batch = jax.tree.map(lambda x: x[0], batch)
+            loss = loss_fn(state.params, batch)  # local partial
+            return jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)) / self.nb_workers
+
+        sharded = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(specs, P(worker_axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)

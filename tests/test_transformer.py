@@ -1,0 +1,157 @@
+"""Transformer family + sharded engine tests.
+
+Strategy (SURVEY.md §4): redundant implementations as cross-checks — the
+collective-free dense path is the oracle for the pipelined/ring/TP path, and
+a manual numpy SGD step is the oracle for the sharded engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from aggregathor_tpu import config, gars
+from aggregathor_tpu.models import transformer as tfm
+from aggregathor_tpu.parallel.mesh import factor_devices, make_mesh
+from aggregathor_tpu.parallel.sharded_engine import ShardedRobustEngine
+
+CFG = tfm.TransformerConfig(vocab_size=17, d_model=16, n_heads=2, n_layers=4)
+
+
+def _merge_stages(params):
+    """(S, Lp, ...) stage-stacked leaves -> (1, S*Lp, ...) single-stage layout."""
+    out = {}
+    for k, v in params.items():
+        if k in ("embed", "unembed", "final_norm"):
+            out[k] = v
+        else:
+            out[k] = np.asarray(v).reshape((1, v.shape[0] * v.shape[1]) + v.shape[2:])
+    return out
+
+
+def _batch(rng, nb_workers, bsz=4, seq=16, vocab=17):
+    return {
+        "tokens": rng.integers(0, vocab, size=(nb_workers, bsz, seq)).astype(np.int32),
+        "targets": rng.integers(0, vocab, size=(nb_workers, bsz, seq)).astype(np.int32),
+    }
+
+
+def test_factor_devices():
+    assert factor_devices(8) == (2, 2, 2)
+    assert factor_devices(4) == (2, 2, 1)
+    assert factor_devices(2) == (2, 1, 1)
+    assert factor_devices(1) == (1, 1, 1)
+    w, p, m = factor_devices(12)
+    assert w * p * m == 12
+
+
+def test_ring_attention_matches_dense(rng):
+    b, s, h, dh = 2, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, dh)), jnp.float32) for _ in range(3))
+    dense = tfm.ring_attention(q, k, v, jnp.arange(s), axis=None)
+
+    mesh = jax.make_mesh((4,), (config.model_axis,))
+
+    def body(q, k, v):
+        sb = q.shape[1]
+        pos = jax.lax.axis_index(config.model_axis) * sb + jnp.arange(sb)
+        return tfm.ring_attention(q, k, v, pos, axis=config.model_axis)
+
+    spec = P(None, config.model_axis, None, None)
+    ringed = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_loss_matches_dense(rng):
+    params = tfm.init_params(CFG, jax.random.PRNGKey(3), n_stages=2)
+    batch = jax.tree.map(lambda x: jnp.asarray(x[0]), _batch(rng, 1))
+    dense = tfm.loss_dense(_merge_stages(params), batch, CFG)
+
+    mesh = make_mesh(nb_workers=2, model_parallelism=2, pipeline_parallelism=2)
+    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=2, microbatches=2)
+
+    def body(p, b):  # local partials sum to the batch loss
+        return jax.lax.psum(loss_fn(p, b), (config.pipe_axis, config.model_axis))
+
+    sharded = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(tfm.param_specs(CFG), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    piped = sharded(params, batch)
+    np.testing.assert_allclose(float(piped), float(dense), rtol=1e-5)
+
+
+def test_sharded_engine_average_matches_manual_sgd(rng):
+    w, pp, tp = 2, 2, 2
+    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+    gar = gars.instantiate("average", w, 0)
+    eng = ShardedRobustEngine(mesh, gar, granularity="global")
+    lr = 0.1
+    tx = optax.sgd(lr)
+    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+    params0 = jax.device_get(state.params)
+    batch = _batch(rng, w)
+    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2)
+    step = eng.build_step(loss_fn, tx, state)
+    state, metrics = step(state, eng.shard_batch(batch))
+    got = jax.device_get(state.params)
+
+    # Oracle: dense per-worker grads, averaged, one SGD step
+    dense0 = _merge_stages(params0)
+    grads = [
+        jax.grad(lambda p, b: tfm.loss_dense(p, b, CFG))(dense0, jax.tree.map(lambda x: jnp.asarray(x[i]), batch))
+        for i in range(w)
+    ]
+    mean = jax.tree.map(lambda *g: sum(np.asarray(x) for x in g) / w, *grads)
+    want = jax.tree.map(lambda p, g: np.asarray(p) - lr * g, dense0, mean)
+    for k in ("wq", "w_down", "embed", "unembed", "final_norm"):
+        np.testing.assert_allclose(
+            np.asarray(_merge_stages(got)[k]), np.asarray(want[k]), rtol=5e-4, atol=1e-5, err_msg=k
+        )
+
+
+@pytest.mark.parametrize("granularity", ["layer", "global"])
+def test_per_layer_krum_under_attack_converges(rng, granularity):
+    from aggregathor_tpu.parallel.attacks import instantiate as make_attack
+
+    w, pp, tp = 4, 2, 1
+    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+    gar = gars.instantiate("krum", w, 1)
+    eng = ShardedRobustEngine(
+        mesh, gar, nb_real_byz=1, attack=make_attack("signflip", w, 1), granularity=granularity
+    )
+    tx = optax.sgd(0.05)
+    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2)
+    step = eng.build_step(loss_fn, tx, state)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+        losses.append(float(metrics["total_loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_dense_forward(rng):
+    cfg = tfm.TransformerConfig(vocab_size=17, d_model=16, n_heads=2, n_layers=2, n_experts=4)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0), n_stages=1)
+    tokens = jnp.asarray(rng.integers(0, 17, size=(2, 16)), jnp.int32)
+    logits, aux = tfm.forward_dense(params, tokens, cfg)
+    assert logits.shape == (2, 16, 17)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    assert float(aux) > 0.0
+
+
+def test_transformer_experiment_registered():
+    from aggregathor_tpu import models
+
+    assert "transformer" in models.itemize()
