@@ -1,0 +1,80 @@
+"""Classic small nets: LeNet, CifarNet, AlexNet v2.
+
+Capability parity with the reference's slim nets_factory entries ``lenet``,
+``cifarnet``, ``alexnet_v2`` (external/slim/nets/nets_factory.py:39-60) —
+the small-image workhorses of the slim zoo, written fresh as flax modules
+(same conventions as resnet.py: NHWC, mixed precision via ``dtype``,
+float32 logits).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import resize_min
+
+
+class LeNet(nn.Module):
+    """LeNet-5-style: 2x (conv + maxpool) -> 1024 dense -> logits."""
+
+    classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = x.astype(d)
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME", dtype=d, name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", dtype=d, name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(1024, dtype=d, name="fc3")(x))
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+
+
+class CifarNet(nn.Module):
+    """slim cifarnet shape: 2x (conv5x5-64 + pool + norm) -> 384 -> 192 -> logits."""
+
+    classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = x.astype(d)
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", dtype=d, name="conv1")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = nn.LayerNorm(dtype=d, name="norm1")(x)
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME", dtype=d, name="conv2")(x))
+        x = nn.LayerNorm(dtype=d, name="norm2")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(384, dtype=d, name="fc3")(x))
+        x = nn.relu(nn.Dense(192, dtype=d, name="fc4")(x))
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+
+
+class AlexNetV2(nn.Module):
+    """slim alexnet_v2: 5 convs + 2 fully-connected-as-conv heads."""
+
+    classes: int = 1000
+    dense_units: int = 4096
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding="SAME", dtype=d, name="conv1")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(192, (5, 5), padding="SAME", dtype=d, name="conv2")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(384, (3, 3), padding="SAME", dtype=d, name="conv3")(x))
+        x = nn.relu(nn.Conv(384, (3, 3), padding="SAME", dtype=d, name="conv4")(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding="SAME", dtype=d, name="conv5")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = jnp.mean(x, axis=(1, 2))  # spatial pool replaces the 6x6 VALID fc
+        x = nn.relu(nn.Dense(self.dense_units, dtype=d, name="fc6")(x))
+        x = nn.relu(nn.Dense(self.dense_units, dtype=d, name="fc7")(x))
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x.astype(jnp.float32))

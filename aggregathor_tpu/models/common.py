@@ -1,0 +1,25 @@
+"""Shared building blocks for the image-model families."""
+
+import flax.linen as nn
+import jax
+
+
+def group_norm(x, name, dtype):
+    """GroupNorm with the largest group count <= 32 that divides the channels.
+
+    Stateless BatchNorm replacement — see models/resnet.py's docstring for why
+    the Byzantine-DP setting rules out mutable batch statistics.
+    """
+    groups = min(32, x.shape[-1])
+    while x.shape[-1] % groups:
+        groups -= 1
+    return nn.GroupNorm(num_groups=groups, dtype=dtype, name=name)(x)
+
+
+def resize_min(x, min_size):
+    """Bilinearly upsample NHWC images below ``min_size`` (e.g. CIFAR 32x32
+    into an ImageNet-shaped stem), instead of failing like slim's
+    VALID-padded stems do on small inputs."""
+    if x.shape[1] < min_size or x.shape[2] < min_size:
+        x = jax.image.resize(x, (x.shape[0], min_size, min_size, x.shape[3]), "bilinear")
+    return x

@@ -1,0 +1,255 @@
+"""Inception v1 (GoogLeNet) and v3 families, TPU-first.
+
+Capability parity with the reference's slim nets_factory entries
+``inception_v1``/``inception_v3`` (external/slim/nets/nets_factory.py:39-60)
+including the auxiliary-logits training head the reference's slims
+experiment wires into the loss (experiments/slims.py:122-124) — written
+fresh as flax modules with the same design stance as resnet.py:
+
+- GroupNorm instead of BatchNorm (stateless; no cross-worker statistic
+  leakage in the Byzantine-DP setting — see models/resnet.py docstring).
+- NHWC, SAME padding throughout; mixed-precision compute via ``dtype`` with
+  float32 params and logits.
+- Small inputs (e.g. CIFAR's 32x32) are bilinearly upsampled to the stem's
+  minimum viable size instead of failing like slim's VALID-padded stems do.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import group_norm as _norm, resize_min
+
+
+class ConvNorm(nn.Module):
+    """Conv + GroupNorm + ReLU, the inception building unit."""
+
+    features: int
+    kernel: tuple
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            (self.stride, self.stride),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        return nn.relu(_norm(x, "norm", self.dtype))
+
+
+class InceptionBlockV1(nn.Module):
+    """The classic 4-branch mixed block (1x1 / 3x3 / 5x5 / pool-proj)."""
+
+    b0: int
+    b1: tuple  # (reduce, out)
+    b2: tuple  # (reduce, out)
+    b3: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        br0 = ConvNorm(self.b0, (1, 1), dtype=d, name="b0")(x)
+        br1 = ConvNorm(self.b1[0], (1, 1), dtype=d, name="b1_reduce")(x)
+        br1 = ConvNorm(self.b1[1], (3, 3), dtype=d, name="b1")(br1)
+        br2 = ConvNorm(self.b2[0], (1, 1), dtype=d, name="b2_reduce")(x)
+        br2 = ConvNorm(self.b2[1], (5, 5), dtype=d, name="b2")(br2)
+        br3 = nn.max_pool(x, (3, 3), (1, 1), padding="SAME")
+        br3 = ConvNorm(self.b3, (1, 1), dtype=d, name="b3")(br3)
+        return jnp.concatenate([br0, br1, br2, br3], axis=-1)
+
+
+# GoogLeNet mixed-block channel table (inception 3a..5b)
+_V1_BLOCKS = [
+    (64, (96, 128), (16, 32), 32),
+    (128, (128, 192), (32, 96), 64),
+    "pool",
+    (192, (96, 208), (16, 48), 64),
+    (160, (112, 224), (24, 64), 64),
+    (128, (128, 256), (24, 64), 64),
+    (112, (144, 288), (32, 64), 64),
+    (256, (160, 320), (32, 128), 128),
+    "pool",
+    (256, (160, 320), (32, 128), 128),
+    (384, (192, 384), (48, 128), 128),
+]
+
+
+class InceptionV1(nn.Module):
+    """GoogLeNet; ``with_aux=True`` also returns the mid-network aux logits."""
+
+    classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 64
+
+    @nn.compact
+    def __call__(self, x, with_aux=False):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        x = ConvNorm(64, (7, 7), 2, dtype=d, name="stem1")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = ConvNorm(64, (1, 1), dtype=d, name="stem2")(x)
+        x = ConvNorm(192, (3, 3), dtype=d, name="stem3")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        aux = None
+        for i, spec in enumerate(_V1_BLOCKS):
+            if spec == "pool":
+                x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+                continue
+            b0, b1, b2, b3 = spec
+            x = InceptionBlockV1(b0, b1, b2, b3, dtype=d, name="mixed_%d" % i)(x)
+            if i == 6 and with_aux:  # after 4d, like GoogLeNet's second aux head
+                a = nn.avg_pool(x, (5, 5), (3, 3), padding="SAME")
+                a = ConvNorm(128, (1, 1), dtype=d, name="aux_proj")(a)
+                a = jnp.mean(a, axis=(1, 2)).astype(jnp.float32)
+                aux = nn.Dense(self.classes, dtype=jnp.float32, name="aux_logits")(a)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global average pool
+        logits = nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+        return (logits, aux) if with_aux else logits
+
+
+class _MixedA(nn.Module):
+    """35x35 block: 1x1 / 5x5 / double-3x3 / pool branches."""
+
+    pool_features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(64, (1, 1), dtype=d, name="b0")(x)
+        b1 = ConvNorm(48, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(64, (5, 5), dtype=d, name="b1_2")(b1)
+        b2 = ConvNorm(64, (1, 1), dtype=d, name="b2_1")(x)
+        b2 = ConvNorm(96, (3, 3), dtype=d, name="b2_2")(b2)
+        b2 = ConvNorm(96, (3, 3), dtype=d, name="b2_3")(b2)
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(self.pool_features, (1, 1), dtype=d, name="b3")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _MixedB(nn.Module):
+    """17x17 block: factorized 7x7 branches."""
+
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d, c = self.dtype, self.channels
+        b0 = ConvNorm(192, (1, 1), dtype=d, name="b0")(x)
+        b1 = ConvNorm(c, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(c, (1, 7), dtype=d, name="b1_2")(b1)
+        b1 = ConvNorm(192, (7, 1), dtype=d, name="b1_3")(b1)
+        b2 = ConvNorm(c, (1, 1), dtype=d, name="b2_1")(x)
+        b2 = ConvNorm(c, (7, 1), dtype=d, name="b2_2")(b2)
+        b2 = ConvNorm(c, (1, 7), dtype=d, name="b2_3")(b2)
+        b2 = ConvNorm(c, (7, 1), dtype=d, name="b2_4")(b2)
+        b2 = ConvNorm(192, (1, 7), dtype=d, name="b2_5")(b2)
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(192, (1, 1), dtype=d, name="b3")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _MixedC(nn.Module):
+    """8x8 block: expanded-filter-bank outputs."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(320, (1, 1), dtype=d, name="b0")(x)
+        b1 = ConvNorm(384, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = jnp.concatenate(
+            [ConvNorm(384, (1, 3), dtype=d, name="b1_2a")(b1), ConvNorm(384, (3, 1), dtype=d, name="b1_2b")(b1)],
+            axis=-1,
+        )
+        b2 = ConvNorm(448, (1, 1), dtype=d, name="b2_1")(x)
+        b2 = ConvNorm(384, (3, 3), dtype=d, name="b2_2")(b2)
+        b2 = jnp.concatenate(
+            [ConvNorm(384, (1, 3), dtype=d, name="b2_3a")(b2), ConvNorm(384, (3, 1), dtype=d, name="b2_3b")(b2)],
+            axis=-1,
+        )
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(192, (1, 1), dtype=d, name="b3")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _ReductionA(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(384, (3, 3), 2, dtype=d, name="b0")(x)
+        b1 = ConvNorm(64, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(96, (3, 3), dtype=d, name="b1_2")(b1)
+        b1 = ConvNorm(96, (3, 3), 2, dtype=d, name="b1_3")(b1)
+        b2 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        return jnp.concatenate([b0, b1, b2], axis=-1)
+
+
+class _ReductionB(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(192, (1, 1), dtype=d, name="b0_1")(x)
+        b0 = ConvNorm(320, (3, 3), 2, dtype=d, name="b0_2")(b0)
+        b1 = ConvNorm(192, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(192, (1, 7), dtype=d, name="b1_2")(b1)
+        b1 = ConvNorm(192, (7, 1), dtype=d, name="b1_3")(b1)
+        b1 = ConvNorm(192, (3, 3), 2, dtype=d, name="b1_4")(b1)
+        b2 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        return jnp.concatenate([b0, b1, b2], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception v3; ``with_aux=True`` also returns the 17x17 aux logits."""
+
+    classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 96
+
+    @nn.compact
+    def __call__(self, x, with_aux=False):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        x = ConvNorm(32, (3, 3), 2, dtype=d, name="stem1")(x)
+        x = ConvNorm(32, (3, 3), dtype=d, name="stem2")(x)
+        x = ConvNorm(64, (3, 3), dtype=d, name="stem3")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = ConvNorm(80, (1, 1), dtype=d, name="stem4")(x)
+        x = ConvNorm(192, (3, 3), dtype=d, name="stem5")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+
+        x = _MixedA(32, dtype=d, name="mixed_5b")(x)
+        x = _MixedA(64, dtype=d, name="mixed_5c")(x)
+        x = _MixedA(64, dtype=d, name="mixed_5d")(x)
+        x = _ReductionA(dtype=d, name="mixed_6a")(x)
+        x = _MixedB(128, dtype=d, name="mixed_6b")(x)
+        x = _MixedB(160, dtype=d, name="mixed_6c")(x)
+        x = _MixedB(160, dtype=d, name="mixed_6d")(x)
+        x = _MixedB(192, dtype=d, name="mixed_6e")(x)
+
+        aux = None
+        if with_aux:
+            a = nn.avg_pool(x, (5, 5), (3, 3), padding="SAME")
+            a = ConvNorm(128, (1, 1), dtype=d, name="aux_proj1")(a)
+            a = ConvNorm(768, (5, 5), dtype=d, name="aux_proj2")(a)
+            a = jnp.mean(a, axis=(1, 2)).astype(jnp.float32)
+            aux = nn.Dense(self.classes, dtype=jnp.float32, name="aux_logits")(a)
+
+        x = _ReductionB(dtype=d, name="mixed_7a")(x)
+        x = _MixedC(dtype=d, name="mixed_7b")(x)
+        x = _MixedC(dtype=d, name="mixed_7c")(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        logits = nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+        return (logits, aux) if with_aux else logits

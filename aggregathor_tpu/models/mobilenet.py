@@ -1,0 +1,91 @@
+"""MobileNet v1 family (depth multipliers 1.0 / 0.75 / 0.5 / 0.25), TPU-first.
+
+Capability parity with the reference's slim nets_factory entries
+``mobilenet_v1`` / ``mobilenet_v1_075`` / ``mobilenet_v1_050`` /
+``mobilenet_v1_025`` (external/slim/nets/nets_factory.py:39-60) — written
+fresh as flax modules with the same design stance as resnet.py (GroupNorm
+instead of BatchNorm, NHWC, mixed-precision via ``dtype``).
+
+Depthwise separable convolutions map to ``nn.Conv`` with
+``feature_group_count=channels`` — XLA lowers these to the TPU's native
+depthwise convolution path.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import group_norm as _norm, resize_min
+
+
+class SeparableBlock(nn.Module):
+    """3x3 depthwise + 1x1 pointwise, each with norm + ReLU."""
+
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        channels = x.shape[-1]
+        y = nn.Conv(
+            channels,
+            (3, 3),
+            (self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=channels,
+            use_bias=False,
+            dtype=self.dtype,
+            name="depthwise",
+        )(x)
+        y = nn.relu(_norm(y, "dw_norm", self.dtype))
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype, name="pointwise")(y)
+        return nn.relu(_norm(y, "pw_norm", self.dtype))
+
+
+# (filters, stride) after the stem conv — the standard v1 body
+_V1_BODY = [
+    (64, 1),
+    (128, 2),
+    (128, 1),
+    (256, 2),
+    (256, 1),
+    (512, 2),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (512, 1),
+    (1024, 2),
+    (1024, 1),
+]
+
+MOBILENET_MULTIPLIERS = {
+    "mobilenet_v1": 1.0,
+    "mobilenet_v1_075": 0.75,
+    "mobilenet_v1_050": 0.5,
+    "mobilenet_v1_025": 0.25,
+}
+
+
+class MobileNetV1(nn.Module):
+    """MobileNet v1 classifier with a width (depth) multiplier."""
+
+    classes: int = 1000
+    multiplier: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+
+        def width(f):
+            return max(8, int(f * self.multiplier))
+
+        x = nn.Conv(width(32), (3, 3), (2, 2), padding="SAME", use_bias=False, dtype=d, name="stem")(x)
+        x = nn.relu(_norm(x, "stem_norm", d))
+        for i, (filters, stride) in enumerate(_V1_BODY):
+            x = SeparableBlock(width(filters), stride, dtype=d, name="sep_%d" % i)(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global average pool
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)

@@ -19,7 +19,10 @@ import optax
 
 from ..utils import parse_keyval
 from . import Experiment, register
+from .classic import AlexNetV2, CifarNet, LeNet
 from .datasets import WorkerBatchIterator, eval_batches, load_cifar10, load_imagenet_standin
+from .inception import InceptionV1, InceptionV3
+from .mobilenet import MOBILENET_MULTIPLIERS, MobileNetV1
 from .resnet import RESNET_DEPTHS, ResNet
 from .vgg import VGG_STAGES, VGG
 
@@ -38,10 +41,29 @@ def _make_factory():
                 variant=variant, classes=classes, dense_units=512 if small else 4096, dtype=dtype
             )
         )
+    factory["inception_v1"] = lambda classes, small, dtype: InceptionV1(classes=classes, dtype=dtype)
+    factory["inception_v3"] = lambda classes, small, dtype: InceptionV3(classes=classes, dtype=dtype)
+    for name, mult in MOBILENET_MULTIPLIERS.items():
+        factory[name] = (
+            lambda classes, small, dtype, mult=mult: MobileNetV1(
+                classes=classes, multiplier=mult, dtype=dtype
+            )
+        )
+    factory["lenet"] = lambda classes, small, dtype: LeNet(classes=classes, dtype=dtype)
+    factory["cifarnet"] = lambda classes, small, dtype: CifarNet(classes=classes, dtype=dtype)
+    factory["alexnet_v2"] = (
+        lambda classes, small, dtype: AlexNetV2(
+            classes=classes, dense_units=512 if small else 4096, dtype=dtype
+        )
+    )
     return factory
 
 
 MODEL_FACTORY = _make_factory()
+
+#: Models with an auxiliary training head (the reference adds the aux-logits
+#: loss for inception nets, experiments/slims.py:122-124)
+AUX_CAPABLE = {"inception_v1", "inception_v3"}
 
 DATASETS = {
     "cifar10": lambda kv: load_cifar10(),
@@ -67,6 +89,7 @@ class ZooExperiment(Experiment):
                 "labels-offset": 0,
                 "image-size": 224,
                 "dtype": "float32",
+                "aux-weight": 0.4,
             },
         )
         self.batch_size = kv["batch-size"]
@@ -74,6 +97,7 @@ class ZooExperiment(Experiment):
         self.weight_decay = kv["weight-decay"]
         self.label_smoothing = kv["label-smoothing"]
         self.labels_offset = kv["labels-offset"]
+        self.aux_weight = kv["aux-weight"] if self.model_name in AUX_CAPABLE else 0.0
         self.dataset = DATASETS[self.dataset_name](kv)
         dtype = jnp.bfloat16 if kv["dtype"] == "bfloat16" else jnp.float32
         classes = self.dataset.nb_classes - self.labels_offset
@@ -83,19 +107,28 @@ class ZooExperiment(Experiment):
 
     def init(self, rng):
         sample = jnp.zeros((1,) + tuple(self.sample_shape), jnp.float32)
+        if self.aux_weight > 0.0:  # also materializes the aux-head params
+            return self.model.init(rng, sample, with_aux=True)
         return self.model.init(rng, sample)
 
     def _logits_labels(self, params, batch):
         return self.model.apply(params, batch["image"]), batch["label"] - self.labels_offset
 
-    def loss(self, params, batch):
-        logits, labels = self._logits_labels(params, batch)
+    def _ce(self, logits, labels):
         if self.label_smoothing > 0.0:
             classes = logits.shape[-1]
             soft = optax.smooth_labels(jax.nn.one_hot(labels, classes), self.label_smoothing)
-            loss = jnp.mean(optax.softmax_cross_entropy(logits, soft))
+            return jnp.mean(optax.softmax_cross_entropy(logits, soft))
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+    def loss(self, params, batch):
+        labels = batch["label"] - self.labels_offset
+        if self.aux_weight > 0.0:
+            logits, aux_logits = self.model.apply(params, batch["image"], with_aux=True)
+            loss = self._ce(logits, labels) + self.aux_weight * self._ce(aux_logits, labels)
         else:
-            loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+            logits = self.model.apply(params, batch["image"])
+            loss = self._ce(logits, labels)
         if self.weight_decay > 0.0:
             # slim's l2_regularizer targets conv/fc kernels only, never norm
             # scales or biases (slims.py:69-76) — rank>1 leaves here.

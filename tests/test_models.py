@@ -19,6 +19,19 @@ def test_zoo_registry_coverage():
         assert "slim-resnet_v1_%d-imagenet" % depth in names
     for variant in VGG_STAGES:
         assert "slim-%s-cifar10" % variant in names
+    for extra in (
+        "inception_v1",
+        "inception_v3",
+        "mobilenet_v1",
+        "mobilenet_v1_075",
+        "mobilenet_v1_050",
+        "mobilenet_v1_025",
+        "lenet",
+        "cifarnet",
+        "alexnet_v2",
+    ):
+        assert "slim-%s-cifar10" % extra in names
+        assert "slim-%s-imagenet" % extra in names
     # core experiments still present
     for core in ("mnist", "cnnet", "mnistAttack"):
         assert core in names
@@ -47,6 +60,35 @@ def test_resnet_bfloat16_compute():
     params = model.init(jax.random.PRNGKey(0), x)
     logits = model.apply(params, x)
     assert logits.dtype == jnp.float32  # head promotes back to f32
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["inception_v1", "mobilenet_v1_025", "lenet", "cifarnet", "alexnet_v2"],
+)
+def test_new_zoo_families_forward(name):
+    exp = models.instantiate("slim-%s-cifar10" % name, ["batch-size:2", "eval-batch-size:2"])
+    params = exp.init(jax.random.PRNGKey(0))
+    batch = jax.tree.map(lambda x: x[0], next(exp.make_train_iterator(1, seed=0)))
+    loss = float(jax.jit(exp.loss)(params, batch))
+    assert np.isfinite(loss)
+    sums = jax.jit(exp.metrics)(params, batch)
+    assert float(sums["accuracy"][1]) > 0
+
+
+def test_inception_aux_head_trains():
+    """The aux-logits head contributes to the loss (slims.py:122-124 parity)."""
+    exp = models.instantiate("slim-inception_v1-cifar10", ["batch-size:2", "aux-weight:0.4"])
+    params = exp.init(jax.random.PRNGKey(0))
+    batch = jax.tree.map(lambda x: x[0], next(exp.make_train_iterator(1, seed=0)))
+    grads = jax.jit(jax.grad(exp.loss))(params, batch)
+    aux_kernel = grads["params"]["aux_logits"]["kernel"]
+    assert float(jnp.sum(jnp.abs(aux_kernel))) > 0
+
+    noaux = models.instantiate("slim-inception_v1-cifar10", ["batch-size:2", "aux-weight:0"])
+    p2 = noaux.init(jax.random.PRNGKey(0))
+    assert "aux_logits" not in p2["params"]
+    assert np.isfinite(float(jax.jit(noaux.loss)(p2, batch)))
 
 
 def test_zoo_experiment_end_to_end():
