@@ -86,6 +86,16 @@ def main(argv=None):
     import jax.numpy as jnp
     import numpy as np
 
+    if args.platform:
+        # The env var alone can be ignored when an accelerator plugin is
+        # pinned by the surrounding environment; the config update wins as
+        # long as no backend has been initialized yet (tests/conftest.py has
+        # the same dance).
+        jax.config.update("jax_platforms", args.platform)
+    effective_platform = args.platform or os.environ.get("JAX_PLATFORMS", "")
+    if effective_platform == "cpu" and args.nb_devices and args.nb_devices > 1:
+        jax.config.update("jax_num_cpu_devices", args.nb_devices)
+
     from .. import config, gars, models
     from ..core import build_optimizer, build_schedule
     from ..obs import CadenceTrigger, Checkpoints, EvalFile, PerfReport, SummaryWriter

@@ -10,6 +10,11 @@ serialized with ``flax.serialization`` (msgpack); restore deserializes into a
 freshly-initialized template state, so shape/dtype mismatches fail loudly.
 Writes are atomic (tmp file + rename) so a killed run never leaves a torn
 latest checkpoint.
+
+Optional authentication: pass ``authenticator`` (a
+``parallel.auth.GradientAuthenticator``) and every snapshot is HMAC-tagged
+in a ``.tag`` sidecar and verified on restore — the host-boundary
+counterpart of the reference's signed tensor pushes (docs/transport.md).
 """
 
 import os
@@ -22,10 +27,11 @@ from ..utils import UserException, info
 
 
 class Checkpoints:
-    def __init__(self, directory, base_name="model", max_to_keep=5):
+    def __init__(self, directory, base_name="model", max_to_keep=5, authenticator=None):
         self.directory = directory
         self.base_name = base_name
         self.max_to_keep = int(max_to_keep)
+        self.authenticator = authenticator
         self._pattern = re.compile(re.escape(base_name) + r"-(\d+)\.ckpt$")
         if directory:
             os.makedirs(directory, exist_ok=True)
@@ -58,7 +64,20 @@ class Checkpoints:
         elif step not in steps:
             raise UserException("No checkpoint for step %d in %r" % (step, self.directory))
         with open(self._path(step), "rb") as fd:
-            state = flax.serialization.from_bytes(template_state, fd.read())
+            data = fd.read()
+        if self.authenticator is not None:
+            tag_path = self._path(step) + ".tag"
+            try:
+                with open(tag_path, "rb") as fd:
+                    tag = fd.read()
+            except OSError:
+                raise UserException("Checkpoint %r has no authentication tag" % (self._path(step),))
+            if not self.authenticator.verify(0, step, data, tag):
+                raise UserException(
+                    "Checkpoint %r failed HMAC verification (corrupted or forged)"
+                    % (self._path(step),)
+                )
+        state = flax.serialization.from_bytes(template_state, data)
         info("Restored checkpoint at step %d from %r" % (step, self.directory))
         return state, step
 
@@ -72,7 +91,16 @@ class Checkpoints:
         with open(tmp, "wb") as fd:
             fd.write(data)
         os.replace(tmp, path)
+        if self.authenticator is not None:
+            # Slot 0 = the controller identity; the step binding prevents
+            # substituting an older (stale) snapshot for a newer one.
+            tag = self.authenticator.sign(0, step, data)
+            with open(path + ".tag", "wb") as fd:
+                fd.write(tag)
         if self.max_to_keep > 0:
             for old in self.steps()[: -self.max_to_keep]:
                 os.remove(self._path(old))
+                tag_path = self._path(old) + ".tag"
+                if os.path.exists(tag_path):
+                    os.remove(tag_path)
         return path

@@ -25,7 +25,8 @@ import tempfile
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ("kernels.cpp", "threadpool.hpp")
+_SOURCES = ("kernels.cpp", "auth.cpp", "threadpool.hpp")
+_COMPILE_UNITS = ("kernels.cpp", "auth.cpp")
 _LIBNAME = "libagtpu_host.so"
 
 _lib = None
@@ -60,7 +61,7 @@ def build(force=False):
     cmd = [
         compiler, "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
         "-Wall", "-Wextra",
-        os.path.join(_DIR, "kernels.cpp"),
+        *[os.path.join(_DIR, unit) for unit in _COMPILE_UNITS],
         "-o", tmp,
     ]
     try:
@@ -98,6 +99,14 @@ def _declare(lib):
         fn = getattr(lib, "agtpu_pairwise_sqdist_%s" % suffix)
         fn.restype = None
         fn.argtypes = [ptr, i64, i64, f64p]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    size_t = ctypes.c_size_t
+    lib.agtpu_sha256.restype = None
+    lib.agtpu_sha256.argtypes = [u8p, size_t, u8p]
+    lib.agtpu_hmac_sha256.restype = None
+    lib.agtpu_hmac_sha256.argtypes = [u8p, size_t, u8p, size_t, u8p]
+    lib.agtpu_hmac_verify.restype = ctypes.c_int
+    lib.agtpu_hmac_verify.argtypes = [u8p, size_t, u8p, size_t, u8p]
 
 
 def load():
@@ -198,3 +207,42 @@ def pairwise_sq_distances(grads):
     fn = getattr(lib, "agtpu_pairwise_sqdist_%s" % suffix)
     fn(_ptr(g, ctype), n, d, _ptr(out, ctypes.c_double))
     return out
+
+
+# --------------------------------------------------------------------------- #
+# host authentication (auth.cpp; see parallel/auth.py for the policy layer)
+
+def _u8(buf):
+    arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
+    arr = np.ascontiguousarray(arr, dtype=np.uint8).ravel()
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr.size
+
+
+def sha256(data):
+    """32-byte SHA-256 digest of ``data`` (bytes or uint8 array)."""
+    lib = load()
+    _, dptr, dlen = _u8(data)
+    out = np.empty(32, dtype=np.uint8)
+    lib.agtpu_sha256(dptr, dlen, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out.tobytes()
+
+
+def hmac_sha256(key, data):
+    """32-byte HMAC-SHA256 tag of ``data`` under ``key``."""
+    lib = load()
+    _, kptr, klen = _u8(key)
+    _, dptr, dlen = _u8(data)
+    out = np.empty(32, dtype=np.uint8)
+    lib.agtpu_hmac_sha256(kptr, klen, dptr, dlen, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out.tobytes()
+
+
+def hmac_verify(key, data, tag):
+    """Constant-time verification of a 32-byte tag."""
+    if len(tag) != 32:
+        return False
+    lib = load()
+    _, kptr, klen = _u8(key)
+    _, dptr, dlen = _u8(data)
+    _, tptr, _tlen = _u8(tag)
+    return bool(lib.agtpu_hmac_verify(kptr, klen, dptr, dlen, tptr))

@@ -1,0 +1,82 @@
+"""Host-level worker authentication (off the hot path).
+
+The reference's threat model includes *forged messages*: Byzantine workers
+may try to impersonate honest ones, so the patched transport ed25519-signs
+every worker->PS tensor push and the PS verifies before reassembly
+(tf_patches/patches/mpi_rendezvous_mgr.patch:585-627, 777-781, 1057-1064);
+TLS channel credentials cover the control plane
+(tf_patches/patches/grpc_channel.patch:70-85).
+
+TPU-native mapping (SURVEY.md §2.6): inside a slice, the ICI fabric is
+closed hardware — a worker cannot inject traffic as another chip, so per-step
+signatures add nothing. The boundary that still needs authentication is the
+*host* layer: multi-host coordination traffic, checkpoint/restore blobs, and
+any gradient material that leaves the SPMD program (e.g. host-relayed DCN
+setups). This module provides the primitive: HMAC-SHA256 tags under
+per-worker keys derived from one session secret, verified in constant time.
+Checkpoint snapshots are tagged/verified when ``obs.Checkpoints`` is built
+with ``authenticator=``; other host flows can reuse the same object.
+Symmetric (not ed25519) because the single controller already shares a
+secret with every worker host it launched — there is no third-party
+verification requirement. The C++ implementation (ops/native/auth.cpp)
+exists for native-tier parity with the reference's C++/libsodium signing
+layer and for hosts whose Python lacks an accelerated hashlib; the stdlib
+fallback keeps the API identical where the library cannot build. For the
+control plane, JAX's multi-host runtime rides gRPC — enabling TLS there is
+deployment configuration, documented in docs/transport.md.
+"""
+
+import hashlib
+import hmac as _py_hmac
+import struct
+
+from ..ops import native
+
+
+def _native_ok():
+    try:
+        return native.available()
+    except Exception:
+        return False
+
+
+def derive_worker_key(session_secret, worker_index):
+    """Per-worker key = SHA-256(secret || worker_index), like the reference
+    derives per-worker identities from deploy-time provisioning."""
+    material = bytes(session_secret) + struct.pack("<q", int(worker_index))
+    if _native_ok():
+        return native.sha256(material)
+    return hashlib.sha256(material).digest()
+
+
+def _message(worker_index, step, payload):
+    # Binding the (worker, step) header into the tag prevents replaying one
+    # worker's gradient as another's or re-sending a stale step — the same
+    # properties the reference gets from signing the metadata chunk
+    # (mpi_rendezvous_mgr.patch:585-627).
+    return struct.pack("<qq", int(worker_index), int(step)) + bytes(payload)
+
+
+class GradientAuthenticator:
+    """Signs / verifies per-worker byte payloads with per-worker HMAC keys."""
+
+    def __init__(self, session_secret, nb_workers):
+        self.nb_workers = int(nb_workers)
+        self.keys = [derive_worker_key(session_secret, w) for w in range(self.nb_workers)]
+
+    def sign(self, worker_index, step, payload):
+        """32-byte tag for ``payload`` (bytes) from ``worker_index`` at ``step``."""
+        msg = _message(worker_index, step, payload)
+        if _native_ok():
+            return native.hmac_sha256(self.keys[worker_index], msg)
+        return _py_hmac.new(self.keys[worker_index], msg, hashlib.sha256).digest()
+
+    def verify(self, worker_index, step, payload, tag):
+        """Constant-time check; False for bad index, stale step binding, or forgery."""
+        if not 0 <= int(worker_index) < self.nb_workers:
+            return False
+        msg = _message(worker_index, step, payload)
+        if _native_ok():
+            return native.hmac_verify(self.keys[worker_index], msg, tag)
+        expect = _py_hmac.new(self.keys[worker_index], msg, hashlib.sha256).digest()
+        return _py_hmac.compare_digest(expect, bytes(tag))

@@ -1,0 +1,120 @@
+"""GAR kernel latency benchmark: ms vs gradient dimension, per tier.
+
+The measurement protocol BASELINE.md prescribes: per-rule kernel latency as a
+function of the flattened gradient dimension ``d``, alongside the steps/s
+bench (bench.py). Tiers:
+
+- ``jnp``     — the default jit/XLA tier (runs on whatever backend is live)
+- ``pallas``  — the hand-written TPU kernels (TPU only; silently skipped
+                elsewhere)
+- ``native``  — the C++ host library via ctypes (CPU threads)
+
+Usage::
+
+    python benchmarks/gar_kernels.py [--n 32] [--f 8] [--dims 65536,1048576]
+                                     [--rules krum,bulyan,median] [--reps 20]
+
+Prints one human table and one machine-readable JSON line per (rule, tier, d).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def time_fn(fn, reps):
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps * 1e3  # ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=32, help="worker count")
+    ap.add_argument("--f", type=int, default=8, help="declared Byzantine count")
+    ap.add_argument("--dims", default="65536,1048576,8388608", help="comma list of d")
+    ap.add_argument("--rules", default="average,average-nan,median,averaged-median,krum,bulyan")
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--platform", default=None, help="force a JAX platform")
+    args = ap.parse_args()
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from aggregathor_tpu import gars
+    from aggregathor_tpu.ops import native
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    native_ok = native.available()
+    rng = np.random.default_rng(0)
+    rules = args.rules.split(",")
+    dims = [int(d) for d in args.dims.split(",")]
+    rows = []
+
+    for d in dims:
+        g_host = rng.normal(size=(args.n, d)).astype(np.float32)
+        g_dev = jax.device_put(g_host)
+
+        for rule in rules:
+            # Bulyan's bound is n >= 4f + 3; clamp f so every rule runs at
+            # the requested n (the reference would reject such configs too).
+            f = min(args.f, (args.n - 3) // 4) if rule.startswith("bulyan") else args.f
+            # jit tier
+            gar = gars.instantiate(rule, args.n, f)
+            agg = jax.jit(gar.aggregate)
+            ms = time_fn(lambda: jax.block_until_ready(agg(g_dev)), args.reps)
+            rows.append((rule, "jnp:" + platform, d, ms, f))
+
+            # pallas tier (TPU only)
+            if on_tpu and (rule + "-pallas") in gars.itemize():
+                pgar = gars.instantiate(rule + "-pallas", args.n, f)
+                pagg = jax.jit(pgar.aggregate)
+                ms = time_fn(lambda: jax.block_until_ready(pagg(g_dev)), args.reps)
+                rows.append((rule, "pallas", d, ms, f))
+
+            # native host tier
+            if native_ok and hasattr(native, rule.replace("-", "_")):
+                nfn = getattr(native, rule.replace("-", "_"))
+                if rule in ("krum", "bulyan", "averaged-median"):
+                    call = lambda nfn=nfn, f=f: nfn(g_host, f)
+                else:
+                    call = lambda nfn=nfn: nfn(g_host)
+                ms = time_fn(call, max(3, args.reps // 4))
+                rows.append((rule, "native", d, ms, f))
+
+    print("%-18s %-12s %12s %12s" % ("rule", "tier", "d", "ms"))
+    for rule, tier, d, ms, f in rows:
+        print("%-18s %-12s %12d %12.3f" % (rule, tier, d, ms))
+    for rule, tier, d, ms, f in rows:
+        print(
+            json.dumps(
+                {
+                    "metric": "gar_kernel_ms",
+                    "rule": rule,
+                    "tier": tier,
+                    "n": args.n,
+                    "f": f,  # effective f (clamped for bulyan's n >= 4f+3)
+                    "d": d,
+                    "value": round(ms, 4),
+                    "unit": "ms",
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

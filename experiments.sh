@@ -1,0 +1,56 @@
+#! /bin/bash
+# Batch-experiment harness — capability parity with the reference's
+# experiments.sh (reference: experiments.sh:19-55): loops
+# `run <experiment> <gar> <n> <f> <batch> <steps>` invocations of the CLI
+# runner, capturing stdout/stderr per configuration under names
+# E=..-R=..-N=..-F=..-B=.. so traces from reference-driven scripts carry over.
+#
+# There is no cluster to start or stop: the single-controller SPMD runtime
+# replaces the reference's deploy.py parameter-server bring-up (its
+# start_cluster/stop_cluster, experiments.sh:7-17). Multi-host TPU pods are
+# launched by running this same script on every host (JAX's multi-process
+# runtime; see aggregathor_tpu/cli/deploy.py).
+
+set -u
+
+RESULTS_DIR="${RESULTS_DIR:-results}"
+PLATFORM_ARGS=${PLATFORM_ARGS:-}    # e.g. "--platform cpu --nb-devices 8"
+RUNNING_PID=0
+
+mkdir -p "${RESULTS_DIR}"
+
+function run {
+	local NAME=E=${1}-R=${2}-N=${3}-F=${4}-B=${5}
+	python3 -m aggregathor_tpu.cli.runner \
+		--experiment "${1}" \
+		--aggregator "${2}" \
+		--nb-workers "${3}" \
+		--nb-decl-byz-workers "${4}" \
+		--experiment-args "batch-size:${5}" \
+		--max-step "${6}" \
+		--stdout-to "${RESULTS_DIR}/${NAME}.stdout" \
+		--stderr-to "${RESULTS_DIR}/${NAME}.stderr" \
+		--evaluation-file "${RESULTS_DIR}/${NAME}.eval" \
+		--evaluation-period -1 \
+		--checkpoint-period 600 \
+		--checkpoint-dir "${RESULTS_DIR}/${NAME}.ckpt" \
+		--summary-period -1 \
+		--evaluation-delta 1000 \
+		--checkpoint-delta -1 \
+		--summary-delta 1000 \
+		${PLATFORM_ARGS} &
+	RUNNING_PID=$!
+	wait ${RUNNING_PID}
+}
+
+function run_abort {
+	kill -s 2 ${RUNNING_PID} 2>/dev/null
+	wait ${RUNNING_PID} 2>/dev/null
+	exit 0
+}
+
+trap run_abort TERM INT
+
+# Begin experiments (reference default: run mnist average 2 0 50 100000)
+run mnist average 2 0 50 10000
+# End experiments
