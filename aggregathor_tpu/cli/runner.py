@@ -50,6 +50,10 @@ def build_parser():
     parser.add_argument("--l1-regularize", type=float, default=None, help="l1 loss regularization")
     parser.add_argument("--l2-regularize", type=float, default=None, help="l2 loss regularization")
     parser.add_argument("--max-step", type=int, default=None, help="train step count (default config.py)")
+    parser.add_argument(
+        "--unroll", type=int, default=1,
+        help="scan this many steps per dispatch (cadences then fire at chunk granularity)",
+    )
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
@@ -152,6 +156,8 @@ def main(argv=None):
         params = experiment.init(jax.random.PRNGKey(args.seed))
         state = engine.init_state(params, tx, seed=args.seed)
         step_fn = engine.build_step(loss_fn, tx)
+        unroll = max(1, args.unroll)
+        multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
         eval_fn = engine.build_eval_sums(experiment.metrics)
 
     # Cadences with config.py defaults (reference: config.py:54-61)
@@ -240,14 +246,28 @@ def main(argv=None):
 
                     trace_ctx = jax.profiler.trace(args.trace_dir)
                     trace_ctx.__enter__()
-                batch = engine.shard_batch(next(train_iter))
-                perf.step_begin()
-                state, metrics = step_fn(state, batch)
-                if pending_loss is not None:
-                    check_divergence()
+                chunk = 1
+                if multi_fn is not None and max_step - step >= unroll and trace_ctx is None:
+                    # Unrolled dispatch: K distinct batches, one executable
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *[next(train_iter) for _ in range(unroll)]
+                    )
+                    perf.step_begin()
+                    state, many = multi_fn(state, engine.shard_batches(stacked))
+                    if pending_loss is not None:
+                        check_divergence()
+                    metrics = jax.tree_util.tree_map(lambda x: x[-1], many)
+                    perf.step_end(unroll)
+                    chunk = unroll
+                else:
+                    batch = engine.shard_batch(next(train_iter))
+                    perf.step_begin()
+                    state, metrics = step_fn(state, batch)
+                    if pending_loss is not None:
+                        check_divergence()
+                    perf.step_end()
                 pending_loss = metrics["total_loss"]
-                perf.step_end()
-                step += 1
+                step += chunk
                 if trace_ctx is not None and step >= offstep + 5:
                     trace_ctx.__exit__(None, None, None)
                     trace_ctx = None

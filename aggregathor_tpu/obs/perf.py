@@ -21,12 +21,13 @@ class PerfReport:
     def step_begin(self):
         self._step_start = time.monotonic()
 
-    def step_end(self):
+    def step_end(self, nb_steps=1):
+        """Account a dispatch covering ``nb_steps`` training steps (unroll)."""
         elapsed = time.monotonic() - self._step_start
         if self.nb_steps == 0:
             self.first_step_s = elapsed
         self.in_graph_s += elapsed
-        self.nb_steps += 1
+        self.nb_steps += int(nb_steps)
 
     def report(self):
         total = time.monotonic() - self.start

@@ -139,16 +139,8 @@ class RobustEngine:
 
     # ------------------------------------------------------------------ #
 
-    def build_step(self, loss_fn, tx):
-        """Build the jitted robust training step.
-
-        Args:
-          loss_fn: (params, worker_batch) -> scalar loss.
-          tx: optax GradientTransformation.
-        Returns:
-          step(state, batch) -> (state, metrics) with ``batch`` pytrees of
-          leading dimension nb_workers (worker-major), sharded over the mesh.
-        """
+    def _make_body(self, loss_fn, tx):
+        """The per-step SPMD body shared by build_step and build_multi_step."""
         W = self.nb_devices
 
         def body(state, batch):
@@ -173,10 +165,64 @@ class RobustEngine:
             }
             return new_state, metrics
 
+        return body
+
+    def build_step(self, loss_fn, tx):
+        """Build the jitted robust training step.
+
+        Args:
+          loss_fn: (params, worker_batch) -> scalar loss.
+          tx: optax GradientTransformation.
+        Returns:
+          step(state, batch) -> (state, metrics) with ``batch`` pytrees of
+          leading dimension nb_workers (worker-major), sharded over the mesh.
+        """
+        body = self._make_body(loss_fn, tx)
         sharded = jax.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(), P(worker_axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def build_multi_step(self, loss_fn, tx, repeat_steps=None):
+        """Build a jitted K-step trainer: one dispatch runs a whole scan.
+
+        Per-step host dispatch dominates wall time for small models (the
+        reference pays this as a full PS round-trip per `sess.run`,
+        runner.py:562-576); scanning K steps inside one executable removes
+        it. Metrics come back per step (leading K).
+
+        Two forms:
+        - ``repeat_steps=None``: ``multi(state, batches)`` with every batch
+          leaf leading (K, nb_workers, ...) — K distinct batches.
+        - ``repeat_steps=K``: ``multi(state, batch)`` reuses one
+          device-resident worker-major batch for K steps (no K-fold host
+          transfer; what the throughput bench uses).
+        """
+        step_body = self._make_body(loss_fn, tx)
+
+        if repeat_steps is None:
+
+            def many(state, batches):
+                return jax.lax.scan(step_body, state, batches)
+
+            batch_spec = P(None, worker_axis)
+        else:
+
+            def many(state, batch):
+                return jax.lax.scan(
+                    lambda s, _: step_body(s, batch), state, None, length=int(repeat_steps)
+                )
+
+            batch_spec = P(worker_axis)
+
+        sharded = jax.shard_map(
+            many,
+            mesh=self.mesh,
+            in_specs=(P(), batch_spec),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -229,6 +275,11 @@ class RobustEngine:
         """Device_put a worker-major batch pytree with the worker sharding."""
         spec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
         return jax.device_put(batch, spec)
+
+    def shard_batches(self, batches):
+        """Device_put a (K, nb_workers, ...) batch stack for build_multi_step."""
+        spec = jax.sharding.NamedSharding(self.mesh, P(None, worker_axis))
+        return jax.device_put(batches, spec)
 
     def replicate(self, tree):
         """Device_put a pytree fully replicated over the mesh."""

@@ -133,3 +133,42 @@ def test_total_loss_is_sum_of_worker_losses():
         wb = {k: v[w] for k, v in batch.items()}
         expect += float(exp.loss(params, wb))
     np.testing.assert_allclose(float(metrics["total_loss"]), expect, rtol=1e-5)
+
+
+def test_multi_step_matches_single_step_chain():
+    """The scanned K-step trainer reproduces K single steps bit-for-bit-ish."""
+    import optax
+
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    n = 4
+    gar = gars.instantiate("krum", n, 1)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    mesh = make_mesh(nb_workers=4)
+    engine = RobustEngine(mesh, gar, nb_workers=n)
+    single = engine.build_step(exp.loss, tx)
+    multi = engine.build_multi_step(exp.loss, tx)
+    repeat = engine.build_multi_step(exp.loss, tx, repeat_steps=5)
+
+    it = exp.make_train_iterator(n, seed=0)
+    batches = [next(it) for _ in range(5)]
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+
+    s1 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    for b in batches:
+        s1, m1 = single(s1, engine.shard_batch(b))
+    s2 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    s2, m2 = multi(s2, engine.shard_batches(stacked))
+    assert np.asarray(m2["total_loss"]).shape == (5,)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s1.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(s2.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    # repeat form: 5 steps on one batch == 5 single steps on that batch
+    s3 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    s3, m3 = repeat(s3, engine.shard_batch(batches[0]))
+    s4 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    for _ in range(5):
+        s4, _ = single(s4, engine.shard_batch(batches[0]))
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s3.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(s4.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
