@@ -189,7 +189,7 @@ def main(argv=None):
     if checkpoints is not None and checkpoints.can_restore():
         with Context("restore"):
             state, offstep = checkpoints.restore(jax.device_get(state))
-            state = engine.replicate(state)
+            state = engine.put_state(state)
 
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
@@ -234,8 +234,11 @@ def main(argv=None):
 
         def check_divergence():
             nonlocal diverged
-            value = float(jax.device_get(pending_loss))
-            if not np.isfinite(value):
+            # ``pending_loss`` is the full per-step loss vector when unrolled,
+            # so a mid-chunk divergence is caught at the next chunk boundary
+            # rather than up to 2K-1 steps late via the last element only.
+            values = np.asarray(jax.device_get(pending_loss))
+            if not np.all(np.isfinite(values)):
                 diverged = True
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
 
@@ -259,6 +262,7 @@ def main(argv=None):
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], many)
                     perf.step_end(unroll)
                     chunk = unroll
+                    pending_loss = many["total_loss"]  # full vector: see check_divergence
                 else:
                     batch = engine.shard_batch(next(train_iter))
                     perf.step_begin()
@@ -266,7 +270,7 @@ def main(argv=None):
                     if pending_loss is not None:
                         check_divergence()
                     perf.step_end()
-                pending_loss = metrics["total_loss"]
+                    pending_loss = metrics["total_loss"]
                 step += chunk
                 if trace_ctx is not None and step >= offstep + 5:
                     trace_ctx.__exit__(None, None, None)

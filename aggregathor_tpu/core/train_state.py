@@ -7,6 +7,7 @@ aggregated gradient (all_gather + identical GAR computation) keeps the copies
 bit-identical — the PS semantics without a PS.
 """
 
+import flax.serialization
 import flax.struct
 import jax
 import jax.numpy as jnp
@@ -15,18 +16,52 @@ import optax  # noqa: F401  (type provider for opt_state pytrees)
 
 @flax.struct.dataclass
 class TrainState:
-    """Pure-pytree training state: parameters, optimizer state, step counter, PRNG key."""
+    """Pure-pytree training state: parameters, optimizer state, step counter, PRNG key.
+
+    ``carry`` is the optional per-worker previously-received gradient matrix,
+    global shape (nb_workers, d), used by the CLEVER stale-value infill of the
+    lossy link (reference: mpi_rendezvous_mgr.patch:833-835 — the PS's
+    reassembly buffer keeps last step's bytes where packets are lost).  Unlike
+    every other field it is *worker-sharded*, never replicated: each device
+    carries only its own workers' rows.
+    """
 
     step: jax.Array
     params: object
     opt_state: object
     rng: jax.Array
+    carry: object = None
 
     @classmethod
-    def create(cls, params, tx, rng=None):
+    def create(cls, params, tx, rng=None, carry=None):
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
+            carry=carry,
         )
+
+
+_SERIALIZED_FIELDS = ("step", "params", "opt_state", "rng")
+
+
+def _to_state_dict(state):
+    # ``carry`` never reaches checkpoints: it is a transport buffer, not model
+    # state — writing it would cost (n, d) host bytes per snapshot and break
+    # restore of snapshots taken before the field existed.  A restarted run
+    # re-zeroes it, like the reference's freshly-allocated reassembly buffer.
+    return {f: flax.serialization.to_state_dict(getattr(state, f)) for f in _SERIALIZED_FIELDS}
+
+
+def _from_state_dict(target, state_dict):
+    restored = {
+        f: flax.serialization.from_state_dict(getattr(target, f), state_dict[f], name=f)
+        for f in _SERIALIZED_FIELDS
+    }
+    return target.replace(**restored)
+
+
+flax.serialization.register_serialization_state(
+    TrainState, _to_state_dict, _from_state_dict, override=True
+)

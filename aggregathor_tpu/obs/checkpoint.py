@@ -85,18 +85,28 @@ class Checkpoints:
         """Snapshot ``state``; prunes beyond ``max_to_keep`` oldest-first."""
         if step is None:
             step = int(jax.device_get(state.step))
+        if getattr(state, "carry", None) is not None:
+            # Not serialized (core/train_state.py) — drop it BEFORE device_get
+            # or the full (n, d) matrix crosses to the host just to be discarded.
+            state = state.replace(carry=None)
         data = flax.serialization.to_bytes(jax.device_get(state))
         path = self._path(step)
+        if self.authenticator is not None:
+            # Slot 0 = the controller identity; the step binding ties each tag
+            # to its snapshot (an attacker with file access can still delete
+            # newer pairs to roll back — pin ``step=`` on restore if rollback
+            # resistance matters). The tag lands on disk BEFORE the data
+            # rename: discovery scans .ckpt files, so a tag without data is
+            # invisible, while data without a tag would fail restore.
+            tag = self.authenticator.sign(0, step, data)
+            tag_tmp = path + ".tag.tmp"
+            with open(tag_tmp, "wb") as fd:
+                fd.write(tag)
+            os.replace(tag_tmp, path + ".tag")
         tmp = path + ".tmp"
         with open(tmp, "wb") as fd:
             fd.write(data)
         os.replace(tmp, path)
-        if self.authenticator is not None:
-            # Slot 0 = the controller identity; the step binding prevents
-            # substituting an older (stale) snapshot for a newer one.
-            tag = self.authenticator.sign(0, step, data)
-            with open(path + ".tag", "wb") as fd:
-                fd.write(tag)
         if self.max_to_keep > 0:
             for old in self.steps()[: -self.max_to_keep]:
                 os.remove(self._path(old))

@@ -66,6 +66,10 @@ class GradientAuthenticator:
 
     def sign(self, worker_index, step, payload):
         """32-byte tag for ``payload`` (bytes) from ``worker_index`` at ``step``."""
+        if not 0 <= int(worker_index) < self.nb_workers:
+            raise ValueError(
+                "worker_index %r out of range [0, %d)" % (worker_index, self.nb_workers)
+            )
         msg = _message(worker_index, step, payload)
         if _native_ok():
             return native.hmac_sha256(self.keys[worker_index], msg)

@@ -78,6 +78,9 @@ class RobustEngine:
             raise UserException("More real Byzantine workers than workers")
         if attack is not None and self.nb_real_byz == 0:
             raise UserException("An attack needs --nb-real-byz-workers > 0 to have anyone to run it")
+        # CLEVER stale infill needs the previously-received gradients carried
+        # across steps (mpi_rendezvous_mgr.patch:833-835).
+        self.carries_gradients = lossy_link is not None and lossy_link.clever
 
     # ------------------------------------------------------------------ #
 
@@ -95,8 +98,13 @@ class RobustEngine:
         flatmap = FlatMap(jax.tree_util.tree_map(lambda g: g[0], grads))
         return losses, gvecs, flatmap
 
-    def _perturb_local(self, gvecs, key):
-        """Apply local attack + lossy link to each local worker's own slot."""
+    def _perturb_local(self, gvecs, key, carry=None):
+        """Apply local attack + lossy link to each local worker's own slot.
+
+        Returns (perturbed (k, d), new_carry) — ``new_carry`` is the
+        post-link gradients, i.e. what "the PS received" this step, which is
+        exactly the stale value a lost packet keeps under CLEVER infill.
+        """
         k = self.workers_per_device
         didx = jax.lax.axis_index(worker_axis)
         out = []
@@ -108,9 +116,11 @@ class RobustEngine:
                 forged = self.attack.apply_local(g, jax.random.fold_in(wkey, 1))
                 g = jnp.where(gidx < self.nb_real_byz, forged, g)
             if self.lossy_link is not None:
-                g = self.lossy_link.apply(g, jax.random.fold_in(wkey, 2), gidx)
+                previous = carry[j] if carry is not None else None
+                g = self.lossy_link.apply(g, jax.random.fold_in(wkey, 2), gidx, previous=previous)
             out.append(g)
-        return jnp.stack(out, axis=0)
+        stacked = jnp.stack(out, axis=0)
+        return stacked, (stacked if self.carries_gradients else None)
 
     def _reshard_to_blocks(self, gvecs, d):
         """(k, d) worker-sharded -> (n, d_block) dimension-sharded column block."""
@@ -139,6 +149,17 @@ class RobustEngine:
 
     # ------------------------------------------------------------------ #
 
+    def _state_spec(self):
+        """PartitionSpec prefix tree for TrainState: everything replicated
+        except the CLEVER carry, whose (n, d) rows stay on their workers."""
+        return TrainState(
+            step=P(),
+            params=P(),
+            opt_state=P(),
+            rng=P(),
+            carry=P(worker_axis) if self.carries_gradients else None,
+        )
+
     def _make_body(self, loss_fn, tx):
         """The per-step SPMD body shared by build_step and build_multi_step."""
         W = self.nb_devices
@@ -146,7 +167,7 @@ class RobustEngine:
         def body(state, batch):
             key = jax.random.fold_in(state.rng, state.step)
             losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn)
-            gvecs = self._perturb_local(gvecs, key)
+            gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
             d = gvecs.shape[-1]
             block = self._reshard_to_blocks(gvecs, d)
             agg_block = self._aggregate_block(block, key)
@@ -158,7 +179,9 @@ class RobustEngine:
             updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             total_loss = jax.lax.psum(jnp.sum(losses), worker_axis) if W > 1 else jnp.sum(losses)
-            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state, carry=new_carry
+            )
             metrics = {
                 "total_loss": total_loss,
                 "grad_norm": jnp.linalg.norm(agg),
@@ -181,8 +204,8 @@ class RobustEngine:
         sharded = jax.shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(), P(worker_axis)),
-            out_specs=(P(), P()),
+            in_specs=(self._state_spec(), P(worker_axis)),
+            out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=(0,))
@@ -222,8 +245,8 @@ class RobustEngine:
         sharded = jax.shard_map(
             many,
             mesh=self.mesh,
-            in_specs=(P(), batch_spec),
-            out_specs=(P(), P()),
+            in_specs=(self._state_spec(), batch_spec),
+            out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=(0,))
@@ -253,7 +276,7 @@ class RobustEngine:
         sharded = jax.shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(), P(worker_axis)),
+            in_specs=(self._state_spec(), P(worker_axis)),
             out_specs=P(),
             check_vma=False,
         )
@@ -286,7 +309,27 @@ class RobustEngine:
         spec = jax.sharding.NamedSharding(self.mesh, P())
         return jax.device_put(tree, spec)
 
+    def put_state(self, state):
+        """Device_put a TrainState with the engine's state sharding — fully
+        replicated except the worker-sharded CLEVER carry (restore path)."""
+        carry = state.carry
+        placed = self.replicate(state.replace(carry=None))
+        if carry is not None:
+            cspec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
+            carry = jax.device_put(carry, cspec)
+        return placed.replace(carry=carry)
+
     def init_state(self, params, tx, seed=0):
-        """Create a replicated TrainState."""
-        state = TrainState.create(params, tx, rng=jax.random.PRNGKey(seed))
-        return self.replicate(state)
+        """Create a replicated TrainState (plus the zeroed CLEVER carry when
+        the lossy link runs in clever mode — packets lost before any gradient
+        was ever received read as zero contributions, like the reference's
+        freshly-allocated reassembly buffer)."""
+        state = self.replicate(TrainState.create(params, tx, rng=jax.random.PRNGKey(seed)))
+        if self.carries_gradients:
+            d = sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
+            cspec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
+            carry = jax.jit(
+                lambda: jnp.zeros((self.nb_workers, d), jnp.float32), out_shardings=cspec
+            )()
+            state = state.replace(carry=carry)
+        return state

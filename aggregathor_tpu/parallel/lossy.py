@@ -12,10 +12,11 @@ the configured rate, and dropped runs become NaN — which the NaN-aware GARs
 exactly the reference's failure mode.
 
 The ``clever`` mode reproduces ``CLEVER=1`` (patch:833-835): a lost packet
-keeps the previous step's value instead of NaN.  It requires the caller to
-supply the previous gradient via ``previous=``; the engine does not carry
-that state yet, so requesting ``clever:true`` through the engine raises
-instead of silently degrading to NaN infill.
+keeps the previous step's value instead of NaN — the PS's reassembly buffer
+simply retains last step's bytes where nothing arrived.  The engine carries
+the per-worker previously-received gradients in ``TrainState.carry``
+(worker-sharded, so the (n, d) matrix never lands on one device) and
+supplies each worker's row via ``previous=``.
 """
 
 import jax
@@ -62,8 +63,8 @@ class LossyLink:
             from ..utils import UserException
 
             raise UserException(
-                "LossyLink clever:true needs the previous gradient (engine support pending); "
-                "use clever:false for NaN infill"
+                "LossyLink clever:true needs the previous gradient; run it through "
+                "RobustEngine (which carries it in TrainState.carry) or pass previous="
             )
         infill = previous if self.clever else jnp.full_like(grad, jnp.nan)
         lossy = jnp.where(mask, infill, grad)

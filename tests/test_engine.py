@@ -111,6 +111,41 @@ def test_lossy_link_breaks_plain_average():
     assert not np.all(np.isfinite(flat_params(state)))
 
 
+def test_lossy_clever_stale_infill():
+    """CLEVER=1 parity (mpi_rendezvous_mgr.patch:833-835): a lost packet keeps
+    the previous step's received value, so even plain average stays finite and
+    converges where NaN infill destroys it (test_lossy_link_breaks_plain_average)."""
+    link = lossy.LossyLink(4, ["drop-rate:0.3", "packet-coords:1024", "min-coords:0", "clever:true"])
+    exp, engine, step, state = make_setup("average", n=8, f=0, lossy_link=link)
+    assert engine.carries_gradients
+    assert state.carry is not None and state.carry.shape[0] == 8
+    state, losses = run_steps(exp, engine, step, state, 25)
+    assert np.all(np.isfinite(flat_params(state)))
+    assert losses[-1] < losses[0]
+
+
+def test_lossy_clever_multi_step_carry():
+    """The scanned trainer threads the carry across steps like single steps."""
+    link = lossy.LossyLink(2, ["drop-rate:0.5", "packet-coords:64", "min-coords:0", "clever:true"])
+    exp, engine, _, _ = make_setup("average", n=4, f=0, nb_devices=4, lossy_link=link)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    multi = engine.build_multi_step(exp.loss, tx)
+    it = exp.make_train_iterator(4, seed=7)
+    batches = [next(it) for _ in range(4)]
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+
+    s1 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    single = engine.build_step(exp.loss, tx)
+    for b in batches:
+        s1, _ = single(s1, engine.shard_batch(b))
+    s2 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    s2, _ = multi(s2, engine.shard_batches(stacked))
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s1.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(s2.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(s1.carry), np.asarray(s2.carry), rtol=1e-6, atol=1e-7)
+
+
 def test_eval_step():
     exp, engine, step, state = make_setup("average", n=8)
     eval_step = engine.build_eval(exp.metrics)

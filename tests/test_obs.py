@@ -54,6 +54,29 @@ def test_checkpoints_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(restored.params["w"]), 1.5)
 
 
+def test_checkpoints_exclude_clever_carry(tmp_path):
+    """The CLEVER carry is a transport buffer, not model state: snapshots must
+    not contain it (size) and must restore into templates with or without one
+    (compatibility both ways), re-zeroing the buffer like the reference's
+    restarted PS reallocates its reassembly one."""
+    state, _ = _tiny_state(2.5)
+    big = np.ones((4, 1 << 16), np.float32)  # 1 MB: would be visible in the file
+    ckpts = Checkpoints(str(tmp_path), "model")
+    path = ckpts.save(state.replace(carry=big), 3)
+    assert os.path.getsize(path) < big.nbytes // 2, "carry leaked into the snapshot"
+    # restore into a clever template: params come back, carry stays the template's
+    template, _ = _tiny_state(0.0)
+    zeros = np.zeros_like(big)
+    restored, step = ckpts.restore(template.replace(carry=zeros))
+    assert step == 3
+    np.testing.assert_allclose(np.asarray(restored.params["w"]), 2.5)
+    np.testing.assert_allclose(np.asarray(restored.carry), 0.0)
+    # restore into a carry-less template (old snapshot shape) also works
+    restored2, _ = ckpts.restore(template)
+    assert restored2.carry is None
+    np.testing.assert_allclose(np.asarray(restored2.params["w"]), 2.5)
+
+
 def test_checkpoints_latest_and_prune(tmp_path):
     state, _ = _tiny_state()
     ckpts = Checkpoints(str(tmp_path), "model", max_to_keep=2)
