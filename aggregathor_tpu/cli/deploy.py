@@ -80,6 +80,15 @@ def main(argv=None):
 
     import jax
 
+    platform = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if platform:
+        # The env var alone can be overridden by an ambient accelerator
+        # plugin, sending jax.distributed.initialize into that plugin's
+        # coordination bootstrap (which can hang); the config-level pin wins
+        # as long as no backend has been initialized yet (cli/runner.py does
+        # the same dance).
+        jax.config.update("jax_platforms", platform)
+
     kwargs = {}
     if args.coordinator_address is not None:
         kwargs = {

@@ -164,10 +164,26 @@ def main(argv=None):
     def pick(value, default):
         return default if value is None else value
 
-    eval_trigger = CadenceTrigger(
-        pick(args.evaluation_delta, config.default_evaluation_delta),
-        pick(args.evaluation_period, config.default_evaluation_period),
-    )
+    # Multi-host discipline: evaluation is a *collective* (every process runs
+    # the SPMD eval program), so its firing must be step-deterministic —
+    # wall-clock cadences can disagree across hosts and deadlock the
+    # collective.  File/snapshot writes are process-0-only (the reference has
+    # exactly one evaluator and one PS writing state, runner.py:318-330).
+    nb_processes = jax.process_count()
+    lead = jax.process_index() == 0
+    eval_period = pick(args.evaluation_period, config.default_evaluation_period)
+    eval_delta = pick(args.evaluation_delta, config.default_evaluation_delta)
+    if nb_processes > 1 and eval_period >= 0.0:
+        if eval_delta < 0:
+            warning(
+                "Multi-process run: wall-period eval is not host-deterministic and "
+                "is DISABLED; pass --evaluation-delta to evaluate"
+            )
+        else:
+            warning("Multi-process run: ignoring --evaluation-period (keeping the step delta)")
+        eval_period = -1.0
+
+    eval_trigger = CadenceTrigger(eval_delta, eval_period)
     ckpt_trigger = CadenceTrigger(
         pick(args.checkpoint_delta, config.default_checkpoint_delta),
         pick(args.checkpoint_period, config.default_checkpoint_period),
@@ -181,15 +197,38 @@ def main(argv=None):
         pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
         args.checkpoint_keep,
     ) if args.checkpoint_dir else None
-    eval_file = EvalFile(args.evaluation_file)
-    summaries = SummaryWriter(args.summary_dir)
+    save_snapshots = checkpoints is not None and lead
+    eval_file = EvalFile(args.evaluation_file if lead else None)
+    summaries = SummaryWriter(args.summary_dir if lead else None)
 
-    # Auto-restore the latest checkpoint (reference: runner.py:514-525)
+    # Auto-restore the latest checkpoint (reference: runner.py:514-525).
+    # Every process must make the SAME restore decision or the SPMD step
+    # counts diverge and the collectives deadlock, so process 0's choice is
+    # broadcast and the others must be able to see that snapshot (shared
+    # filesystem) — failing loudly beats hanging.
     offstep = 0
-    if checkpoints is not None and checkpoints.can_restore():
-        with Context("restore"):
-            state, offstep = checkpoints.restore(jax.device_get(state))
-            state = engine.put_state(state)
+    if checkpoints is not None:
+        steps_on_disk = checkpoints.steps()
+        target_step = steps_on_disk[-1] if steps_on_disk else -1
+        if nb_processes > 1:
+            from jax.experimental import multihost_utils
+
+            target_step = int(multihost_utils.broadcast_one_to_all(np.int32(target_step)))
+            if target_step >= 0 and not checkpoints.can_restore(target_step):
+                raise UserException(
+                    "Process %d cannot see checkpoint step %d: multi-host resume needs "
+                    "--checkpoint-dir on a filesystem shared with process 0"
+                    % (jax.process_index(), target_step)
+                )
+        if target_step >= 0:
+            with Context("restore"):
+                # The CLEVER carry is worker-sharded (possibly across hosts) and
+                # never serialized: keep the live zeroed buffer aside and restore
+                # into a carry-less host template.
+                carry = state.carry
+                template = jax.device_get(state.replace(carry=None))
+                restored, offstep = checkpoints.restore(template, step=target_step)
+                state = engine.put_state(restored.replace(carry=carry))
 
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
@@ -280,7 +319,7 @@ def main(argv=None):
                     check_divergence()
                     run_eval(step)
                     eval_trigger.fired(step)
-                if checkpoints is not None and ckpt_trigger.should_fire(step):
+                if save_snapshots and ckpt_trigger.should_fire(step):
                     check_divergence()
                     checkpoints.save(state, step)
                     ckpt_trigger.fired(step)
@@ -310,7 +349,7 @@ def main(argv=None):
             if step > offstep and not diverged:
                 if eval_trigger.enabled and eval_trigger.last_step != step:
                     run_eval(step)
-                if checkpoints is not None and ckpt_trigger.last_step != step:
+                if save_snapshots and ckpt_trigger.last_step != step:
                     checkpoints.save(state, step)
                 if metrics and summary_trigger.last_step != step:
                     summaries.scalars(step, {"total_loss": float(jax.device_get(metrics["total_loss"]))})

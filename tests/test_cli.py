@@ -6,6 +6,9 @@ The reference's only correctness harness is end-to-end experiment runs
 
 import json
 import os
+import socket
+import subprocess
+import sys
 
 import pytest
 
@@ -59,6 +62,33 @@ def test_runner_resume(tmp_path):
     assert 0 == run(base + ["--max-step", "8"])
     steps = sorted(int(n.split("-")[1].split(".")[0]) for n in os.listdir(ckpt_dir))
     assert 8 in steps  # resumed from 5 and reached 8
+
+
+def test_deploy_local_simulate(tmp_path):
+    """The multi-host path for real: --local-simulate 2 forks a 2-process CPU
+    cluster connected via jax.distributed (reference single-machine story,
+    deploy.py:190-309 / README.md:141-146), runs mnist+krum over the spanning
+    mesh, and only process 0 writes the eval file."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    eval_file = tmp_path / "eval.tsv"
+    proc = subprocess.run(
+        [sys.executable, "-m", "aggregathor_tpu.cli.deploy",
+         "--local-simulate", "2", "--port", str(port), "--",
+         "--experiment", "mnist", "--experiment-args", "batch-size:16",
+         "--aggregator", "krum", "--nb-workers", "4", "--nb-decl-byz-workers", "1",
+         "--max-step", "5", "--learning-rate-args", "initial-rate:0.05",
+         "--evaluation-file", str(eval_file), "--evaluation-delta", "5"],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = eval_file.read_text().strip().splitlines()
+    steps = [int(line.split("\t")[1]) for line in lines]
+    assert steps == sorted(set(steps)), "duplicate eval rows: several processes wrote the file"
+    assert steps[-1] == 5
 
 
 def test_runner_rejects_bad_nf():
