@@ -1,10 +1,10 @@
-"""Classic small nets: LeNet, CifarNet, AlexNet v2.
+"""Classic small nets: LeNet, CifarNet, AlexNet v2, OverFeat.
 
 Capability parity with the reference's slim nets_factory entries ``lenet``,
-``cifarnet``, ``alexnet_v2`` (external/slim/nets/nets_factory.py:39-60) —
-the small-image workhorses of the slim zoo, written fresh as flax modules
-(same conventions as resnet.py: NHWC, mixed precision via ``dtype``,
-float32 logits).
+``cifarnet``, ``alexnet_v2``, ``overfeat``
+(external/slim/nets/nets_factory.py:39-60) — the small-image workhorses of
+the slim zoo, written fresh as flax modules (same conventions as resnet.py:
+NHWC, mixed precision via ``dtype``, float32 logits).
 """
 
 import flax.linen as nn
@@ -77,4 +77,30 @@ class AlexNetV2(nn.Module):
         x = jnp.mean(x, axis=(1, 2))  # spatial pool replaces the 6x6 VALID fc
         x = nn.relu(nn.Dense(self.dense_units, dtype=d, name="fc6")(x))
         x = nn.relu(nn.Dense(self.dense_units, dtype=d, name="fc7")(x))
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x.astype(jnp.float32))
+
+
+class OverFeat(nn.Module):
+    """slim overfeat: 5 convs (11x11/4 stem, wide 1024 tail) + 2 dense heads."""
+
+    classes: int = 1000
+    dense_units: int = 3072
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding="SAME", dtype=d, name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(256, (5, 5), padding="SAME", dtype=d, name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(512, (3, 3), padding="SAME", dtype=d, name="conv3")(x))
+        x = nn.relu(nn.Conv(1024, (3, 3), padding="SAME", dtype=d, name="conv4")(x))
+        x = nn.relu(nn.Conv(1024, (3, 3), padding="SAME", dtype=d, name="conv5")(x))
+        x = nn.max_pool(x, (2, 2), (2, 2), padding="SAME")
+        x = jnp.mean(x, axis=(1, 2))  # spatial pool replaces the 6x6 VALID fc
+        x = nn.relu(nn.Dense(self.dense_units, dtype=d, name="fc6")(x))
+        x = nn.relu(nn.Dense(self.dense_units + 1024, dtype=d, name="fc7")(x))
         return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x.astype(jnp.float32))

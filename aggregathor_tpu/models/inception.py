@@ -1,7 +1,9 @@
-"""Inception v1 (GoogLeNet) and v3 families, TPU-first.
+"""Inception v1 (GoogLeNet), v2 (BN-Inception), v3, v4 and
+Inception-ResNet-v2 families, TPU-first.
 
 Capability parity with the reference's slim nets_factory entries
-``inception_v1``/``inception_v3`` (external/slim/nets/nets_factory.py:39-60)
+``inception_v1`` / ``inception_v2`` / ``inception_v3`` / ``inception_v4`` /
+``inception_resnet_v2`` (external/slim/nets/nets_factory.py:39-60)
 including the auxiliary-logits training head the reference's slims
 experiment wires into the loss (experiments/slims.py:122-124) — written
 fresh as flax modules with the same design stance as resnet.py:
@@ -111,6 +113,19 @@ class InceptionV1(nn.Module):
         x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global average pool
         logits = nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
         return (logits, aux) if with_aux else logits
+
+
+def _aux_head(x, classes, d):
+    """The 17x17 auxiliary-logits head shared by v3/v4/inception-resnet-v2.
+
+    Called inside the owning module's ``@nn.compact`` scope so the parameter
+    names (aux_proj1/aux_proj2/aux_logits) attach to the net itself.
+    """
+    a = nn.avg_pool(x, (5, 5), (3, 3), padding="SAME")
+    a = ConvNorm(128, (1, 1), dtype=d, name="aux_proj1")(a)
+    a = ConvNorm(768, (5, 5), dtype=d, name="aux_proj2")(a)
+    a = jnp.mean(a, axis=(1, 2)).astype(jnp.float32)
+    return nn.Dense(classes, dtype=jnp.float32, name="aux_logits")(a)
 
 
 class _MixedA(nn.Module):
@@ -239,17 +254,336 @@ class InceptionV3(nn.Module):
         x = _MixedB(160, dtype=d, name="mixed_6d")(x)
         x = _MixedB(192, dtype=d, name="mixed_6e")(x)
 
-        aux = None
-        if with_aux:
-            a = nn.avg_pool(x, (5, 5), (3, 3), padding="SAME")
-            a = ConvNorm(128, (1, 1), dtype=d, name="aux_proj1")(a)
-            a = ConvNorm(768, (5, 5), dtype=d, name="aux_proj2")(a)
-            a = jnp.mean(a, axis=(1, 2)).astype(jnp.float32)
-            aux = nn.Dense(self.classes, dtype=jnp.float32, name="aux_logits")(a)
+        aux = _aux_head(x, self.classes, d) if with_aux else None
 
         x = _ReductionB(dtype=d, name="mixed_7a")(x)
         x = _MixedC(dtype=d, name="mixed_7b")(x)
         x = _MixedC(dtype=d, name="mixed_7c")(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        logits = nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+        return (logits, aux) if with_aux else logits
+
+
+class _MixedV2(nn.Module):
+    """BN-Inception 4-branch block: 1x1 / 3x3 / double-3x3 / pool-proj.
+
+    Inception v2 replaces v1's 5x5 branch with two stacked 3x3s; ``pool``
+    selects avg (most blocks) or max (the last one) per the v2 table.
+    """
+
+    b0: int
+    b1: tuple  # (reduce, out)
+    b2: tuple  # (reduce, out) -- out used twice (double 3x3)
+    b3: int
+    pool: str = "avg"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        br0 = ConvNorm(self.b0, (1, 1), dtype=d, name="b0")(x)
+        br1 = ConvNorm(self.b1[0], (1, 1), dtype=d, name="b1_reduce")(x)
+        br1 = ConvNorm(self.b1[1], (3, 3), dtype=d, name="b1")(br1)
+        br2 = ConvNorm(self.b2[0], (1, 1), dtype=d, name="b2_reduce")(x)
+        br2 = ConvNorm(self.b2[1], (3, 3), dtype=d, name="b2_1")(br2)
+        br2 = ConvNorm(self.b2[1], (3, 3), dtype=d, name="b2_2")(br2)
+        pool = nn.avg_pool if self.pool == "avg" else nn.max_pool
+        br3 = pool(x, (3, 3), (1, 1), padding="SAME")
+        br3 = ConvNorm(self.b3, (1, 1), dtype=d, name="b3")(br3)
+        return jnp.concatenate([br0, br1, br2, br3], axis=-1)
+
+
+class _ReductionV2(nn.Module):
+    """BN-Inception stride-2 block (Mixed_4a / Mixed_5a): 3x3 / double-3x3 / pool."""
+
+    b0: tuple  # (reduce, out)
+    b1: tuple  # (reduce, out)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        br0 = ConvNorm(self.b0[0], (1, 1), dtype=d, name="b0_reduce")(x)
+        br0 = ConvNorm(self.b0[1], (3, 3), 2, dtype=d, name="b0")(br0)
+        br1 = ConvNorm(self.b1[0], (1, 1), dtype=d, name="b1_reduce")(x)
+        br1 = ConvNorm(self.b1[1], (3, 3), dtype=d, name="b1_1")(br1)
+        br1 = ConvNorm(self.b1[1], (3, 3), 2, dtype=d, name="b1_2")(br1)
+        br2 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        return jnp.concatenate([br0, br1, br2], axis=-1)
+
+
+# The slim inception_v2 mixed-block channel table (Mixed_3b .. Mixed_5c)
+_V2_BLOCKS = [
+    (64, (64, 64), (64, 96), 32, "avg"),       # 3b
+    (64, (64, 96), (64, 96), 64, "avg"),       # 3c
+    "reduce_4a",
+    (224, (64, 96), (96, 128), 128, "avg"),    # 4b
+    (192, (96, 128), (96, 128), 128, "avg"),   # 4c
+    (160, (128, 160), (128, 160), 96, "avg"),  # 4d
+    (96, (128, 192), (160, 192), 96, "avg"),   # 4e
+    "reduce_5a",
+    (352, (192, 320), (160, 224), 128, "avg"), # 5b
+    (352, (192, 320), (192, 224), 128, "max"), # 5c
+]
+
+
+class InceptionV2(nn.Module):
+    """BN-Inception: v1 topology with double-3x3 branches, separable stem."""
+
+    classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        # slim's depthwise-separable 7x7/2 stem (inception_v2.py): depthwise
+        # then 1x1 pointwise, one norm+relu at the end.
+        channels = x.shape[-1]
+        x = nn.Conv(channels * 8, (7, 7), (2, 2), padding="SAME",
+                    feature_group_count=channels, use_bias=False, dtype=d, name="stem_dw")(x)
+        x = nn.Conv(64, (1, 1), use_bias=False, dtype=d, name="stem_pw")(x)
+        x = nn.relu(_norm(x, "stem_norm", d))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = ConvNorm(64, (1, 1), dtype=d, name="stem2")(x)
+        x = ConvNorm(192, (3, 3), dtype=d, name="stem3")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, spec in enumerate(_V2_BLOCKS):
+            if spec == "reduce_4a":
+                x = _ReductionV2((128, 160), (64, 96), dtype=d, name="mixed_4a")(x)
+            elif spec == "reduce_5a":
+                x = _ReductionV2((128, 192), (192, 256), dtype=d, name="mixed_5a")(x)
+            else:
+                b0, b1, b2, b3, pool = spec
+                x = _MixedV2(b0, b1, b2, b3, pool, dtype=d, name="mixed_%d" % i)(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+
+
+class _V4InceptionA(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(96, (1, 1), dtype=d, name="b0")(x)
+        b1 = ConvNorm(64, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(96, (3, 3), dtype=d, name="b1_2")(b1)
+        b2 = ConvNorm(64, (1, 1), dtype=d, name="b2_1")(x)
+        b2 = ConvNorm(96, (3, 3), dtype=d, name="b2_2")(b2)
+        b2 = ConvNorm(96, (3, 3), dtype=d, name="b2_3")(b2)
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(96, (1, 1), dtype=d, name="b3")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _V4ReductionA(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(384, (3, 3), 2, dtype=d, name="b0")(x)
+        b1 = ConvNorm(192, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(224, (3, 3), dtype=d, name="b1_2")(b1)
+        b1 = ConvNorm(256, (3, 3), 2, dtype=d, name="b1_3")(b1)
+        b2 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        return jnp.concatenate([b0, b1, b2], axis=-1)
+
+
+class _V4InceptionB(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(384, (1, 1), dtype=d, name="b0")(x)
+        b1 = ConvNorm(192, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(224, (1, 7), dtype=d, name="b1_2")(b1)
+        b1 = ConvNorm(256, (7, 1), dtype=d, name="b1_3")(b1)
+        b2 = ConvNorm(192, (1, 1), dtype=d, name="b2_1")(x)
+        b2 = ConvNorm(192, (7, 1), dtype=d, name="b2_2")(b2)
+        b2 = ConvNorm(224, (1, 7), dtype=d, name="b2_3")(b2)
+        b2 = ConvNorm(224, (7, 1), dtype=d, name="b2_4")(b2)
+        b2 = ConvNorm(256, (1, 7), dtype=d, name="b2_5")(b2)
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(128, (1, 1), dtype=d, name="b3")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _V4ReductionB(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(192, (1, 1), dtype=d, name="b0_1")(x)
+        b0 = ConvNorm(192, (3, 3), 2, dtype=d, name="b0_2")(b0)
+        b1 = ConvNorm(256, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = ConvNorm(256, (1, 7), dtype=d, name="b1_2")(b1)
+        b1 = ConvNorm(320, (7, 1), dtype=d, name="b1_3")(b1)
+        b1 = ConvNorm(320, (3, 3), 2, dtype=d, name="b1_4")(b1)
+        b2 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        return jnp.concatenate([b0, b1, b2], axis=-1)
+
+
+class _V4InceptionC(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b0 = ConvNorm(256, (1, 1), dtype=d, name="b0")(x)
+        b1 = ConvNorm(384, (1, 1), dtype=d, name="b1_1")(x)
+        b1 = jnp.concatenate(
+            [ConvNorm(256, (1, 3), dtype=d, name="b1_2a")(b1),
+             ConvNorm(256, (3, 1), dtype=d, name="b1_2b")(b1)], axis=-1)
+        b2 = ConvNorm(384, (1, 1), dtype=d, name="b2_1")(x)
+        b2 = ConvNorm(448, (3, 1), dtype=d, name="b2_2")(b2)
+        b2 = ConvNorm(512, (1, 3), dtype=d, name="b2_3")(b2)
+        b2 = jnp.concatenate(
+            [ConvNorm(256, (1, 3), dtype=d, name="b2_4a")(b2),
+             ConvNorm(256, (3, 1), dtype=d, name="b2_4b")(b2)], axis=-1)
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(256, (1, 1), dtype=d, name="b3")(b3)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class InceptionV4(nn.Module):
+    """Inception v4; ``with_aux=True`` also returns the 17x17 aux logits."""
+
+    classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 96
+
+    @nn.compact
+    def __call__(self, x, with_aux=False):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        # v4 stem: conv stack with two filter-concat joins
+        x = ConvNorm(32, (3, 3), 2, dtype=d, name="stem1")(x)
+        x = ConvNorm(32, (3, 3), dtype=d, name="stem2")(x)
+        x = ConvNorm(64, (3, 3), dtype=d, name="stem3")(x)
+        x = jnp.concatenate(
+            [nn.max_pool(x, (3, 3), (2, 2), padding="SAME"),
+             ConvNorm(96, (3, 3), 2, dtype=d, name="stem4")(x)], axis=-1)
+        y0 = ConvNorm(64, (1, 1), dtype=d, name="stem5a_1")(x)
+        y0 = ConvNorm(96, (3, 3), dtype=d, name="stem5a_2")(y0)
+        y1 = ConvNorm(64, (1, 1), dtype=d, name="stem5b_1")(x)
+        y1 = ConvNorm(64, (7, 1), dtype=d, name="stem5b_2")(y1)
+        y1 = ConvNorm(64, (1, 7), dtype=d, name="stem5b_3")(y1)
+        y1 = ConvNorm(96, (3, 3), dtype=d, name="stem5b_4")(y1)
+        x = jnp.concatenate([y0, y1], axis=-1)
+        x = jnp.concatenate(
+            [ConvNorm(192, (3, 3), 2, dtype=d, name="stem6")(x),
+             nn.max_pool(x, (3, 3), (2, 2), padding="SAME")], axis=-1)
+
+        for i in range(4):
+            x = _V4InceptionA(dtype=d, name="mixed_5%c" % (98 + i))(x)
+        x = _V4ReductionA(dtype=d, name="mixed_6a")(x)
+        for i in range(7):
+            x = _V4InceptionB(dtype=d, name="mixed_6%c" % (98 + i))(x)
+
+        aux = _aux_head(x, self.classes, d) if with_aux else None
+
+        x = _V4ReductionB(dtype=d, name="mixed_7a")(x)
+        for i in range(3):
+            x = _V4InceptionC(dtype=d, name="mixed_7%c" % (98 + i))(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        logits = nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+        return (logits, aux) if with_aux else logits
+
+
+class _ResBlock(nn.Module):
+    """Inception-ResNet residual unit: branches -> concat -> linear 1x1 ->
+    scaled residual add (the stabilizing scale from the paper)."""
+
+    out_channels: int
+    scale: float
+    branches: tuple  # tuple of tuples of (features, kernel) conv chains
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        outs = []
+        for bi, chain in enumerate(self.branches):
+            y = x
+            for ci, (features, kernel) in enumerate(chain):
+                y = ConvNorm(features, kernel, dtype=d, name="b%d_%d" % (bi, ci))(y)
+            outs.append(y)
+        up = jnp.concatenate(outs, axis=-1)
+        up = nn.Conv(self.out_channels, (1, 1), dtype=d, name="up")(up)  # linear
+        return nn.relu(x + self.scale * up)
+
+
+class InceptionResNetV2(nn.Module):
+    """Inception-ResNet-v2; ``with_aux=True`` returns the 17x17 aux logits.
+
+    10x block35 (scale 0.17), 20x block17 (scale 0.10), 10x block8
+    (scale 0.20) between the v4-style reductions, as in the paper/slim.
+    """
+
+    classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 96
+
+    @nn.compact
+    def __call__(self, x, with_aux=False):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        x = ConvNorm(32, (3, 3), 2, dtype=d, name="stem1")(x)
+        x = ConvNorm(32, (3, 3), dtype=d, name="stem2")(x)
+        x = ConvNorm(64, (3, 3), dtype=d, name="stem3")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = ConvNorm(80, (1, 1), dtype=d, name="stem4")(x)
+        x = ConvNorm(192, (3, 3), dtype=d, name="stem5")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        # Mixed_5b
+        b0 = ConvNorm(96, (1, 1), dtype=d, name="m5b_b0")(x)
+        b1 = ConvNorm(48, (1, 1), dtype=d, name="m5b_b1_1")(x)
+        b1 = ConvNorm(64, (5, 5), dtype=d, name="m5b_b1_2")(b1)
+        b2 = ConvNorm(64, (1, 1), dtype=d, name="m5b_b2_1")(x)
+        b2 = ConvNorm(96, (3, 3), dtype=d, name="m5b_b2_2")(b2)
+        b2 = ConvNorm(96, (3, 3), dtype=d, name="m5b_b2_3")(b2)
+        b3 = nn.avg_pool(x, (3, 3), (1, 1), padding="SAME")
+        b3 = ConvNorm(64, (1, 1), dtype=d, name="m5b_b3")(b3)
+        x = jnp.concatenate([b0, b1, b2, b3], axis=-1)  # 320
+
+        block35 = (((32, (1, 1)),), ((32, (1, 1)), (32, (3, 3))),
+                   ((32, (1, 1)), (48, (3, 3)), (64, (3, 3))))
+        for i in range(10):
+            x = _ResBlock(320, 0.17, block35, dtype=d, name="block35_%d" % i)(x)
+        # Reduction A with the inception-resnet widths (k,l,m,n = 256,256,384,384)
+        r0 = ConvNorm(384, (3, 3), 2, dtype=d, name="m6a_b0")(x)
+        r1 = ConvNorm(256, (1, 1), dtype=d, name="m6a_b1_1")(x)
+        r1 = ConvNorm(256, (3, 3), dtype=d, name="m6a_b1_2")(r1)
+        r1 = ConvNorm(384, (3, 3), 2, dtype=d, name="m6a_b1_3")(r1)
+        r2 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = jnp.concatenate([r0, r1, r2], axis=-1)  # -> 1088
+
+        block17 = (((192, (1, 1)),), ((128, (1, 1)), (160, (1, 7)), (192, (7, 1))))
+        for i in range(20):
+            x = _ResBlock(1088, 0.10, block17, dtype=d, name="block17_%d" % i)(x)
+
+        aux = _aux_head(x, self.classes, d) if with_aux else None
+
+        # Reduction B (inception-resnet variant: three conv branches + pool)
+        b0 = ConvNorm(256, (1, 1), dtype=d, name="m7a_b0_1")(x)
+        b0 = ConvNorm(384, (3, 3), 2, dtype=d, name="m7a_b0_2")(b0)
+        b1 = ConvNorm(256, (1, 1), dtype=d, name="m7a_b1_1")(x)
+        b1 = ConvNorm(288, (3, 3), 2, dtype=d, name="m7a_b1_2")(b1)
+        b2 = ConvNorm(256, (1, 1), dtype=d, name="m7a_b2_1")(x)
+        b2 = ConvNorm(288, (3, 3), dtype=d, name="m7a_b2_2")(b2)
+        b2 = ConvNorm(320, (3, 3), 2, dtype=d, name="m7a_b2_3")(b2)
+        b3 = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = jnp.concatenate([b0, b1, b2, b3], axis=-1)  # 2080
+
+        block8 = (((192, (1, 1)),), ((192, (1, 1)), (224, (1, 3)), (256, (3, 1))))
+        for i in range(10):
+            x = _ResBlock(2080, 0.20, block8, dtype=d, name="block8_%d" % i)(x)
+        x = ConvNorm(1536, (1, 1), dtype=d, name="final_conv")(x)
         x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
         logits = nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
         return (logits, aux) if with_aux else logits

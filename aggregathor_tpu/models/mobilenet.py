@@ -1,8 +1,9 @@
-"""MobileNet v1 family (depth multipliers 1.0 / 0.75 / 0.5 / 0.25), TPU-first.
+"""MobileNet v1 + v2 families, TPU-first.
 
 Capability parity with the reference's slim nets_factory entries
 ``mobilenet_v1`` / ``mobilenet_v1_075`` / ``mobilenet_v1_050`` /
-``mobilenet_v1_025`` (external/slim/nets/nets_factory.py:39-60) — written
+``mobilenet_v1_025`` and ``mobilenet_v2`` / ``mobilenet_v2_140`` /
+``mobilenet_v2_035`` (external/slim/nets/nets_factory.py:39-60) — written
 fresh as flax modules with the same design stance as resnet.py (GroupNorm
 instead of BatchNorm, NHWC, mixed-precision via ``dtype``).
 
@@ -12,6 +13,7 @@ depthwise convolution path.
 """
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from .common import group_norm as _norm, resize_min
@@ -88,4 +90,85 @@ class MobileNetV1(nn.Module):
         for i, (filters, stride) in enumerate(_V1_BODY):
             x = SeparableBlock(width(filters), stride, dtype=d, name="sep_%d" % i)(x)
         x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global average pool
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)
+
+
+class InvertedResidual(nn.Module):
+    """v2 bottleneck: 1x1 expand -> 3x3 depthwise -> 1x1 linear project,
+    residual when stride 1 and channels match.  ReLU6 as in the paper."""
+
+    features: int
+    stride: int = 1
+    expand: int = 6
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        channels = x.shape[-1]
+        y = x
+        hidden = channels * self.expand
+        if self.expand != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False, dtype=d, name="expand")(y)
+            y = jax.nn.relu6(_norm(y, "expand_norm", d))
+        y = nn.Conv(hidden, (3, 3), (self.stride, self.stride), padding="SAME",
+                    feature_group_count=hidden, use_bias=False, dtype=d, name="depthwise")(y)
+        y = jax.nn.relu6(_norm(y, "dw_norm", d))
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=d, name="project")(y)
+        y = _norm(y, "project_norm", d)  # linear bottleneck: no activation
+        if self.stride == 1 and channels == self.features:
+            y = x + y
+        return y
+
+
+# (expansion t, channels c, repeats n, first stride s) — the v2 paper body
+_V2_BODY = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+MOBILENET_V2_MULTIPLIERS = {
+    "mobilenet_v2": 1.0,
+    "mobilenet_v2_140": 1.4,
+    "mobilenet_v2_035": 0.35,
+}
+
+
+class MobileNetV2(nn.Module):
+    """MobileNet v2 classifier with a width multiplier.
+
+    As in the paper/slim, the width multiplier scales every layer except the
+    final 1280-channel head, which only scales *up* (multiplier > 1).
+    """
+
+    classes: int = 1000
+    multiplier: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+
+        def width(f):
+            return max(8, int(f * self.multiplier + 4) // 8 * 8)  # round to /8 like slim
+
+        x = nn.Conv(width(32), (3, 3), (2, 2), padding="SAME", use_bias=False, dtype=d, name="stem")(x)
+        x = jax.nn.relu6(_norm(x, "stem_norm", d))
+        i = 0
+        for expand, channels, repeats, stride in _V2_BODY:
+            for r in range(repeats):
+                x = InvertedResidual(width(channels), stride if r == 0 else 1, expand,
+                                     dtype=d, name="block_%d" % i)(x)
+                i += 1
+        head = width(1280) if self.multiplier > 1.0 else 1280
+        x = nn.Conv(head, (1, 1), use_bias=False, dtype=d, name="head")(x)
+        x = jax.nn.relu6(_norm(x, "head_norm", d))
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
         return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)

@@ -1,0 +1,170 @@
+"""NASNet-A and PNASNet-5 families, TPU-first.
+
+Capability parity with the reference's slim nets_factory entries
+``nasnet_cifar`` / ``nasnet_mobile`` / ``nasnet_large`` and
+``pnasnet_mobile`` / ``pnasnet_large``
+(external/slim/nets/nets_factory.py:39-60) — written fresh as flax modules.
+
+The cell wiring follows the published architectures: the NASNet-A normal and
+reduction cells (Zoph et al., "Learning Transferable Architectures", fig. 4)
+as 5 pairwise-combined blocks over the two previous cell outputs, and the
+PNASNet-5 cell (Liu et al., "Progressive Neural Architecture Search") as one
+cell type used at both strides.  Deliberate simplifications, documented here
+rather than hidden: separable convs are applied once (not twice) per op, the
+"previous" input is aligned to the current spatial size by average pooling
+when needed, and — per the repo-wide design stance (models/resnet.py) —
+GroupNorm replaces BatchNorm.  Variant sizing (cells N, penultimate filters)
+matches slim's: cifar (N=6, F=32), mobile (N=4, F=44), large (N=6, F=168);
+pnasnet mobile (N=3, F=54), large (N=4, F=216).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import group_norm as _norm, resize_min
+
+
+class _SepConv(nn.Module):
+    """ReLU -> depthwise kxk -> pointwise 1x1 -> norm (one application)."""
+
+    features: int
+    kernel: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        channels = x.shape[-1]
+        y = nn.relu(x)
+        y = nn.Conv(channels, (self.kernel, self.kernel), (self.stride, self.stride),
+                    padding="SAME", feature_group_count=channels, use_bias=False,
+                    dtype=d, name="depthwise")(y)
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=d, name="pointwise")(y)
+        return _norm(y, "norm", d)
+
+
+class _Squeeze(nn.Module):
+    """ReLU -> 1x1 conv -> norm, aligning an input to F filters."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype, name="proj")(nn.relu(x))
+        return _norm(y, "norm", self.dtype)
+
+
+def _pool(kind, x, stride):
+    op = nn.avg_pool if kind == "avg" else nn.max_pool
+    return op(x, (3, 3), (stride, stride), padding="SAME")
+
+
+class _NasnetCell(nn.Module):
+    """One NASNet-A cell over (prev, cur) with 5 combination blocks.
+
+    ``reduction=True`` applies the reduction-cell op set at stride 2.
+    Outputs the concatenation of the unconsumed block outputs, the standard
+    NASNet-A combination rule.
+    """
+
+    filters: int
+    reduction: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, prev, cur):
+        d, f = self.dtype, self.filters
+        s = 2 if self.reduction else 1
+        # Align both inputs to F filters; align prev to cur's spatial size.
+        if prev.shape[1] != cur.shape[1]:
+            prev = nn.avg_pool(prev, (1, 1), (prev.shape[1] // cur.shape[1],) * 2)
+        h0 = _Squeeze(f, dtype=d, name="sq_prev")(prev)
+        h1 = _Squeeze(f, dtype=d, name="sq_cur")(cur)
+        if self.reduction:
+            # NASNet-A reduction cell (5 blocks, stride-2 first uses)
+            b0 = _SepConv(f, 7, s, dtype=d, name="b0_l")(h0) + _SepConv(f, 5, s, dtype=d, name="b0_r")(h1)
+            b1 = _pool("max", h1, s) + _SepConv(f, 7, s, dtype=d, name="b1_r")(h0)
+            b2 = _pool("avg", h1, s) + _SepConv(f, 5, s, dtype=d, name="b2_r")(h0)
+            b3 = _pool("max", h1, s) + _SepConv(f, 3, 1, dtype=d, name="b3_r")(b0)
+            b4 = _pool("avg", b0, 1) + b1
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+        # NASNet-A normal cell (5 blocks, all stride 1)
+        b0 = _SepConv(f, 3, dtype=d, name="b0_l")(h1) + h1
+        b1 = _SepConv(f, 3, dtype=d, name="b1_l")(h0) + _SepConv(f, 5, dtype=d, name="b1_r")(h1)
+        b2 = _pool("avg", h1, 1) + h0
+        b3 = _pool("avg", h0, 1) + _pool("avg", h0, 1)
+        b4 = _SepConv(f, 5, dtype=d, name="b4_l")(h0) + _SepConv(f, 3, dtype=d, name="b4_r")(h0)
+        return jnp.concatenate([b0, b1, b2, b3, b4], axis=-1)
+
+
+class _PnasnetCell(nn.Module):
+    """One PNASNet-5 cell (same op set at stride 1 or 2)."""
+
+    filters: int
+    reduction: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, prev, cur):
+        d, f = self.dtype, self.filters
+        s = 2 if self.reduction else 1
+        if prev.shape[1] != cur.shape[1]:
+            prev = nn.avg_pool(prev, (1, 1), (prev.shape[1] // cur.shape[1],) * 2)
+        h0 = _Squeeze(f, dtype=d, name="sq_prev")(prev)
+        h1 = _Squeeze(f, dtype=d, name="sq_cur")(cur)
+        # PNASNet-5 blocks: (sep5x5, max3x3)(h0,h0); (sep7x7, max3x3)(h1,h1);
+        # (sep5x5, sep3x3)(h1,h1); (sep3x3, none)(b?,h1); (sep3x3, none)(h0,h0)
+        b0 = _SepConv(f, 5, s, dtype=d, name="b0_l")(h0) + _pool("max", h0, s)
+        b1 = _SepConv(f, 7, s, dtype=d, name="b1_l")(h1) + _pool("max", h1, s)
+        b2 = _SepConv(f, 5, s, dtype=d, name="b2_l")(h1) + _SepConv(f, 3, s, dtype=d, name="b2_r")(h1)
+        b3 = _SepConv(f, 3, 1, dtype=d, name="b3_l")(b2) + b1
+        b4 = _SepConv(f, 3, s, dtype=d, name="b4_l")(h0) + (h1 if s == 1 else _pool("max", h1, s))
+        return jnp.concatenate([b0, b1, b2, b3, b4], axis=-1)
+
+
+#: name -> (cell class, cells-per-stack N, first-stack cell filters F,
+#: imagenet stem) — N and F are slim's num_cells/num_conv_filters per variant
+#: (nasnet.py/pnasnet.py configs); filters double at each reduction.
+NASNET_VARIANTS = {
+    "nasnet_cifar": (_NasnetCell, 6, 32, False),
+    "nasnet_mobile": (_NasnetCell, 4, 44, True),
+    "nasnet_large": (_NasnetCell, 6, 168, True),
+    "pnasnet_mobile": (_PnasnetCell, 3, 54, True),
+    "pnasnet_large": (_PnasnetCell, 4, 216, True),
+}
+
+
+class NASNet(nn.Module):
+    """NASNet-A / PNASNet-5 classifier: stem, 3 stacks of N cells separated
+    by reduction cells, global pool, logits."""
+
+    variant: str = "nasnet_cifar"
+    classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    min_size: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        cell_cls, n_cells, f, imagenet_stem = NASNET_VARIANTS[self.variant]
+        d = self.dtype
+        x = resize_min(x, self.min_size).astype(d)
+        if imagenet_stem:
+            x = nn.Conv(32, (3, 3), (2, 2), padding="SAME", use_bias=False, dtype=d, name="stem")(x)
+        else:
+            x = nn.Conv(32, (3, 3), padding="SAME", use_bias=False, dtype=d, name="stem")(x)
+        x = _norm(x, "stem_norm", d)
+        prev, cur = x, x
+        idx = 0
+        for stack in range(3):
+            filters = f * (2 ** stack)
+            if stack > 0:
+                prev, cur = cur, cell_cls(filters, reduction=True, dtype=d,
+                                          name="reduce_%d" % stack)(prev, cur)
+            for _ in range(n_cells):
+                prev, cur = cur, cell_cls(filters, dtype=d, name="cell_%d" % idx)(prev, cur)
+                idx += 1
+        x = nn.relu(cur)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)

@@ -1,9 +1,11 @@
-"""ResNet v1 family, TPU-first.
+"""ResNet v1 + v2 (pre-activation) families, TPU-first.
 
 Capability parity with the reference's vendored slim resnet_v1
 (external/slim/nets/resnet_v1.py:281+, including its resnet_v1_18 addition
-and the 34/50/101/152/200 depths from nets_factory.py:39-60) — written fresh
-as flax modules:
+and the 34/50/101/152/200 depths from nets_factory.py:39-60) and the
+``resnet_v2_50/101/152/200`` factory entries (nets_factory.py:39-60; v2 =
+pre-activation: norm+ReLU precede each conv, identity-clean shortcuts, one
+final norm+ReLU before pooling) — written fresh as flax modules:
 
 - **GroupNorm instead of BatchNorm**: the robust-DP engine treats model state
   as pure parameters (one canonical replicated copy, SURVEY.md §7 design
@@ -72,6 +74,35 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class PreactBottleneckBlock(nn.Module):
+    """v2 bottleneck: norm+ReLU *before* each conv, un-normalized shortcut."""
+
+    filters: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        out_filters = 4 * self.filters
+        y = nn.GroupNorm(num_groups=min(32, x.shape[-1]), dtype=self.dtype, name="norm1")(x)
+        y = nn.relu(y)
+        # Projection reads the pre-activated tensor (resnet_v2 convention);
+        # identity shortcuts bypass normalization entirely.
+        residual = x
+        if x.shape[-1] != out_filters or self.stride != 1:
+            residual = nn.Conv(out_filters, (1, 1), (self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype, name="shortcut")(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype, name="conv1")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype, name="norm2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.stride, self.stride), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.filters), dtype=self.dtype, name="norm3")(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_filters, (1, 1), use_bias=False, dtype=self.dtype, name="conv3")(y)
+        return residual + y
+
+
 # depth -> (block class, stage sizes); nets_factory.py's resnet_v1 variants
 RESNET_DEPTHS = {
     18: (BasicBlock, (2, 2, 2, 2)),
@@ -82,30 +113,43 @@ RESNET_DEPTHS = {
     200: (BottleneckBlock, (3, 24, 36, 3)),
 }
 
+# nets_factory.py's resnet_v2 variants (bottleneck-only, same stage tables)
+RESNET_V2_DEPTHS = {
+    50: (PreactBottleneckBlock, (3, 4, 6, 3)),
+    101: (PreactBottleneckBlock, (3, 4, 23, 3)),
+    152: (PreactBottleneckBlock, (3, 8, 36, 3)),
+    200: (PreactBottleneckBlock, (3, 24, 36, 3)),
+}
+
 
 class ResNet(nn.Module):
-    """ResNet v1 classifier.
+    """ResNet v1/v2 classifier.
 
     ``small_inputs`` switches the stem from the ImageNet 7x7/2 + 3x3/2-pool to
     a CIFAR-style 3x3/1 conv (no pool), the standard adaptation for 32x32.
+    ``preact=True`` selects the v2 pre-activation family: a bare stem conv
+    (normalization happens inside the first block) and a final norm+ReLU
+    before pooling.
     """
 
     depth: int = 50
     classes: int = 1000
     small_inputs: bool = False
+    preact: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        block_cls, stages = RESNET_DEPTHS[self.depth]
+        block_cls, stages = (RESNET_V2_DEPTHS if self.preact else RESNET_DEPTHS)[self.depth]
         x = x.astype(self.dtype)
         if self.small_inputs:
             x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype, name="stem")(x)
         else:
             x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False,
                         dtype=self.dtype, name="stem")(x)
-        x = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="stem_norm")(x)
-        x = nn.relu(x)
+        if not self.preact:
+            x = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="stem_norm")(x)
+            x = nn.relu(x)
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, nb_blocks in enumerate(stages):
@@ -113,5 +157,8 @@ class ResNet(nn.Module):
                 stride = 2 if (stage > 0 and block == 0) else 1
                 x = block_cls(64 * (2 ** stage), stride, self.dtype,
                               name="stage%d_block%d" % (stage + 1, block))(x)
+        if self.preact:
+            x = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="final_norm")(x)
+            x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         return nn.Dense(self.classes, dtype=jnp.float32, name="logits")(x)

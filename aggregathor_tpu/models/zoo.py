@@ -19,11 +19,17 @@ import optax
 
 from ..utils import parse_keyval
 from . import Experiment, register
-from .classic import AlexNetV2, CifarNet, LeNet
+from .classic import AlexNetV2, CifarNet, LeNet, OverFeat
 from .datasets import WorkerBatchIterator, eval_batches, load_cifar10, load_imagenet_standin
-from .inception import InceptionV1, InceptionV3
-from .mobilenet import MOBILENET_MULTIPLIERS, MobileNetV1
-from .resnet import RESNET_DEPTHS, ResNet
+from .inception import InceptionResNetV2, InceptionV1, InceptionV2, InceptionV3, InceptionV4
+from .mobilenet import (
+    MOBILENET_MULTIPLIERS,
+    MOBILENET_V2_MULTIPLIERS,
+    MobileNetV1,
+    MobileNetV2,
+)
+from .nasnet import NASNET_VARIANTS, NASNet
+from .resnet import RESNET_DEPTHS, RESNET_V2_DEPTHS, ResNet
 from .vgg import VGG_STAGES, VGG
 
 
@@ -35,6 +41,12 @@ def _make_factory():
                 depth=depth, classes=classes, small_inputs=small, dtype=dtype
             )
         )
+    for depth in RESNET_V2_DEPTHS:
+        factory["resnet_v2_%d" % depth] = (
+            lambda classes, small, dtype, depth=depth: ResNet(
+                depth=depth, classes=classes, small_inputs=small, preact=True, dtype=dtype
+            )
+        )
     for variant in VGG_STAGES:
         factory[variant] = (
             lambda classes, small, dtype, variant=variant: VGG(
@@ -42,11 +54,28 @@ def _make_factory():
             )
         )
     factory["inception_v1"] = lambda classes, small, dtype: InceptionV1(classes=classes, dtype=dtype)
+    factory["inception_v2"] = lambda classes, small, dtype: InceptionV2(classes=classes, dtype=dtype)
     factory["inception_v3"] = lambda classes, small, dtype: InceptionV3(classes=classes, dtype=dtype)
+    factory["inception_v4"] = lambda classes, small, dtype: InceptionV4(classes=classes, dtype=dtype)
+    factory["inception_resnet_v2"] = (
+        lambda classes, small, dtype: InceptionResNetV2(classes=classes, dtype=dtype)
+    )
     for name, mult in MOBILENET_MULTIPLIERS.items():
         factory[name] = (
             lambda classes, small, dtype, mult=mult: MobileNetV1(
                 classes=classes, multiplier=mult, dtype=dtype
+            )
+        )
+    for name, mult in MOBILENET_V2_MULTIPLIERS.items():
+        factory[name] = (
+            lambda classes, small, dtype, mult=mult: MobileNetV2(
+                classes=classes, multiplier=mult, dtype=dtype
+            )
+        )
+    for variant in NASNET_VARIANTS:
+        factory[variant] = (
+            lambda classes, small, dtype, variant=variant: NASNet(
+                variant=variant, classes=classes, dtype=dtype
             )
         )
     factory["lenet"] = lambda classes, small, dtype: LeNet(classes=classes, dtype=dtype)
@@ -56,14 +85,20 @@ def _make_factory():
             classes=classes, dense_units=512 if small else 4096, dtype=dtype
         )
     )
+    factory["overfeat"] = (
+        lambda classes, small, dtype: OverFeat(
+            classes=classes, dense_units=512 if small else 3072, dtype=dtype
+        )
+    )
     return factory
 
 
 MODEL_FACTORY = _make_factory()
 
 #: Models with an auxiliary training head (the reference adds the aux-logits
-#: loss for inception nets, experiments/slims.py:122-124)
-AUX_CAPABLE = {"inception_v1", "inception_v3"}
+#: loss for inception nets, experiments/slims.py:122-124; like slim, v2/BN-
+#: inception has no aux head)
+AUX_CAPABLE = {"inception_v1", "inception_v3", "inception_v4", "inception_resnet_v2"}
 
 DATASETS = {
     "cifar10": lambda kv: load_cifar10(),
