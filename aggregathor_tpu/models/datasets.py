@@ -37,10 +37,13 @@ def _data_dirs():
 
 
 def _find_npz(basename):
+    stem = basename.split(".")[0]
     for dirname in _data_dirs():
-        path = os.path.join(dirname, basename)
-        if os.path.isfile(path):
-            return path
+        # both <data>/cifar10.npz and <data>/cifar10/cifar10.npz (the latter
+        # is where the TFRecord fallback writes its cache)
+        for path in (os.path.join(dirname, basename), os.path.join(dirname, stem, basename)):
+            if os.path.isfile(path):
+                return path
     return None
 
 
@@ -99,11 +102,43 @@ def load_mnist():
     return _synthetic_classification("mnist", (28, 28, 1), 10, nb_train=8192, nb_test=2048, seed=7)
 
 
+def _find_cifar10_tfrecords():
+    from .tfrecord import has_cifar10_tfrecords
+
+    for dirname in _data_dirs():
+        for candidate in (dirname, os.path.join(dirname, "cifar10")):
+            if has_cifar10_tfrecords(candidate):
+                return candidate
+    return None
+
+
 def load_cifar10():
-    """32x32x3 images in [0, 1]; real file or synthetic stand-in."""
+    """32x32x3 images in [0, 1]; real data (npz, or the reference's slim
+    TFRecord shards — experiments/cnnet.py:115-146) or synthetic stand-in."""
     path = _find_npz("cifar10.npz")
     if path:
         return _load_npz(path, (32, 32, 3), 255.0)
+    tfr_dir = _find_cifar10_tfrecords()
+    if tfr_dir:
+        from .tfrecord import read_cifar10_split
+
+        x_train, y_train = read_cifar10_split(tfr_dir, "train")
+        x_test, y_test = read_cifar10_split(tfr_dir, "test")
+        info("Loaded CIFAR-10 TFRecord shards from %s" % tfr_dir)
+        # Parsing 60k PNG records through the pure-Python codec costs minutes;
+        # cache as the preferred npz so the next run short-circuits above.
+        cache = os.path.join(tfr_dir, "cifar10.npz")
+        try:
+            np.savez_compressed(cache, x_train=x_train, y_train=y_train,
+                                x_test=x_test, y_test=y_test)
+            info("Cached npz at %s" % cache)
+        except OSError:
+            pass  # read-only data dir: pay the parse each run
+        return ArrayDataset(
+            x_train.astype(np.float32) / 255.0, y_train,
+            x_test.astype(np.float32) / 255.0, y_test,
+            nb_classes=int(y_train.max()) + 1, synthetic=False,
+        )
     return _synthetic_classification("cifar10", (32, 32, 3), 10, nb_train=8192, nb_test=2048, seed=11)
 
 

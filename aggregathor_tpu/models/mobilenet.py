@@ -157,7 +157,10 @@ class MobileNetV2(nn.Module):
         x = resize_min(x, self.min_size).astype(d)
 
         def width(f):
-            return max(8, int(f * self.multiplier + 4) // 8 * 8)  # round to /8 like slim
+            # slim's make_divisible: round to /8, never below 90% of the target
+            v = f * self.multiplier
+            new = max(8, int(v + 4) // 8 * 8)
+            return new + 8 if new < 0.9 * v else new
 
         x = nn.Conv(width(32), (3, 3), (2, 2), padding="SAME", use_bias=False, dtype=d, name="stem")(x)
         x = jax.nn.relu6(_norm(x, "stem_norm", d))

@@ -79,7 +79,10 @@ class _NasnetCell(nn.Module):
         s = 2 if self.reduction else 1
         # Align both inputs to F filters; align prev to cur's spatial size.
         if prev.shape[1] != cur.shape[1]:
-            prev = nn.avg_pool(prev, (1, 1), (prev.shape[1] // cur.shape[1],) * 2)
+            # ceil-div stride: SAME stride-2 reductions produce ceil(n/2), so
+            # odd sizes (25 -> 13) need stride ceil(25/13) = 2, not floor = 1
+            s_align = -(-prev.shape[1] // cur.shape[1])
+            prev = nn.avg_pool(prev, (1, 1), (s_align, s_align))
         h0 = _Squeeze(f, dtype=d, name="sq_prev")(prev)
         h1 = _Squeeze(f, dtype=d, name="sq_cur")(cur)
         if self.reduction:
@@ -111,7 +114,10 @@ class _PnasnetCell(nn.Module):
         d, f = self.dtype, self.filters
         s = 2 if self.reduction else 1
         if prev.shape[1] != cur.shape[1]:
-            prev = nn.avg_pool(prev, (1, 1), (prev.shape[1] // cur.shape[1],) * 2)
+            # ceil-div stride: SAME stride-2 reductions produce ceil(n/2), so
+            # odd sizes (25 -> 13) need stride ceil(25/13) = 2, not floor = 1
+            s_align = -(-prev.shape[1] // cur.shape[1])
+            prev = nn.avg_pool(prev, (1, 1), (s_align, s_align))
         h0 = _Squeeze(f, dtype=d, name="sq_prev")(prev)
         h1 = _Squeeze(f, dtype=d, name="sq_cur")(cur)
         # PNASNet-5 blocks: (sep5x5, max3x3)(h0,h0); (sep7x7, max3x3)(h1,h1);

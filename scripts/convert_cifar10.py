@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Convert CIFAR-10 between the reference's TFRecord layout and cifar10.npz.
+
+The reference expects slim's ``download_and_convert_cifar10.py`` output
+(``cifar10_train.tfrecord`` / ``cifar10_test.tfrecord``) symlinked under
+``experiments/datasets/cifar10`` (reference: README.md:190-195,
+experiments/cnnet.py:115-146).  This framework prefers one ``cifar10.npz``
+(keys x_train/y_train/x_test/y_test) under ``$AGGREGATHOR_DATA``; both
+directions are supported so either artifact can seed the other::
+
+  python3 scripts/convert_cifar10.py --from-tfrecords DIR --to-npz cifar10.npz
+  python3 scripts/convert_cifar10.py --from-npz cifar10.npz --to-tfrecords DIR
+
+No TensorFlow involved — see aggregathor_tpu/models/tfrecord.py.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from aggregathor_tpu.models import tfrecord  # noqa: E402
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--from-tfrecords", metavar="DIR", help="read slim TFRecord shards from DIR")
+    parser.add_argument("--to-npz", metavar="FILE", help="write cifar10.npz to FILE")
+    parser.add_argument("--from-npz", metavar="FILE", help="read cifar10.npz from FILE")
+    parser.add_argument("--to-tfrecords", metavar="DIR", help="write slim TFRecord shards to DIR")
+    args = parser.parse_args(argv)
+
+    if args.from_tfrecords and args.to_npz:
+        x_train, y_train = tfrecord.read_cifar10_split(args.from_tfrecords, "train")
+        x_test, y_test = tfrecord.read_cifar10_split(args.from_tfrecords, "test")
+        np.savez_compressed(args.to_npz, x_train=x_train, y_train=y_train,
+                            x_test=x_test, y_test=y_test)
+        print("wrote %s (%d train / %d test)" % (args.to_npz, len(y_train), len(y_test)))
+    elif args.from_npz and args.to_tfrecords:
+        data = np.load(args.from_npz)
+        to_u8 = lambda x: np.clip(np.asarray(x, np.float64) * (255.0 if x.dtype.kind == "f" else 1.0), 0, 255).astype(np.uint8)
+        for split, (x, y) in (("train", (data["x_train"], data["y_train"])),
+                              ("test", (data["x_test"], data["y_test"]))):
+            path = tfrecord.write_cifar10_split(args.to_tfrecords, split, to_u8(x), y.ravel())
+            print("wrote %s (%d records)" % (path, len(y)))
+    else:
+        parser.error("pick one direction: --from-tfrecords + --to-npz, or --from-npz + --to-tfrecords")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

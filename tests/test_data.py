@@ -1,0 +1,158 @@
+"""Real-data path tests: TFRecord codec, loader fallback, conversion script,
+and the accuracy-target convergence test that activates on real data."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu.models import datasets, tfrecord
+from aggregathor_tpu.utils import UserException
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors for CRC32C (Castagnoli)
+    assert tfrecord.crc32c(b"123456789") == 0xE3069283
+    assert tfrecord.crc32c(b"") == 0
+    assert tfrecord.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_tfrecord_framing_roundtrip(tmp_path):
+    path = str(tmp_path / "x.tfrecord")
+    payloads = [b"abc", b"", b"\x00\xff" * 100]
+    tfrecord.write_tfrecords(path, payloads)
+    assert list(tfrecord.iter_tfrecords(path)) == payloads
+
+
+def test_tfrecord_corruption_detected(tmp_path):
+    path = str(tmp_path / "x.tfrecord")
+    tfrecord.write_tfrecords(path, [b"payload-bytes"])
+    data = bytearray(open(path, "rb").read())
+    data[14] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(UserException):
+        list(tfrecord.iter_tfrecords(path))
+
+
+def test_example_roundtrip():
+    built = tfrecord.build_example({
+        "image/encoded": b"\x89PNG-ish",
+        "image/format": b"png",
+        "image/class/label": 7,
+        "image/height": 32,
+    })
+    parsed = tfrecord.parse_example(built)
+    assert parsed["image/encoded"] == [b"\x89PNG-ish"]
+    assert parsed["image/format"] == [b"png"]
+    assert parsed["image/class/label"] == [7]
+    assert parsed["image/height"] == [32]
+
+
+def _fixture_images(count, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(count, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=count).astype(np.int32)
+    return images, labels
+
+
+def test_cifar10_shard_roundtrip(tmp_path):
+    images, labels = _fixture_images(12)
+    tfrecord.write_cifar10_split(str(tmp_path), "train", images, labels)
+    back_x, back_y = tfrecord.read_cifar10_split(str(tmp_path), "train")
+    np.testing.assert_array_equal(back_x, images)  # PNG is lossless
+    np.testing.assert_array_equal(back_y, labels)
+
+
+def test_load_cifar10_from_tfrecords(tmp_path, monkeypatch):
+    images, labels = _fixture_images(10, seed=1)
+    test_images, test_labels = _fixture_images(4, seed=2)
+    tfrecord.write_cifar10_split(str(tmp_path / "cifar10"), "train", images, labels)
+    tfrecord.write_cifar10_split(str(tmp_path / "cifar10"), "test", test_images, test_labels)
+    monkeypatch.setenv("AGGREGATHOR_DATA", str(tmp_path))
+    data = datasets.load_cifar10()
+    assert not data.synthetic
+    assert data.x_train.shape == (10, 32, 32, 3)
+    np.testing.assert_allclose(data.x_train, images.astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(data.y_test, test_labels)
+
+
+def test_convert_script_both_ways(tmp_path):
+    images, labels = _fixture_images(8, seed=3)
+    test_images, test_labels = _fixture_images(3, seed=4)
+    src = str(tmp_path / "shards")
+    tfrecord.write_cifar10_split(src, "train", images, labels)
+    tfrecord.write_cifar10_split(src, "test", test_images, test_labels)
+    npz = str(tmp_path / "cifar10.npz")
+    script = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          "scripts", "convert_cifar10.py")
+    subprocess.run([sys.executable, script, "--from-tfrecords", src, "--to-npz", npz],
+                   check=True, capture_output=True)
+    data = np.load(npz)
+    np.testing.assert_array_equal(data["x_train"], images)
+    np.testing.assert_array_equal(data["y_test"], test_labels)
+    # and back again
+    dst = str(tmp_path / "shards2")
+    subprocess.run([sys.executable, script, "--from-npz", npz, "--to-tfrecords", dst],
+                   check=True, capture_output=True)
+    back_x, back_y = tfrecord.read_cifar10_split(dst, "train")
+    np.testing.assert_array_equal(back_x, images)
+    np.testing.assert_array_equal(back_y, labels)
+
+
+def _train_and_eval_mnist(nb_steps, gar_name="krum", f=1, lr=0.1):
+    import jax
+    import optax
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.parallel import RobustEngine, make_mesh
+
+    exp = models.instantiate("mnist", ["batch-size:64"])
+    engine = RobustEngine(make_mesh(nb_workers=4), gars.instantiate(gar_name, 4, f), 4)
+    tx = optax.sgd(lr)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    step = engine.build_step(exp.loss, tx)
+    it = exp.make_train_iterator(4, seed=0)
+    for _ in range(nb_steps):
+        state, _ = step(state, engine.shard_batch(next(it)))
+    ev = engine.build_eval_sums(exp.metrics)
+    sums = None
+    for batch in exp.make_eval_iterator(4):
+        folded = jax.device_get(ev(state, engine.shard_batch(batch)))
+        sums = folded if sums is None else jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
+    return float(sums["accuracy"][0]) / float(sums["accuracy"][1])
+
+
+def test_mnist_accuracy_target_synthetic():
+    """Accuracy-target convergence on whatever data is present.
+
+    The synthetic stand-in is class-conditional Gaussians whose intrinsic
+    hardness is set by the noise level, so the target is *relative*: the
+    nearest-class-mean classifier is (approximately) Bayes-optimal for this
+    generative family, and robust training must reach >=80% of its accuracy
+    — 'trains correctly' verified by accuracy, not just loss-went-down."""
+    data = datasets.load_mnist()
+    means = np.stack([
+        data.x_train[data.y_train == c].mean(axis=0).ravel() for c in range(10)
+    ])  # (10, d) estimated class means ~ the generative templates
+    flat_test = data.x_test.reshape(len(data.y_test), -1)
+    # nearest mean under squared distance == argmax of the linear score
+    scores = flat_test @ means.T - 0.5 * np.sum(means * means, axis=1)
+    bayes_accuracy = float(np.mean(np.argmax(scores, axis=1) == data.y_test))
+    accuracy = _train_and_eval_mnist(300)
+    assert bayes_accuracy > 0.3, "fixture degenerated: bayes %.3f" % bayes_accuracy
+    assert accuracy >= 0.8 * bayes_accuracy, (
+        "accuracy %.3f below 80%% of the %.3f near-optimal bar" % (accuracy, bayes_accuracy)
+    )
+
+
+def test_mnist_accuracy_target_on_real_data():
+    """North-star accuracy check (BASELINE.md): activates only when a real
+    mnist.npz is present (zero-egress environments fall back to synthetic
+    data, where loss-goes-down convergence tests in test_engine.py apply)."""
+    data = datasets.load_mnist()
+    if data.synthetic:
+        pytest.skip("no real mnist.npz on disk (synthetic stand-in active)")
+    accuracy = _train_and_eval_mnist(300)
+    assert accuracy >= 0.9, "MNIST accuracy %.3f below target after 300 robust steps" % accuracy
