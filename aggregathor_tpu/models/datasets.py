@@ -23,7 +23,7 @@ import os
 
 import numpy as np
 
-from ..utils import info, warning
+from ..utils import UserException, can_access, info, warning
 
 
 def _data_dirs():
@@ -82,6 +82,10 @@ def _synthetic_classification(name, shape, nb_classes, nb_train, nb_test, seed, 
 
 
 def _load_npz(path, shape, scale):
+    # Fail with a clear message before a long run starts, like the reference
+    # validates its dataset dirs up front (tools/access.py via slims.py:183).
+    if not can_access(path, read=True):
+        raise UserException("Dataset file %r exists but is not readable" % path)
     data = np.load(path)
     def prep(x):
         x = x.astype(np.float32) / scale

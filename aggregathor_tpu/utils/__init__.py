@@ -22,3 +22,4 @@ from .logging import (  # noqa: F401
 from .registry import ClassRegister  # noqa: F401
 from .keyval import parse_keyval  # noqa: F401
 from .plugins import import_directory  # noqa: F401
+from .access import can_access  # noqa: F401

@@ -1,0 +1,138 @@
+"""BASELINE.json training-config benchmark: steps/s through the real CLI.
+
+Each entry launches ``aggregathor_tpu.cli.runner`` as a subprocess — the
+exact surface a user drives, paying the full input pipeline, host->device
+transfer, and metric plumbing — and parses the end-of-run performance report
+(the reference's own metric: steps/s excluding the first/compilation step,
+reference runner.py:595-597).
+
+Configs follow BASELINE.md's protocol, sized per worker so the largest ones
+fit a single chip; the JSON output records every sizing knob so numbers are
+only ever compared like-for-like.
+
+Usage::
+
+    python benchmarks/train_configs.py [--configs 1,2,3,4] [--steps 40]
+                                       [--platform tpu]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: BASELINE.md config table (batch = per-worker batch size)
+CONFIGS = {
+    "1": {
+        "name": "mnist_average_n4_f0",
+        "note": "BASELINE config 1 (single-host CPU reference)",
+        "args": ["--experiment", "mnist", "--aggregator", "average",
+                 "--nb-workers", "4", "--nb-decl-byz-workers", "0",
+                 "--experiment-args", "batch-size:50"],
+        "platform": "cpu",  # the config IS the CPU reference
+    },
+    "2": {
+        "name": "cnnet_krum_n8_f2",
+        "note": "BASELINE config 2 (bench.py measures this too, in-process)",
+        "args": ["--experiment", "cnnet", "--aggregator", "krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+                 "--experiment-args", "batch-size:128"],
+    },
+    "3": {
+        "name": "resnet50_bulyan_n32_f8",
+        "note": "BASELINE config 3; ImageNet-shaped synthetic stand-in, "
+                "per-worker batch 4 at 128x128 to fit one chip",
+        "args": ["--experiment", "slim-resnet_v1_50-imagenet", "--aggregator", "bulyan",
+                 "--nb-workers", "32", "--nb-decl-byz-workers", "8",
+                 "--experiment-args", "batch-size:4", "image-size:128", "dtype:bfloat16"],
+    },
+    "4": {
+        "name": "inception_v3_median_little_n32_f8",
+        "note": "BASELINE config 4: coordinate-median under a real 'little' "
+                "omniscient attack from 8 of 32 workers",
+        "args": ["--experiment", "slim-inception_v3-imagenet", "--aggregator", "median",
+                 "--nb-workers", "32", "--nb-decl-byz-workers", "8",
+                 "--nb-real-byz-workers", "8", "--attack", "little",
+                 "--experiment-args", "batch-size:4", "image-size:128", "dtype:bfloat16"],
+    },
+}
+
+_PERF_RE = re.compile(r"steps/s \(excl\. 1st\)\s+([0-9.]+)")
+
+
+def run_config(key, steps, platform, timeout):
+    cfg = CONFIGS[key]
+    env = dict(os.environ)
+    use_platform = cfg.get("platform", platform)
+    summary_dir = tempfile.mkdtemp(prefix="aggregathor_bench_sum_%s_" % cfg["name"])
+    cmd = [sys.executable, "-m", "aggregathor_tpu.cli.runner"] + cfg["args"] + [
+        "--max-step", str(steps),
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--summary-dir", summary_dir, "--summary-delta", str(steps),
+    ]
+    if use_platform:
+        cmd += ["--platform", use_platform]
+        env["JAX_PLATFORMS"] = use_platform
+    if use_platform == "cpu":
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        cmd += ["--nb-devices", "4" if key == "1" else "8"]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+    out = proc.stdout + proc.stderr
+    match = _PERF_RE.search(out)
+    result = {
+        "metric": "train_steps_per_s",
+        "config": cfg["name"],
+        "note": cfg["note"],
+        "steps": steps,
+        "platform": use_platform or "ambient",
+        "value": float(match.group(1)) if match else None,
+        "unit": "steps/s",
+        "rc": proc.returncode,
+    }
+    # final summary JSONL has the last total_loss
+    try:
+        events = []
+        for path in glob.glob(os.path.join(summary_dir, "*")):
+            events += [json.loads(line) for line in open(path)]
+        if events:
+            result["final_loss"] = events[-1].get("total_loss")
+    except Exception:
+        pass
+    finally:
+        shutil.rmtree(summary_dir, ignore_errors=True)
+    if proc.returncode != 0 and match is None:
+        result["error"] = out.strip()[-500:]
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--platform", default=None, help="platform for non-CPU configs (default ambient)")
+    ap.add_argument("--timeout", type=int, default=1200)
+    args = ap.parse_args()
+    for key in args.configs.split(","):
+        key = key.strip()
+        # One hung config (e.g. a wedged accelerator) or a bad key must not
+        # abort the sweep: every requested config gets exactly one JSON line.
+        try:
+            result = run_config(key, args.steps, args.platform, args.timeout)
+        except KeyError:
+            result = {"metric": "train_steps_per_s", "config": key, "value": None,
+                      "error": "unknown config (have: %s)" % ",".join(sorted(CONFIGS))}
+        except subprocess.TimeoutExpired:
+            result = {"metric": "train_steps_per_s", "config": CONFIGS[key]["name"],
+                      "value": None, "error": "timed out after %ds" % args.timeout}
+        print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,29 @@ def test_runner_end_to_end(tmp_path):
     assert all("total_loss" in ev for ev in events)
 
 
+def test_runner_steady_state_cadences(tmp_path):
+    """Longer run where delta cadences fire repeatedly in steady state (not
+    just the fire-at-start and final-fire paths): 60 steps with deltas 10/20
+    must produce the full arithmetic progression of firings."""
+    eval_file = str(tmp_path / "eval.tsv")
+    ckpt_dir = str(tmp_path / "ckpt")
+    assert 0 == run([
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "average", "--nb-workers", "4",
+        "--learning-rate-args", "initial-rate:0.01",
+        "--max-step", "60",
+        "--evaluation-delta", "20", "--evaluation-period", "-1",
+        "--evaluation-file", eval_file,
+        "--checkpoint-dir", ckpt_dir, "--checkpoint-delta", "10",
+        "--checkpoint-period", "-1", "--checkpoint-keep", "0",
+    ])
+    eval_steps = [int(line.split("\t")[1]) for line in open(eval_file).read().strip().splitlines()]
+    # fires at start (step 1), then every >= 20 steps, then the final fire
+    assert eval_steps == [1, 21, 41, 60], eval_steps
+    ckpt_steps = sorted(int(n.split("-")[1].split(".")[0]) for n in os.listdir(ckpt_dir))
+    assert ckpt_steps == [1, 11, 21, 31, 41, 51, 60], ckpt_steps
+
+
 def test_runner_resume(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")
     base = [

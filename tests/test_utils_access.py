@@ -1,0 +1,31 @@
+"""Tests for the can_access pre-check (reference tools/access.py parity)."""
+
+import os
+
+from aggregathor_tpu.utils import can_access
+
+
+def test_can_access_file(tmp_path):
+    f = tmp_path / "x.txt"
+    f.write_text("hi")
+    assert can_access(str(f), read=True)
+    assert can_access(str(f), read=True, write=True)
+    assert not can_access(str(tmp_path / "missing"), read=True)
+
+
+def test_can_access_dir_recurse(tmp_path):
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "a.txt").write_text("a")
+    assert can_access(str(tmp_path), read=True, recurse=True)
+    if os.geteuid() != 0:  # root bypasses mode bits
+        os.chmod(sub / "a.txt", 0o000)
+        assert not can_access(str(tmp_path), read=True, recurse=True)
+        assert can_access(str(tmp_path), read=True, recurse=False)
+        os.chmod(sub / "a.txt", 0o644)
+
+
+def test_can_access_write_only_check(tmp_path):
+    f = tmp_path / "w.txt"
+    f.write_text("")
+    assert can_access(str(f), write=True)
