@@ -82,11 +82,12 @@ def _synthetic_classification(name, shape, nb_classes, nb_train, nb_test, seed, 
 
 
 def _load_npz(path, shape, scale):
-    # Fail with a clear message before a long run starts, like the reference
-    # validates its dataset dirs up front (tools/access.py via slims.py:183).
-    if not can_access(path, read=True):
-        raise UserException("Dataset file %r exists but is not readable" % path)
-    data = np.load(path)
+    try:
+        data = np.load(path)
+    except OSError as exc:
+        # A clear startup message instead of a mid-pipeline traceback, like
+        # the reference's up-front dir validation (tools/access.py).
+        raise UserException("Cannot load dataset %r: %s" % (path, exc))
     def prep(x):
         x = x.astype(np.float32) / scale
         return x.reshape((x.shape[0],) + shape)
@@ -112,6 +113,9 @@ def _find_cifar10_tfrecords():
     for dirname in _data_dirs():
         for candidate in (dirname, os.path.join(dirname, "cifar10")):
             if has_cifar10_tfrecords(candidate):
+                if not can_access(candidate, read=True):
+                    warning("CIFAR-10 shards at %r are not readable; skipping" % candidate)
+                    continue
                 return candidate
     return None
 

@@ -25,8 +25,8 @@ import tempfile
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ("kernels.cpp", "auth.cpp", "threadpool.hpp")
-_COMPILE_UNITS = ("kernels.cpp", "auth.cpp")
+_SOURCES = ("kernels.cpp", "auth.cpp", "io.cpp", "threadpool.hpp")
+_COMPILE_UNITS = ("kernels.cpp", "auth.cpp", "io.cpp")
 _LIBNAME = "libagtpu_host.so"
 
 _lib = None
@@ -101,6 +101,11 @@ def _declare(lib):
         fn.argtypes = [ptr, i64, i64, f64p]
     u8p = ctypes.POINTER(ctypes.c_uint8)
     size_t = ctypes.c_size_t
+    i64p = ctypes.POINTER(i64)
+    lib.agtpu_crc32c.restype = ctypes.c_uint32
+    lib.agtpu_crc32c.argtypes = [u8p, size_t]
+    lib.agtpu_tfrecord_index.restype = i64
+    lib.agtpu_tfrecord_index.argtypes = [u8p, i64, i64p, i64p, i64, ctypes.c_int]
     lib.agtpu_sha256.restype = None
     lib.agtpu_sha256.argtypes = [u8p, size_t, u8p]
     lib.agtpu_hmac_sha256.restype = None
@@ -207,6 +212,42 @@ def pairwise_sq_distances(grads):
     fn = getattr(lib, "agtpu_pairwise_sqdist_%s" % suffix)
     fn(_ptr(g, ctype), n, d, _ptr(out, ctypes.c_double))
     return out
+
+
+# --------------------------------------------------------------------------- #
+# TFRecord IO (io.cpp; the fast path behind models/tfrecord.py)
+
+def crc32c(data):
+    """CRC32C (Castagnoli) of bytes/uint8 array — the TFRecord checksum."""
+    lib = load()
+    _, ptr, length = _u8(data)
+    return int(lib.agtpu_crc32c(ptr, length))
+
+
+def tfrecord_index(buf, verify=True):
+    """Index a whole TFRecord shard held in ``buf`` (bytes/mmap/uint8 array).
+
+    Returns (offsets, lengths) int64 arrays — payload i is
+    ``buf[offsets[i]:offsets[i]+lengths[i]]``.  With ``verify`` all framing
+    CRCs are checked (payloads in parallel on the thread pool).  Raises
+    ValueError at the first corrupt byte offset.
+    """
+    lib = load()
+    arr, ptr, length = _u8(buf)
+    # every record is >= 16 bytes of framing
+    cap = max(1, length // 16 + 1)
+    offsets = np.empty(cap, dtype=np.int64)
+    lengths = np.empty(cap, dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    count = int(lib.agtpu_tfrecord_index(
+        ptr, length,
+        offsets.ctypes.data_as(i64p), lengths.ctypes.data_as(i64p),
+        cap, 1 if verify else 0,
+    ))
+    if count < 0:
+        raise ValueError("corrupt TFRecord framing at byte %d" % (-count - 1))
+    # copies: slicing views would pin the file-sized scratch allocation
+    return offsets[:count].copy(), lengths[:count].copy()
 
 
 # --------------------------------------------------------------------------- #

@@ -46,9 +46,12 @@ def main():
         os.environ["JAX_PLATFORMS"] = args.platform
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-    if args.platform == "cpu":
+    resolved = args.platform or os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if resolved:
+        # config-level pin: the env var alone is overridden by ambient
+        # accelerator plugins (cli/runner.py does the same dance)
+        jax.config.update("jax_platforms", resolved)
+    if resolved == "cpu":
         # before any backend init (jax.devices() would lock the count)
         import re
 

@@ -72,6 +72,13 @@ def run_config(key, steps, platform, timeout):
     env = dict(os.environ)
     use_platform = cfg.get("platform", platform)
     summary_dir = tempfile.mkdtemp(prefix="aggregathor_bench_sum_%s_" % cfg["name"])
+    try:
+        return _run_config(cfg, steps, use_platform, timeout, env, summary_dir, key)
+    finally:
+        shutil.rmtree(summary_dir, ignore_errors=True)
+
+
+def _run_config(cfg, steps, use_platform, timeout, env, summary_dir, key):
     cmd = [sys.executable, "-m", "aggregathor_tpu.cli.runner"] + cfg["args"] + [
         "--max-step", str(steps),
         "--evaluation-delta", "-1", "--evaluation-period", "-1",
@@ -105,8 +112,6 @@ def run_config(key, steps, platform, timeout):
             result["final_loss"] = events[-1].get("total_loss")
     except Exception:
         pass
-    finally:
-        shutil.rmtree(summary_dir, ignore_errors=True)
     if proc.returncode != 0 and match is None:
         result["error"] = out.strip()[-500:]
     return result

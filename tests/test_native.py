@@ -112,3 +112,30 @@ def test_rebuild_is_incremental(tmp_path):
     mtime = native.os.path.getmtime(path)
     assert native.build() == path
     assert native.os.path.getmtime(path) == mtime
+
+
+def test_native_crc32c_and_tfrecord_index(tmp_path):
+    """io.cpp cross-checked against the pure-Python tier (models/tfrecord.py)."""
+    from aggregathor_tpu.models import tfrecord
+
+    rng = np.random.default_rng(3)
+    for size in (0, 1, 7, 8, 9, 4096):
+        data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+        assert native.crc32c(data) == tfrecord.crc32c(data)
+
+    payloads = [b"a", b"", rng.integers(0, 256, size=1000, dtype=np.uint8).tobytes()]
+    path = str(tmp_path / "x.tfrecord")
+    tfrecord.write_tfrecords(path, payloads)
+    buf = open(path, "rb").read()
+    offsets, lengths = native.tfrecord_index(buf)
+    assert [buf[o:o + l] for o, l in zip(offsets, lengths)] == payloads
+
+    corrupt = bytearray(buf)
+    corrupt[14] ^= 0xFF  # a payload byte of record 0
+    import pytest
+
+    with pytest.raises(ValueError):
+        native.tfrecord_index(bytes(corrupt))
+    # verify=False skips checksums entirely (fast path when trust is external)
+    offsets2, _ = native.tfrecord_index(bytes(corrupt), verify=False)
+    assert len(offsets2) == len(payloads)
