@@ -1,0 +1,75 @@
+"""Centered-clipping GAR (Karimireddy, He, Jaggi 2021, "Learning from
+History for Byzantine Robust Optimization").
+
+An extension beyond the reference's rule set: iteratively re-estimate the
+center ``v`` by averaging *clipped* deviations,
+
+    v  <-  v + (1/n) sum_i  (g_i - v) * min(1, tau / |g_i - v|),
+
+a fixed number of iterations from the coordinate-wise median.  Honest
+gradients move the center; Byzantine ones contribute at most ``tau`` of
+displacement each, so the estimator tolerates up to f < n/2 attackers with
+a bias bounded by tau — and unlike Krum/Bulyan it needs NO pairwise
+distances (O(n·d) per iteration, bandwidth-bound, ideal on TPU).
+
+TPU mapping: each iteration is one norm reduction + one axpy over the
+(n, d) matrix — on the sharded engine the per-row norms need one extra
+O(n) psum per iteration across dimension blocks, so the rule is marked
+``coordinate_wise = False`` with ``needs_distances = False`` and aggregates
+on the gathered rows (the engine's existing blockwise path applies it per
+block with block-local norms, a documented approximation the dense tier
+does not make).
+
+Non-finite rows clip to radius tau in an arbitrary direction would poison
+the center, so rows with any non-finite coordinate are excluded from every
+iteration (their clipped contribution is zero) — the NaN-absorbing behavior
+of average-nan, which this rule generalizes.
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+
+
+def centered_clip(rows, tau, iters):
+    """Iterative clipped-deviation center of the (n, d_block) rows."""
+    finite_row = jnp.all(jnp.isfinite(rows), axis=-1, keepdims=True)
+    safe = jnp.where(finite_row, rows, 0.0)
+    nb_alive = jnp.maximum(jnp.sum(finite_row.astype(jnp.float32)), 1.0)
+    # robust start: coordinate-wise median of the finite rows
+    center = jnp.nan_to_num(
+        jnp.nanmedian(jnp.where(finite_row, rows, jnp.nan), axis=0)
+    )
+    for _ in range(iters):
+        deviation = safe - center[None, :]
+        norms = jnp.sqrt(jnp.sum(deviation * deviation, axis=-1, keepdims=True))
+        scale = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-12))
+        clipped = deviation * scale * finite_row
+        center = center + jnp.sum(clipped, axis=0) / nb_alive
+    return center
+
+
+class CenteredClipGAR(GAR):
+    coordinate_wise = False
+    needs_distances = False
+    ARG_DEFAULTS = {"tau": 10.0, "iters": 3}
+
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        self.tau = float(self.args["tau"])
+        self.iters = int(self.args["iters"])
+        from ..utils import UserException
+
+        if self.tau <= 0 or self.iters < 1:
+            raise UserException("centered-clip needs tau > 0 and iters >= 1")
+        if self.nb_workers <= 2 * self.nb_byz_workers:
+            from ..utils import warning
+
+            warning("centered-clip tolerates f < n/2; n=%d f=%d is out of bound"
+                    % (self.nb_workers, self.nb_byz_workers))
+
+    def aggregate_block(self, block, dist2=None):
+        return centered_clip(block, self.tau, self.iters)
+
+
+register("centered-clip", CenteredClipGAR)

@@ -135,3 +135,31 @@ def bulyan(grads, f):
         closest = col[np.argsort(dev, kind="stable")[:b]]
         out[x] = np.mean(closest)
     return out
+
+
+def trimmed_mean(grads, f, trim=None):
+    """Coordinate-wise b-trimmed mean (extension; see gars/trimmed_mean.py)."""
+    grads = np.asarray(grads, dtype=np.float64)
+    n, _ = grads.shape
+    b = f if trim is None else trim
+    clean = np.where(np.isfinite(grads), grads, np.inf)
+    ordered = np.sort(clean, axis=0)[b:n - b]
+    out = ordered.mean(axis=0)
+    return np.where(np.isfinite(out), out, np.nan)
+
+
+def centered_clip(grads, f, tau=10.0, iters=3):
+    """Iterative clipped-deviation center (extension; see gars/centered_clip.py)."""
+    grads = np.asarray(grads, dtype=np.float64)
+    finite_row = np.all(np.isfinite(grads), axis=-1, keepdims=True)
+    safe = np.where(finite_row, grads, 0.0)
+    nb_alive = max(float(finite_row.sum()), 1.0)
+    masked = np.where(finite_row, grads, np.nan)
+    with np.errstate(all="ignore"):
+        center = np.nan_to_num(np.nanmedian(masked, axis=0))
+    for _ in range(iters):
+        deviation = safe - center[None, :]
+        norms = np.sqrt((deviation * deviation).sum(axis=-1, keepdims=True))
+        scale = np.minimum(1.0, tau / np.maximum(norms, 1e-12))
+        center = center + (deviation * scale * finite_row).sum(axis=0) / nb_alive
+    return center

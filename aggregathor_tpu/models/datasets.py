@@ -82,11 +82,14 @@ def _synthetic_classification(name, shape, nb_classes, nb_train, nb_test, seed, 
 
 
 def _load_npz(path, shape, scale):
+    import zipfile
+
     try:
         data = np.load(path)
-    except OSError as exc:
+    except (OSError, ValueError, zipfile.BadZipFile) as exc:
         # A clear startup message instead of a mid-pipeline traceback, like
-        # the reference's up-front dir validation (tools/access.py).
+        # the reference's up-front dir validation (tools/access.py); covers
+        # unreadable files AND corrupt/truncated archives.
         raise UserException("Cannot load dataset %r: %s" % (path, exc))
     def prep(x):
         x = x.astype(np.float32) / scale

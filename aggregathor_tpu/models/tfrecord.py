@@ -75,14 +75,33 @@ def iter_tfrecords(path):
     except Exception:
         pass
     if use_native:
+        import mmap
+
+        import numpy as np
+
         with open(path, "rb") as fd:
-            buf = fd.read()
+            if os.fstat(fd.fileno()).st_size == 0:
+                return
+            buf = mmap.mmap(fd.fileno(), 0, access=mmap.ACCESS_READ)
         try:
-            offsets, lengths = native.tfrecord_index(buf)
-        except ValueError as exc:
-            raise UserException("%s in %r" % (exc, path))
-        for offset, length in zip(offsets, lengths):
-            yield buf[offset:offset + length]
+            # Lifetime care: every numpy view over the mmap must be dropped
+            # before close() or it raises BufferError — including views
+            # pinned by exception tracebacks, so the ValueError is fully
+            # handled (its frames released) before a fresh error is raised.
+            view = np.frombuffer(buf, dtype=np.uint8)
+            error = None
+            try:
+                offsets, lengths = native.tfrecord_index(view)
+            except ValueError as exc:
+                error = "%s in %r" % (exc, path)
+            finally:
+                del view
+            if error is not None:
+                raise UserException(error)
+            for offset, length in zip(offsets, lengths):
+                yield bytes(buf[offset:offset + length])
+        finally:
+            buf.close()
         return
     with open(path, "rb") as fd:
         while True:

@@ -35,7 +35,11 @@ def run_steps(exp, engine, step, state, count, seed=3):
     return state, losses
 
 
-@pytest.mark.parametrize("gar_name,f", [("average", 0), ("median", 1), ("krum", 1), ("bulyan", 1)])
+@pytest.mark.parametrize(
+    "gar_name,f",
+    [("average", 0), ("median", 1), ("krum", 1), ("bulyan", 1),
+     ("trimmed-mean", 1), ("centered-clip", 1)],
+)
 def test_training_decreases_loss(gar_name, f):
     exp, engine, step, state = make_setup(gar_name, n=8, f=f)
     state, losses = run_steps(exp, engine, step, state, 25)

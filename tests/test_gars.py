@@ -6,7 +6,8 @@ import pytest
 from aggregathor_tpu import gars
 from aggregathor_tpu.gars import oracle
 
-RULES = ["average", "average-nan", "median", "averaged-median", "krum", "bulyan"]
+RULES = ["average", "average-nan", "median", "averaged-median", "krum", "bulyan",
+         "trimmed-mean", "centered-clip"]
 ORACLES = {
     "average": oracle.average,
     "average-nan": oracle.average_nan,
@@ -14,6 +15,8 @@ ORACLES = {
     "averaged-median": oracle.averaged_median,
     "krum": oracle.krum,
     "bulyan": oracle.bulyan,
+    "trimmed-mean": oracle.trimmed_mean,
+    "centered-clip": oracle.centered_clip,
 }
 
 
@@ -22,7 +25,8 @@ def make_grads(rng, n=11, d=37, scale=1.0):
 
 
 def params_for(rule):
-    # bulyan needs n >= 4f + 3; krum n >= f + 3
+    # bulyan needs n >= 4f + 3; krum n >= f + 3; trimmed-mean n > 2f;
+    # centered-clip f < n/2
     return {"bulyan": (11, 2), "krum": (11, 3)}.get(rule, (11, 3))
 
 
@@ -48,7 +52,9 @@ def test_permutation_equivariance(rule, rng):
     np.testing.assert_allclose(shuffled, base, rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("rule", ["median", "averaged-median", "krum", "bulyan"])
+@pytest.mark.parametrize(
+    "rule", ["median", "averaged-median", "krum", "bulyan", "trimmed-mean", "centered-clip"]
+)
 def test_byzantine_robustness(rule, rng):
     """With f adversarial rows pushing a huge vector, the aggregate must stay
     within the honest cloud (Byzantine-bound sanity; SURVEY.md §4)."""
@@ -141,3 +147,48 @@ def test_registry_lists_all_rules():
     names = gars.itemize()
     for rule in RULES:
         assert rule in names
+
+
+def test_trimmed_mean_nan_columns(rng):
+    """A column with more than `trim` poisoned entries surfaces NaN, never a
+    silently-huge mean; columns within the trim budget stay clean."""
+    grads = make_grads(rng, n=9)
+    grads[:2, 0] = np.inf  # within trim=2 budget
+    grads[:3, 1] = np.nan  # exceeds it
+    gar = gars.instantiate("trimmed-mean", 9, 2)
+    out = np.asarray(gar.aggregate(grads))
+    assert np.isfinite(out[0])
+    assert np.isnan(out[1])
+
+
+def test_trimmed_mean_trim_arg(rng):
+    grads = make_grads(rng, n=9)
+    default = np.asarray(gars.instantiate("trimmed-mean", 9, 2).aggregate(grads))
+    explicit = np.asarray(gars.instantiate("trimmed-mean", 9, 2, ["trim:2"]).aggregate(grads))
+    np.testing.assert_allclose(default, explicit)
+    wider = np.asarray(gars.instantiate("trimmed-mean", 9, 2, ["trim:4"]).aggregate(grads))
+    assert not np.allclose(default, wider)
+
+
+def test_centered_clip_bias_bound(rng):
+    """f Byzantine rows can displace the center by at most iters*f*tau/n."""
+    n, f, tau, iters = 11, 3, 1.0, 3
+    grads = make_grads(rng, n=n, scale=0.1)
+    attacked = grads.copy()
+    attacked[:f] = 1e6
+    gar = gars.instantiate("centered-clip", n, f, ["tau:%s" % tau, "iters:%d" % iters])
+    clean = np.asarray(gar.aggregate(grads))
+    dirty = np.asarray(gar.aggregate(attacked))
+    displacement = np.linalg.norm(dirty - clean)
+    assert displacement <= iters * f * tau / n + 1.0, displacement
+
+
+def test_centered_clip_excludes_nonfinite_rows(rng):
+    grads = make_grads(rng, n=8)
+    grads[1, 3] = np.nan
+    gar = gars.instantiate("centered-clip", 8, 1)
+    out = np.asarray(gar.aggregate(grads))
+    assert np.all(np.isfinite(out))
+    # removing the poisoned row entirely gives a nearby center
+    alone = np.asarray(gars.instantiate("centered-clip", 7, 1).aggregate(grads[[0] + list(range(2, 8))]))
+    np.testing.assert_allclose(out, alone, rtol=1e-3, atol=1e-4)
