@@ -77,11 +77,41 @@ def build_parser():
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
     parser.add_argument("--stdout-to", default=None, help="replicate stdout to this file")
     parser.add_argument("--stderr-to", default=None, help="replicate stderr to this file")
+    # Device-preference flags (reference: runner.py:196-211): map to a JAX
+    # platform priority list when --platform is not forced.
+    parser.add_argument("--use-tpu", action="store_true", help="prefer TPU devices if available")
+    parser.add_argument("--use-gpu", action="store_true", help="prefer GPU devices if available")
+    parser.add_argument("--reuse-tpu", action="store_true",
+                        help="compat: implies --use-tpu (device sharing is inherent under SPMD)")
+    parser.add_argument("--reuse-gpu", action="store_true",
+                        help="compat: implies --use-gpu (device sharing is inherent under SPMD)")
+    # Drop-in compatibility: flags whose mechanism dissolved under the
+    # single-controller SPMD design (docs/transport.md) — accepted so the
+    # reference's driver scripts run unchanged, warned about once.
+    for flag, meta in (
+        ("--client", "TARGET"), ("--server", "SPEC"), ("--ps-job-name", "NAME"),
+        ("--ev-job-name", "NAME"), ("--wk-job-name", "NAME"),
+    ):
+        parser.add_argument(flag, default=None, metavar=meta,
+                            help="compat no-op: cluster/session topology dissolved under SPMD")
+    parser.add_argument("--MPI", action="store_true", dest="mpi",
+                        help="compat no-op: transport is XLA collectives over ICI/DCN")
+    parser.add_argument("--no-wait", action="store_true",
+                        help="compat no-op: there is no server process to linger")
     return parser
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    device_preference = None
+    if not args.platform and (args.use_tpu or args.use_gpu or args.reuse_tpu or args.reuse_gpu):
+        # preference order like the reference's allocator (runner.py:282-287):
+        # TPU > GPU > CPU among the requested kinds, CPU always the fallback
+        device_preference = []
+        if args.use_tpu or args.reuse_tpu:
+            device_preference.append("tpu")
+        if args.use_gpu or args.reuse_gpu:
+            device_preference.append("gpu")
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
 
@@ -96,6 +126,23 @@ def main(argv=None):
         # long as no backend has been initialized yet (tests/conftest.py has
         # the same dance).
         jax.config.update("jax_platforms", args.platform)
+    elif device_preference is not None:
+        # "use X if available" (reference allocator semantics): try the
+        # preference list; when this installation cannot even name the
+        # backend, fall through to CPU like the reference does when no such
+        # device exists in the cluster.
+        # JAX's platform list is strict (one uninitializable backend fails the
+        # whole list), so retry progressively shorter suffixes: a GPU host
+        # without libtpu still lands on its GPU, not on CPU.
+        candidates = device_preference + ["cpu"]
+        for start in range(len(candidates)):
+            args.platform = ",".join(candidates[start:])
+            jax.config.update("jax_platforms", args.platform)
+            try:
+                jax.devices()
+                break
+            except RuntimeError:
+                continue
     effective_platform = args.platform or os.environ.get("JAX_PLATFORMS", "")
     if effective_platform == "cpu" and args.nb_devices and args.nb_devices > 1:
         jax.config.update("jax_num_cpu_devices", args.nb_devices)
@@ -108,6 +155,17 @@ def main(argv=None):
     from ..utils import Context, UserException, info, replicate_streams, warning
 
     replicate_streams(args.stdout_to, args.stderr_to)
+
+    ignored = [flag for flag, value in (
+        ("--client", args.client), ("--server", args.server),
+        ("--ps-job-name", args.ps_job_name), ("--ev-job-name", args.ev_job_name),
+        ("--wk-job-name", args.wk_job_name), ("--MPI", args.mpi), ("--no-wait", args.no_wait),
+    ) if value]
+    if ignored:
+        warning(
+            "Compat no-op flags ignored (cluster topology and transport dissolved "
+            "under single-controller SPMD, see docs/transport.md): %s" % " ".join(ignored)
+        )
 
     # Worker-count sanity (reference: runner.py:253-260)
     n, f, r = args.nb_workers, args.nb_decl_byz_workers, args.nb_real_byz_workers

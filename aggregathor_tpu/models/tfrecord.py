@@ -77,8 +77,6 @@ def iter_tfrecords(path):
     if use_native:
         import mmap
 
-        import numpy as np
-
         with open(path, "rb") as fd:
             if os.fstat(fd.fileno()).st_size == 0:
                 return

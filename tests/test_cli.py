@@ -114,6 +114,30 @@ def test_deploy_local_simulate(tmp_path):
     assert steps[-1] == 5
 
 
+def test_reference_compat_flags(tmp_path):
+    """The reference README's local-deployment flags run unchanged: dissolved
+    topology flags (--server/--*-job-name/--MPI/--no-wait) are accepted as
+    warned no-ops and --use-gpu degrades to CPU when no GPU backend exists
+    (reference README.md:141-146, runner.py:196-211)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "aggregathor_tpu.cli.runner",
+         "--experiment", "mnist", "--aggregator", "average", "--nb-workers", "4",
+         "--max-step", "3", "--evaluation-delta", "-1", "--evaluation-period", "-1",
+         "--server", '{"local": ["127.0.0.1:7000"]}',
+         "--ps-job-name", "local", "--wk-job-name", "local", "--ev-job-name", "local",
+         "--MPI", "--no-wait", "--use-gpu"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    out = proc.stdout + proc.stderr
+    assert "Compat no-op flags ignored" in out
+    assert "Mesh:" in out
+
+
 def test_runner_rejects_bad_nf():
     with pytest.raises(UserException):
         run(["--experiment", "mnist", "--aggregator", "krum",
