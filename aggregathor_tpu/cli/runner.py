@@ -54,6 +54,11 @@ def build_parser():
         "--unroll", type=int, default=1,
         help="scan this many steps per dispatch (cadences then fire at chunk granularity)",
     )
+    parser.add_argument(
+        "--exchange-dtype", default=None, choices=["float32", "bfloat16"],
+        help="wire precision of the gradient exchange (bfloat16 halves the "
+             "collective bytes; GAR math stays float32)",
+    )
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
@@ -120,17 +125,31 @@ def main(argv=None):
     import jax.numpy as jnp
     import numpy as np
 
+    def want_cpu_devices():
+        # The virtual-CPU device count must be configured BEFORE any backend
+        # initializes (a post-init update raises); honor an ambient
+        # XLA_FLAGS force if one exists.
+        return (
+            args.nb_devices and args.nb_devices > 1
+            and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
+        )
+
     if args.platform:
         # The env var alone can be ignored when an accelerator plugin is
         # pinned by the surrounding environment; the config update wins as
         # long as no backend has been initialized yet (tests/conftest.py has
         # the same dance).
         jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu" and want_cpu_devices():
+            jax.config.update("jax_num_cpu_devices", args.nb_devices)
     elif device_preference is not None:
         # "use X if available" (reference allocator semantics): try the
         # preference list; when this installation cannot even name the
         # backend, fall through to CPU like the reference does when no such
-        # device exists in the cluster.
+        # device exists in the cluster.  The probe initializes a backend, so
+        # the CPU device count is set first (the fallback may land there).
+        if want_cpu_devices():
+            jax.config.update("jax_num_cpu_devices", args.nb_devices)
         # JAX's platform list is strict (one uninitializable backend fails the
         # whole list), so retry progressively shorter suffixes: a GPU host
         # without libtpu still lands on its GPU, not on CPU.
@@ -143,9 +162,10 @@ def main(argv=None):
                 break
             except RuntimeError:
                 continue
-    effective_platform = args.platform or os.environ.get("JAX_PLATFORMS", "")
-    if effective_platform == "cpu" and args.nb_devices and args.nb_devices > 1:
-        jax.config.update("jax_num_cpu_devices", args.nb_devices)
+    else:
+        effective_platform = os.environ.get("JAX_PLATFORMS", "")
+        if effective_platform == "cpu" and want_cpu_devices():
+            jax.config.update("jax_num_cpu_devices", args.nb_devices)
 
     from .. import config, gars, models
     from ..core import build_optimizer, build_schedule
@@ -194,7 +214,10 @@ def main(argv=None):
         gar = gars.instantiate(args.aggregator, n, f, args.aggregator_args)
         attack = attacks.instantiate(args.attack, n, r, args.attack_args) if args.attack else None
         lossy = LossyLink(args.udp, args.udp_args) if args.udp > 0 else None
-        engine = RobustEngine(mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy)
+        engine = RobustEngine(
+            mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
+            exchange_dtype=args.exchange_dtype,
+        )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
         tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)

@@ -60,13 +60,22 @@ def _partial_pairwise_sq_distances(block):
 class RobustEngine:
     """Builds jitted robust train/eval steps over a (worker, model) mesh."""
 
-    def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None):
+    def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
+                 exchange_dtype=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
+        # Wire precision: the all_to_all + all_gather carry ~2d floats per
+        # device per step (the dominant wire cost, module docstring); bf16
+        # halves it.  Gradients are quantized ONCE before the reshard and all
+        # GAR math runs in f32 on the upcast values, so every device still
+        # sees bit-identical inputs (replicated-update determinism holds).
+        # float32 normalizes to None (no quantization path compiled in).
+        dt = jnp.dtype(exchange_dtype) if exchange_dtype else None
+        self.exchange_dtype = None if dt == jnp.float32 else dt
         self.nb_devices = mesh.shape[worker_axis]
         if self.nb_workers % self.nb_devices != 0:
             raise UserException(
@@ -125,6 +134,8 @@ class RobustEngine:
     def _reshard_to_blocks(self, gvecs, d):
         """(k, d) worker-sharded -> (n, d_block) dimension-sharded column block."""
         W, k = self.nb_devices, self.workers_per_device
+        if self.exchange_dtype is not None:
+            gvecs = gvecs.astype(self.exchange_dtype)
         blk = -(-d // W)
         padded = jnp.pad(gvecs, ((0, 0), (0, W * blk - d)))
         pieces = padded.reshape(k, W, blk).transpose(1, 0, 2)  # (W, k, blk)
@@ -140,6 +151,10 @@ class RobustEngine:
         if self.attack is not None and self.attack.omniscient:
             byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
             block = self.attack.apply_matrix(block, byz_mask, key)
+            if self.exchange_dtype is not None:
+                # The forged rows crossed the same wire as honest ones: they
+                # cannot carry sub-exchange-precision structure.
+                block = block.astype(self.exchange_dtype).astype(jnp.float32)
         dist2 = None
         if self.gar.needs_distances:
             partial = _partial_pairwise_sq_distances(block)
@@ -170,11 +185,16 @@ class RobustEngine:
             gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
             d = gvecs.shape[-1]
             block = self._reshard_to_blocks(gvecs, d)
+            if self.exchange_dtype is not None:
+                block = block.astype(jnp.float32)  # GAR math always in f32
             agg_block = self._aggregate_block(block, key)
+            if self.exchange_dtype is not None:
+                agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
             if W > 1:
                 agg = jax.lax.all_gather(agg_block, worker_axis, axis=0).reshape(-1)[:d]
             else:
                 agg = agg_block[:d]
+            agg = agg.astype(jnp.float32)
             agg_tree = flatmap.inflate(agg)
             updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)

@@ -115,6 +115,27 @@ def test_lossy_link_breaks_plain_average():
     assert not np.all(np.isfinite(flat_params(state)))
 
 
+def test_bf16_exchange_converges_and_stays_invariant():
+    """bfloat16 wire exchange: training still converges, and the result is
+    device-count invariant (the quantization happens identically before the
+    collective on every layout)."""
+    import optax
+
+    results = []
+    for nb_devices in (8, 1):
+        exp = models.instantiate("mnist", ["batch-size:16"])
+        gar = gars.instantiate("krum", 8, 1)
+        tx = optax.sgd(0.05)
+        engine = RobustEngine(make_mesh(nb_workers=nb_devices), gar, nb_workers=8,
+                              exchange_dtype="bfloat16")
+        step = engine.build_step(exp.loss, tx)
+        state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+        state, losses = run_steps(exp, engine, step, state, 20)
+        assert losses[-1] < losses[0]
+        results.append(flat_params(state))
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-4, atol=1e-5)
+
+
 def test_lossy_clever_stale_infill():
     """CLEVER=1 parity (mpi_rendezvous_mgr.patch:833-835): a lost packet keeps
     the previous step's received value, so even plain average stays finite and
