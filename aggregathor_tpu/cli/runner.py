@@ -59,6 +59,11 @@ def build_parser():
         help="wire precision of the gradient exchange (bfloat16 halves the "
              "collective bytes; GAR math stays float32)",
     )
+    parser.add_argument(
+        "--worker-momentum", type=float, default=None, metavar="BETA",
+        help="workers send momenta (beta in (0,1)) instead of raw gradients — "
+             "history-aware robustness (Karimireddy et al. 2021)",
+    )
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
@@ -216,7 +221,7 @@ def main(argv=None):
         lossy = LossyLink(args.udp, args.udp_args) if args.udp > 0 else None
         engine = RobustEngine(
             mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
-            exchange_dtype=args.exchange_dtype,
+            exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
         )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
@@ -303,13 +308,13 @@ def main(argv=None):
                 )
         if target_step >= 0:
             with Context("restore"):
-                # The CLEVER carry is worker-sharded (possibly across hosts) and
-                # never serialized: keep the live zeroed buffer aside and restore
-                # into a carry-less host template.
-                carry = state.carry
-                template = jax.device_get(state.replace(carry=None))
+                # The worker-sharded side buffers (CLEVER carry, momentum) may
+                # span hosts and are never serialized: keep the live zeroed
+                # buffers aside and restore into a stripped host template.
+                carry, momentum = state.carry, state.momentum
+                template = jax.device_get(state.replace(carry=None, momentum=None))
                 restored, offstep = checkpoints.restore(template, step=target_step)
-                state = engine.put_state(restored.replace(carry=carry))
+                state = engine.put_state(restored.replace(carry=carry, momentum=momentum))
 
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)

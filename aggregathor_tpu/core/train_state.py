@@ -18,12 +18,23 @@ import optax  # noqa: F401  (type provider for opt_state pytrees)
 class TrainState:
     """Pure-pytree training state: parameters, optimizer state, step counter, PRNG key.
 
-    ``carry`` is the optional per-worker previously-received gradient matrix,
-    global shape (nb_workers, d), used by the CLEVER stale-value infill of the
-    lossy link (reference: mpi_rendezvous_mgr.patch:833-835 — the PS's
-    reassembly buffer keeps last step's bytes where packets are lost).  Unlike
-    every other field it is *worker-sharded*, never replicated: each device
-    carries only its own workers' rows.
+    Two optional (nb_workers, d) per-worker matrices ride along, both
+    *worker-sharded* (each device holds only its own workers' rows, never
+    replicated) and both excluded from checkpoints:
+
+    - ``carry``: the previously-received gradients, used by the CLEVER
+      stale-value infill of the lossy link (reference:
+      mpi_rendezvous_mgr.patch:833-835 — the PS's reassembly buffer keeps
+      last step's bytes where packets are lost);
+    - ``momentum``: per-worker momentum for history-aware robust aggregation
+      (Karimireddy et al. 2021): workers send momenta instead of raw
+      gradients, so a Byzantine worker cannot re-inject fresh noise each
+      step (time-coupled attacks average out in honest momenta).
+
+    ``momentum_steps`` counts momentum updates separately from ``step``: the
+    buffer re-zeroes on restore (never serialized), so its bias correction
+    must restart too — correcting by the global step would attenuate the
+    first post-restore sends by up to (1 - beta).
     """
 
     step: jax.Array
@@ -31,15 +42,18 @@ class TrainState:
     opt_state: object
     rng: jax.Array
     carry: object = None
+    momentum: object = None
+    momentum_steps: object = None
 
     @classmethod
-    def create(cls, params, tx, rng=None, carry=None):
+    def create(cls, params, tx, rng=None, carry=None, momentum=None):
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
             carry=carry,
+            momentum=momentum,
         )
 
 
@@ -47,10 +61,11 @@ _SERIALIZED_FIELDS = ("step", "params", "opt_state", "rng")
 
 
 def _to_state_dict(state):
-    # ``carry`` never reaches checkpoints: it is a transport buffer, not model
-    # state — writing it would cost (n, d) host bytes per snapshot and break
-    # restore of snapshots taken before the field existed.  A restarted run
-    # re-zeroes it, like the reference's freshly-allocated reassembly buffer.
+    # The worker-sharded side buffers (carry, momentum) never reach
+    # checkpoints: writing them would cost (n, d) host bytes per snapshot
+    # and break restore of snapshots taken before the fields existed.  A
+    # restarted run re-zeroes them (for CLEVER, exactly the reference's
+    # freshly-allocated reassembly buffer; for momentum, a short re-warmup).
     return {f: flax.serialization.to_state_dict(getattr(state, f)) for f in _SERIALIZED_FIELDS}
 
 

@@ -85,10 +85,11 @@ class Checkpoints:
         """Snapshot ``state``; prunes beyond ``max_to_keep`` oldest-first."""
         if step is None:
             step = int(jax.device_get(state.step))
-        if getattr(state, "carry", None) is not None:
-            # Not serialized (core/train_state.py) — drop it BEFORE device_get
-            # or the full (n, d) matrix crosses to the host just to be discarded.
-            state = state.replace(carry=None)
+        for field in ("carry", "momentum"):
+            if getattr(state, field, None) is not None:
+                # Not serialized (core/train_state.py) — drop BEFORE device_get
+                # or the (n, d) matrix crosses to the host just to be discarded.
+                state = state.replace(**{field: None})
         data = flax.serialization.to_bytes(jax.device_get(state))
         path = self._path(step)
         if self.authenticator is not None:

@@ -61,13 +61,21 @@ class RobustEngine:
     """Builds jitted robust train/eval steps over a (worker, model) mesh."""
 
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
-                 exchange_dtype=None):
+                 exchange_dtype=None, worker_momentum=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
+        # History-aware robustness (Karimireddy et al. 2021): with
+        # worker_momentum = beta in (0, 1), every worker sends its momentum
+        # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
+        # GAR aggregates slow-moving honest statistics that a fresh-noise
+        # Byzantine strategy cannot track.  Carried worker-sharded.
+        self.worker_momentum = None if worker_momentum is None else float(worker_momentum)
+        if self.worker_momentum is not None and not 0.0 < self.worker_momentum < 1.0:
+            raise UserException("worker_momentum must lie in (0, 1), got %r" % worker_momentum)
         # Wire precision: the all_to_all + all_gather carry ~2d floats per
         # device per step (the dominant wire cost, module docstring); bf16
         # halves it.  Gradients are quantized ONCE before the reshard and all
@@ -166,13 +174,15 @@ class RobustEngine:
 
     def _state_spec(self):
         """PartitionSpec prefix tree for TrainState: everything replicated
-        except the CLEVER carry, whose (n, d) rows stay on their workers."""
+        except the worker-sharded side buffers (CLEVER carry, momentum)."""
         return TrainState(
             step=P(),
             params=P(),
             opt_state=P(),
             rng=P(),
             carry=P(worker_axis) if self.carries_gradients else None,
+            momentum=P(worker_axis) if self.worker_momentum is not None else None,
+            momentum_steps=P() if self.worker_momentum is not None else None,
         )
 
     def _make_body(self, loss_fn, tx):
@@ -182,6 +192,18 @@ class RobustEngine:
         def body(state, batch):
             key = jax.random.fold_in(state.rng, state.step)
             losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn)
+            new_momentum, new_momentum_steps = None, None
+            if self.worker_momentum is not None:
+                # Honest workers send momenta (computed BEFORE the attack:
+                # attackers forge what they transmit, not what honest peers
+                # remember).  Bias-corrected like Adam so early steps are not
+                # (1-beta)-scaled relative to plain gradients; the correction
+                # counts momentum updates, NOT the global step — the buffer
+                # re-zeroes on restore and its warmup must restart with it.
+                beta = self.worker_momentum
+                new_momentum = beta * state.momentum + (1.0 - beta) * gvecs
+                new_momentum_steps = state.momentum_steps + 1
+                gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
             gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
             d = gvecs.shape[-1]
             block = self._reshard_to_blocks(gvecs, d)
@@ -200,7 +222,8 @@ class RobustEngine:
             params = optax.apply_updates(state.params, updates)
             total_loss = jax.lax.psum(jnp.sum(losses), worker_axis) if W > 1 else jnp.sum(losses)
             new_state = state.replace(
-                step=state.step + 1, params=params, opt_state=opt_state, carry=new_carry
+                step=state.step + 1, params=params, opt_state=opt_state,
+                carry=new_carry, momentum=new_momentum, momentum_steps=new_momentum_steps,
             )
             metrics = {
                 "total_loss": total_loss,
@@ -329,27 +352,37 @@ class RobustEngine:
         spec = jax.sharding.NamedSharding(self.mesh, P())
         return jax.device_put(tree, spec)
 
+    def _worker_sharded(self, array_or_none, d=None):
+        """Device_put (or create zeroed) a (nb_workers, d) worker-sharded buffer."""
+        spec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
+        if array_or_none is not None:
+            return jax.device_put(array_or_none, spec)
+        return jax.jit(lambda: jnp.zeros((self.nb_workers, d), jnp.float32), out_shardings=spec)()
+
     def put_state(self, state):
         """Device_put a TrainState with the engine's state sharding — fully
-        replicated except the worker-sharded CLEVER carry (restore path)."""
-        carry = state.carry
-        placed = self.replicate(state.replace(carry=None))
+        replicated except the worker-sharded side buffers (restore path)."""
+        carry, momentum = state.carry, state.momentum
+        placed = self.replicate(state.replace(carry=None, momentum=None))
         if carry is not None:
-            cspec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
-            carry = jax.device_put(carry, cspec)
-        return placed.replace(carry=carry)
+            carry = self._worker_sharded(carry)
+        if momentum is not None:
+            momentum = self._worker_sharded(momentum)
+        return placed.replace(carry=carry, momentum=momentum)
 
     def init_state(self, params, tx, seed=0):
-        """Create a replicated TrainState (plus the zeroed CLEVER carry when
-        the lossy link runs in clever mode — packets lost before any gradient
-        was ever received read as zero contributions, like the reference's
-        freshly-allocated reassembly buffer)."""
+        """Create a replicated TrainState, plus zeroed worker-sharded side
+        buffers when enabled: the CLEVER carry (packets lost before any
+        gradient was received read as zero contributions, like the
+        reference's freshly-allocated reassembly buffer) and the per-worker
+        momentum."""
         state = self.replicate(TrainState.create(params, tx, rng=jax.random.PRNGKey(seed)))
+        d = sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
         if self.carries_gradients:
-            d = sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
-            cspec = jax.sharding.NamedSharding(self.mesh, P(worker_axis))
-            carry = jax.jit(
-                lambda: jnp.zeros((self.nb_workers, d), jnp.float32), out_shardings=cspec
-            )()
-            state = state.replace(carry=carry)
+            state = state.replace(carry=self._worker_sharded(None, d))
+        if self.worker_momentum is not None:
+            state = state.replace(
+                momentum=self._worker_sharded(None, d),
+                momentum_steps=self.replicate(jnp.zeros((), jnp.int32)),
+            )
         return state

@@ -136,6 +136,117 @@ def test_bf16_exchange_converges_and_stays_invariant():
     np.testing.assert_allclose(results[0], results[1], rtol=1e-4, atol=1e-5)
 
 
+def test_worker_momentum_converges_under_attack():
+    """History-aware robustness: workers send bias-corrected momenta; krum on
+    momenta still converges under a signflip coalition, and the momentum
+    buffer is threaded worker-sharded through the step."""
+    import optax
+
+    atk = attacks.instantiate("signflip", 8, 2, ["scale:10.0"])
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    gar = gars.instantiate("krum", 8, 2)
+    tx = optax.sgd(0.05)
+    engine = RobustEngine(make_mesh(nb_workers=8), gar, nb_workers=8, nb_real_byz=2,
+                          attack=atk, worker_momentum=0.9)
+    step = engine.build_step(exp.loss, tx)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    assert state.momentum is not None and state.momentum.shape[0] == 8
+    state, losses = run_steps(exp, engine, step, state, 25)
+    assert losses[-1] < losses[0]
+    assert np.all(np.isfinite(np.asarray(state.momentum)))
+
+
+def test_worker_momentum_matches_closed_form():
+    """n=1, average GAR, one fixed batch: the sent value is the bias-corrected
+    EMA of a constant-ish gradient stream; step 1 must equal plain SGD's."""
+    import optax
+
+    exp = models.instantiate("mnist", ["batch-size:8"])
+    tx = optax.sgd(0.1)
+    batch = next(exp.make_train_iterator(1, seed=5))
+
+    def one_step_params(worker_momentum):
+        gar = gars.instantiate("average", 1, 0)
+        engine = RobustEngine(make_mesh(nb_workers=1), gar, nb_workers=1,
+                              worker_momentum=worker_momentum)
+        step = engine.build_step(exp.loss, tx)
+        state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+        state, _ = step(state, engine.shard_batch(batch))
+        return flat_params(state)
+
+    # bias correction makes the first momentum step IDENTICAL to plain SGD
+    np.testing.assert_allclose(one_step_params(0.9), one_step_params(None),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_worker_momentum_multi_step_matches_single():
+    import optax
+
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    tx = optax.sgd(0.05)
+    gar = gars.instantiate("average", 4, 0)
+    engine = RobustEngine(make_mesh(nb_workers=4), gar, nb_workers=4, worker_momentum=0.8)
+    single = engine.build_step(exp.loss, tx)
+    multi = engine.build_multi_step(exp.loss, tx)
+    it = exp.make_train_iterator(4, seed=9)
+    batches = [next(it) for _ in range(4)]
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    s1 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    for b in batches:
+        s1, _ = single(s1, engine.shard_batch(b))
+    s2 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    s2, _ = multi(s2, engine.shard_batches(stacked))
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s1.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(s2.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(s1.momentum), np.asarray(s2.momentum),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_worker_momentum_bias_correction_restarts_on_restore(tmp_path):
+    """After restore the momentum buffer re-zeroes, so its bias correction
+    must restart with it: the first post-restore step equals a plain-SGD
+    step on the restored params, not a (1-beta)-attenuated one."""
+    import optax
+
+    from aggregathor_tpu.obs import Checkpoints
+
+    exp = models.instantiate("mnist", ["batch-size:8"])
+    tx = optax.sgd(0.1)
+    gar = gars.instantiate("average", 4, 0)
+    engine = RobustEngine(make_mesh(nb_workers=4), gar, nb_workers=4, worker_momentum=0.9)
+    step = engine.build_step(exp.loss, tx)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    it = exp.make_train_iterator(4, seed=1)
+    for _ in range(3):
+        state, _ = step(state, engine.shard_batch(next(it)))
+    ckpts = Checkpoints(str(tmp_path))
+    ckpts.save(state)
+
+    template = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    fresh_buffers = (template.carry, template.momentum)
+    host_template = jax.device_get(template.replace(carry=None, momentum=None))
+    restored, _ = ckpts.restore(host_template)
+    restored = engine.put_state(
+        restored.replace(carry=fresh_buffers[0], momentum=fresh_buffers[1])
+    )
+    assert int(jax.device_get(restored.momentum_steps)) == 0
+    params_before = jax.device_get(restored.params)
+    batch = next(it)
+    restored, _ = step(restored, engine.shard_batch(batch))
+    momentum_delta = flat_params(restored) - np.concatenate(
+        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(params_before)])
+
+    plain = RobustEngine(make_mesh(nb_workers=4), gar, nb_workers=4)
+    pstep = plain.build_step(exp.loss, tx)
+    pstate = plain.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    pstate = pstate.replace(params=plain.replicate(params_before))
+    pstate, _ = pstep(pstate, plain.shard_batch(batch))
+    plain_delta = flat_params(pstate) - np.concatenate(
+        [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(params_before)])
+    np.testing.assert_allclose(momentum_delta, plain_delta, rtol=1e-4, atol=1e-6)
+
+
 def test_lossy_clever_stale_infill():
     """CLEVER=1 parity (mpi_rendezvous_mgr.patch:833-835): a lost packet keeps
     the previous step's received value, so even plain average stays finite and
