@@ -76,13 +76,20 @@ def _replication_axes(spec):
 class ShardedRobustEngine:
     """Robust Byzantine-DP over logical workers that each span a submesh."""
 
-    def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer"):
+    def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
+                 exchange_dtype=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
+        # Wire precision of the per-bucket worker-axis all_gathers (the
+        # engine's dominant collective): bf16 halves the bytes; GAR math
+        # stays float32 on upcast rows (see parallel/engine.py for the
+        # identical policy on the flat engine).  float32 normalizes to None.
+        dt = jnp.dtype(exchange_dtype) if exchange_dtype else None
+        self.exchange_dtype = None if dt == jnp.float32 else dt
         if granularity not in ("layer", "leaf", "global"):
             raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
         self.granularity = granularity
@@ -143,14 +150,22 @@ class ShardedRobustEngine:
 
     def _gather_rows(self, buckets):
         """(Lb, d) local buckets -> (Lb, n, d) per-worker rows via all_gather."""
+        if self.exchange_dtype is not None:
+            buckets = buckets.astype(self.exchange_dtype)
         rows = jax.lax.all_gather(buckets, worker_axis)  # (n, Lb, d)
+        if self.exchange_dtype is not None:
+            rows = rows.astype(jnp.float32)
         return jnp.swapaxes(rows, 0, 1)
 
     def _apply_omniscient(self, rows, key):
         if self.attack is None or not self.attack.omniscient:
             return rows
         byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
-        return jax.vmap(lambda m: self.attack.apply_matrix(m, byz_mask, key))(rows)
+        rows = jax.vmap(lambda m: self.attack.apply_matrix(m, byz_mask, key))(rows)
+        if self.exchange_dtype is not None:
+            # forged rows crossed the same quantized wire as honest ones
+            rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+        return rows
 
     def _bucket_distances(self, rows, spec):
         """(Lb, n, n) squared distances for this leaf's buckets (exact)."""
