@@ -77,7 +77,7 @@ class ShardedRobustEngine:
     """Robust Byzantine-DP over logical workers that each span a submesh."""
 
     def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
-                 exchange_dtype=None):
+                 exchange_dtype=None, worker_momentum=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
@@ -90,6 +90,13 @@ class ShardedRobustEngine:
         # identical policy on the flat engine).  float32 normalizes to None.
         dt = jnp.dtype(exchange_dtype) if exchange_dtype else None
         self.exchange_dtype = None if dt == jnp.float32 else dt
+        # History-aware robustness (Karimireddy et al. 2021), same policy as
+        # the flat engine: workers send bias-corrected momenta.  The buffer
+        # is a per-worker pytree shaped like the params with a leading
+        # worker dim, sharded P(worker, *param_spec).
+        self.worker_momentum = None if worker_momentum is None else float(worker_momentum)
+        if self.worker_momentum is not None and not 0.0 < self.worker_momentum < 1.0:
+            raise UserException("worker_momentum must lie in (0, 1), got %r" % worker_momentum)
         if granularity not in ("layer", "leaf", "global"):
             raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
         self.granularity = granularity
@@ -117,11 +124,26 @@ class ShardedRobustEngine:
         with jax.set_mesh(self.mesh):  # optimizers that allocate (adam, ...) need the mesh
             opt_state = jax.jit(tx.init)(params)  # shardings propagate from params
         rep = NamedSharding(self.mesh, P())
+        momentum = momentum_steps = None
+        if self.worker_momentum is not None:
+            m_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, P(worker_axis, *tuple(s))),
+                specs, is_leaf=_is_spec,
+            )
+            momentum = jax.jit(
+                lambda: jax.tree.map(
+                    lambda p: jnp.zeros((self.nb_workers,) + p.shape, jnp.float32), params
+                ),
+                out_shardings=m_shardings,
+            )()
+            momentum_steps = jax.device_put(jnp.zeros((), jnp.int32), rep)
         return TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
             opt_state=opt_state,
             rng=jax.device_put(jax.random.PRNGKey(seed), rep),
+            momentum=momentum,
+            momentum_steps=momentum_steps,
         )
 
     def shard_batch(self, batch):
@@ -215,6 +237,17 @@ class ShardedRobustEngine:
                 jax.lax.psum(g, _replication_axes(s)) if _replication_axes(s) else g
                 for g, s in zip(g_leaves, s_leaves)
             ]
+            # (2b) honest worker momentum (pre-attack, like the flat engine):
+            # send bias-corrected momenta, carry the uncorrected buffer
+            new_momentum, new_momentum_steps = state.momentum, state.momentum_steps
+            if self.worker_momentum is not None:
+                beta = self.worker_momentum
+                m_leaves, _ = jax.tree_util.tree_flatten(state.momentum)
+                new_momentum_steps = state.momentum_steps + 1
+                corr = 1.0 - beta ** new_momentum_steps.astype(jnp.float32)
+                m_new = [beta * m[0] + (1.0 - beta) * g for m, g in zip(m_leaves, g_leaves)]
+                g_leaves = [m / corr for m in m_new]
+                new_momentum = jax.tree_util.tree_unflatten(treedef, [m[None] for m in m_new])
             # (3) per-worker perturbation of this worker's own shards
             g_leaves = [
                 self._perturb(g, s, jax.random.fold_in(jax.random.fold_in(key, widx), i), widx)
@@ -260,7 +293,8 @@ class ShardedRobustEngine:
                 sq = sq + jnp.sum(jnp.square(agg.astype(jnp.float32))) * self._replication_scale(s)
             grad_norm = jnp.sqrt(jax.lax.psum(sq, _IN_GROUP_AXES))
 
-            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state,
+                                      momentum=new_momentum, momentum_steps=new_momentum_steps)
             metrics = {
                 # loss is a local partial: sum the worker group, then workers
                 "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),
