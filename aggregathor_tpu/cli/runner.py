@@ -64,6 +64,12 @@ def build_parser():
         help="workers send momenta (beta in (0,1)) instead of raw gradients — "
              "history-aware robustness (Karimireddy et al. 2021)",
     )
+    parser.add_argument(
+        "--prefetch", type=int, default=2, metavar="DEPTH",
+        help="device-resident input batches prepared ahead by a background "
+             "thread (0 disables; applies to the per-step path, --unroll "
+             "chunks already amortize the input cost)",
+    )
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
@@ -318,6 +324,17 @@ def main(argv=None):
 
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
+    prefetcher = None
+    if args.prefetch > 0 and unroll == 1 and nb_processes == 1:
+        # Overlap host batch assembly + host->device transfer with compute
+        # (the reference's fetcher/batcher threads + prefetch queue,
+        # cnnet.py:115-146).  Disabled under --unroll (the scanned chunk
+        # builder consumes train_iter directly) and in multi-process runs:
+        # a background device_put would interleave differently on each host,
+        # breaking the strict cross-process ordering collectives require.
+        from ..models.datasets import DevicePrefetcher
+
+        prefetcher = DevicePrefetcher(train_iter, engine.shard_batch, depth=args.prefetch)
 
     stop = {"requested": False}
 
@@ -389,7 +406,7 @@ def main(argv=None):
                     chunk = unroll
                     pending_loss = many["total_loss"]  # full vector: see check_divergence
                 else:
-                    batch = engine.shard_batch(next(train_iter))
+                    batch = next(prefetcher) if prefetcher is not None else engine.shard_batch(next(train_iter))
                     perf.step_begin()
                     state, metrics = step_fn(state, batch)
                     if pending_loss is not None:
@@ -439,6 +456,8 @@ def main(argv=None):
                     checkpoints.save(state, step)
                 if metrics and summary_trigger.last_step != step:
                     summaries.scalars(step, {"total_loss": float(jax.device_get(metrics["total_loss"]))})
+            if prefetcher is not None:
+                prefetcher.close()
             eval_file.close()
             summaries.close()
             perf.report()

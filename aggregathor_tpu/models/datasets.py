@@ -207,3 +207,87 @@ def eval_batches(x, y, nb_workers, batch_size):
         bx = x[idx].reshape((nb_workers, batch_size) + x.shape[1:])
         by = y[idx].reshape(nb_workers, batch_size)
         yield {"image": bx, "label": by, "valid": valid.reshape(nb_workers, batch_size)}
+
+
+class _PrefetchError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Background-thread input prefetch: overlaps host-side batch assembly
+    and host->device transfer with device compute.
+
+    The reference hides its input path behind TF queue runners with
+    fetcher/batcher threads and a prefetch queue (experiments/cnnet.py:115-146);
+    the JAX equivalent is this double buffer: a daemon thread pulls host
+    batches from ``iterator``, applies ``put`` (e.g. ``engine.shard_batch`` —
+    ``jax.device_put`` is thread-safe and asynchronous), and keeps up to
+    ``depth`` device-resident batches ready for the training loop.
+    """
+
+    def __init__(self, iterator, put, depth=2):
+        import queue
+        import threading
+
+        self._queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._iterator = iterator
+        self._put = put
+        self._stop = threading.Event()
+        self._terminal = None  # remembered end-of-stream / producer error
+        self._thread = threading.Thread(target=self._run, daemon=True, name="prefetch")
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for batch in self._iterator:
+                if self._stop.is_set():
+                    return
+                device_batch = self._put(batch)
+                if self._stop.is_set():
+                    return
+                self._queue.put(device_batch)
+            self._queue.put(_PrefetchError(StopIteration()))
+        except BaseException as exc:  # surfaced on the consumer side
+            self._queue.put(_PrefetchError(exc))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._terminal is not None:  # iterator protocol: stay terminal
+            raise self._terminal
+        item = self._queue.get()
+        if isinstance(item, _PrefetchError):
+            self._terminal = item.exc
+            raise item.exc
+        return item
+
+    def close(self):
+        """Stop and join the worker; no batch stays pinned afterwards.
+
+        The drain loop keeps the queue unblocked while the producer winds
+        down (it may complete one last ``put``), then the join makes the
+        shutdown terminal — no in-flight ``device_put`` can race a
+        subsequent run's setup.
+        """
+        import queue
+        import time
+
+        self._stop.set()
+        self._terminal = StopIteration()
+        # bounded: a producer stuck inside the wrapped iterator cannot be
+        # interrupted — it is a daemon thread and dies with the process
+        deadline = time.monotonic() + 5.0
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass

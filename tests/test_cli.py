@@ -114,6 +114,24 @@ def test_deploy_local_simulate(tmp_path):
     assert steps[-1] == 5
 
 
+def test_prefetch_does_not_change_training(tmp_path):
+    """The background prefetcher preserves batch order: final params are
+    byte-identical with and without it."""
+    blobs = []
+    for depth in ("0", "3"):
+        ckpt = str(tmp_path / ("ckpt" + depth))
+        assert 0 == run([
+            "--experiment", "mnist", "--experiment-args", "batch-size:16",
+            "--aggregator", "median", "--nb-workers", "4", "--nb-decl-byz-workers", "1",
+            "--max-step", "7", "--prefetch", depth,
+            "--evaluation-delta", "-1", "--evaluation-period", "-1",
+            "--checkpoint-dir", ckpt, "--checkpoint-delta", "-1", "--checkpoint-period", "-1",
+        ])
+        [name] = [n for n in os.listdir(ckpt) if n.endswith("-7.ckpt")]
+        blobs.append(open(os.path.join(ckpt, name), "rb").read())
+    assert blobs[0] == blobs[1]
+
+
 def test_reference_compat_flags(tmp_path):
     """The reference README's local-deployment flags run unchanged: dissolved
     topology flags (--server/--*-job-name/--MPI/--no-wait) are accepted as
