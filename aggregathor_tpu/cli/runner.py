@@ -70,6 +70,12 @@ def build_parser():
              "thread (0 disables; applies to the per-step path, --unroll "
              "chunks already amortize the input cost)",
     )
+    parser.add_argument(
+        "--backend-timeout", type=float, default=300.0, metavar="SECONDS",
+        help="fail loudly if the accelerator backend does not initialize in "
+             "this many seconds (a wedged chip otherwise hangs forever); "
+             "<= 0 waits indefinitely",
+    )
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
@@ -210,6 +216,32 @@ def main(argv=None):
         warning("n = %d <= 2f = %d: most GARs offer no guarantee at this ratio" % (n, 2 * f))
 
     with Context("cluster"):
+        if args.backend_timeout and args.backend_timeout > 0:
+            # A wedged accelerator can hang backend init indefinitely and
+            # uninterruptibly; probe it on a daemon thread so the process
+            # can still fail loudly with a diagnosis.
+            import threading
+
+            probe_done = threading.Event()
+            probe_error = []
+
+            def probe():
+                try:
+                    jax.devices()
+                except BaseException as exc:  # surfaced below
+                    probe_error.append(exc)
+                finally:
+                    probe_done.set()
+
+            threading.Thread(target=probe, daemon=True, name="backend-probe").start()
+            if not probe_done.wait(args.backend_timeout):
+                raise UserException(
+                    "JAX backend did not initialize within %.0fs — the accelerator "
+                    "looks wedged or unreachable; retry with --platform cpu or raise "
+                    "--backend-timeout" % args.backend_timeout
+                )
+            if probe_error:
+                raise probe_error[0]
         devices = jax.devices()
         nb_devices = args.nb_devices
         if nb_devices is None:
