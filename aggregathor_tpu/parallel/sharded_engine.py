@@ -97,6 +97,10 @@ class ShardedRobustEngine:
         self.worker_momentum = None if worker_momentum is None else float(worker_momentum)
         if self.worker_momentum is not None and not 0.0 < self.worker_momentum < 1.0:
             raise UserException("worker_momentum must lie in (0, 1), got %r" % worker_momentum)
+        # CLEVER stale infill carries the previously-sent values per leaf
+        # (the reference's >1 MB UDP threshold is per-tensor too,
+        # mpi_rendezvous_mgr.patch:507-513); buffer layout mirrors momentum.
+        self.carries_gradients = lossy_link is not None and lossy_link.clever
         if granularity not in ("layer", "leaf", "global"):
             raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
         self.granularity = granularity
@@ -124,24 +128,31 @@ class ShardedRobustEngine:
         with jax.set_mesh(self.mesh):  # optimizers that allocate (adam, ...) need the mesh
             opt_state = jax.jit(tx.init)(params)  # shardings propagate from params
         rep = NamedSharding(self.mesh, P())
-        momentum = momentum_steps = None
-        if self.worker_momentum is not None:
+
+        def per_worker_zeros():
             m_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, P(worker_axis, *tuple(s))),
                 specs, is_leaf=_is_spec,
             )
-            momentum = jax.jit(
+            return jax.jit(
                 lambda: jax.tree.map(
                     lambda p: jnp.zeros((self.nb_workers,) + p.shape, jnp.float32), params
                 ),
                 out_shardings=m_shardings,
             )()
+
+        momentum = momentum_steps = carry = None
+        if self.worker_momentum is not None:
+            momentum = per_worker_zeros()
             momentum_steps = jax.device_put(jnp.zeros((), jnp.int32), rep)
+        if self.carries_gradients:
+            carry = per_worker_zeros()
         return TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
             opt_state=opt_state,
             rng=jax.device_put(jax.random.PRNGKey(seed), rep),
+            carry=carry,
             momentum=momentum,
             momentum_steps=momentum_steps,
         )
@@ -152,15 +163,21 @@ class ShardedRobustEngine:
 
     # ------------------------------------------------------------------ #
 
-    def _perturb(self, g, spec, key, widx):
-        """Worker-local attack + lossy link on this worker's own shard."""
+    def _perturb(self, g, spec, key, widx, previous=None):
+        """Worker-local attack + lossy link on this worker's own shard.
+
+        Returns (perturbed leaf, post-link leaf) — the latter is what "the
+        receiver saw", the stale value a lost packet keeps under CLEVER.
+        """
         flat = g.reshape(-1)
         if self.attack is not None and not self.attack.omniscient:
             forged = self.attack.apply_local(flat, jax.random.fold_in(key, 1))
             flat = jnp.where(widx < self.nb_real_byz, forged, flat)
         if self.lossy_link is not None:
-            flat = self.lossy_link.apply(flat, jax.random.fold_in(key, 2), widx)
-        return flat.reshape(g.shape)
+            prev_flat = previous.reshape(-1) if previous is not None else None
+            flat = self.lossy_link.apply(flat, jax.random.fold_in(key, 2), widx, previous=prev_flat)
+        out = flat.reshape(g.shape)
+        return out, out
 
     def _leaf_buckets(self, g, spec):
         """Reshape a local leaf to (n_buckets, d_bucket) rows-to-be."""
@@ -249,10 +266,22 @@ class ShardedRobustEngine:
                 g_leaves = [m / corr for m in m_new]
                 new_momentum = jax.tree_util.tree_unflatten(treedef, [m[None] for m in m_new])
             # (3) per-worker perturbation of this worker's own shards
-            g_leaves = [
-                self._perturb(g, s, jax.random.fold_in(jax.random.fold_in(key, widx), i), widx)
+            carry_leaves = None
+            if self.carries_gradients:
+                carry_leaves = [c[0] for c in jax.tree_util.tree_leaves(state.carry)]
+            perturbed = [
+                self._perturb(
+                    g, s, jax.random.fold_in(jax.random.fold_in(key, widx), i), widx,
+                    previous=carry_leaves[i] if carry_leaves is not None else None,
+                )
                 for i, (g, s) in enumerate(zip(g_leaves, s_leaves))
             ]
+            g_leaves = [p[0] for p in perturbed]
+            new_carry = state.carry
+            if self.carries_gradients:
+                new_carry = jax.tree_util.tree_unflatten(
+                    treedef, [p[1][None] for p in perturbed]
+                )
 
             # (4/5) per-bucket robust aggregation over the worker axis
             all_rows = []
@@ -294,7 +323,8 @@ class ShardedRobustEngine:
             grad_norm = jnp.sqrt(jax.lax.psum(sq, _IN_GROUP_AXES))
 
             new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state,
-                                      momentum=new_momentum, momentum_steps=new_momentum_steps)
+                                      carry=new_carry, momentum=new_momentum,
+                                      momentum_steps=new_momentum_steps)
             metrics = {
                 # loss is a local partial: sum the worker group, then workers
                 "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),

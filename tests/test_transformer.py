@@ -219,3 +219,27 @@ def test_sharded_engine_momentum_under_attack_converges(rng):
         losses.append(float(metrics["total_loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_sharded_engine_clever_lossy(rng):
+    """CLEVER stale infill on the sharded engine: plain average stays finite
+    and trains under a lossy worker, where NaN infill would poison params."""
+    from aggregathor_tpu.parallel.lossy import LossyLink
+
+    w, pp, tp = 2, 2, 1
+    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+    gar = gars.instantiate("average", w, 0)
+    link = LossyLink(1, ["drop-rate:0.3", "packet-coords:64", "min-coords:0", "clever:true"])
+    eng = ShardedRobustEngine(mesh, gar, lossy_link=link, granularity="layer")
+    tx = optax.sgd(0.05)
+    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+    assert state.carry is not None
+    step = eng.build_step(tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2), tx, state)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+        losses.append(float(metrics["total_loss"]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    finite = [bool(np.isfinite(np.asarray(l)).all()) for l in jax.tree_util.tree_leaves(state.params)]
+    assert all(finite)
