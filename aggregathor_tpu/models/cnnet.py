@@ -40,9 +40,22 @@ class CNNet(nn.Module):
 class CNNetExperiment(Experiment):
     def __init__(self, args):
         super().__init__(args)
-        kv = parse_keyval(args, {"batch-size": 128, "eval-batch-size": 256})
+        kv = parse_keyval(args, {
+            "batch-size": 128,
+            "eval-batch-size": 256,
+            # same arg surface as the reference (cnnet.py:100-107):
+            # preprocessing selects the train augmentation; the thread counts
+            # are accepted for drop-in compat (input threading is the
+            # prefetcher's job here, cli/runner.py --prefetch)
+            "preprocessing": "cifarnet",
+            "nb-fetcher-threads": 0,
+            "nb-batcher-threads": 0,
+        })
+        from .preprocessing import check as check_preprocessing
+
         self.batch_size = kv["batch-size"]
         self.eval_batch_size = kv["eval-batch-size"]
+        self.preprocessing = check_preprocessing(kv["preprocessing"])  # fail fast
         self.dataset = load_cifar10()
         self.model = CNNet(classes=self.dataset.nb_classes)
 
@@ -66,8 +79,11 @@ class CNNetExperiment(Experiment):
         return {"accuracy": (jnp.sum(hit), count)}
 
     def make_train_iterator(self, nb_workers, seed=0):
+        from .preprocessing import instantiate as make_preprocessing
+
         return WorkerBatchIterator(
-            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed
+            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed,
+            transform=make_preprocessing(self.preprocessing, seed=seed),
         )
 
     def make_eval_iterator(self, nb_workers):

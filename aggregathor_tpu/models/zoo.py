@@ -125,6 +125,12 @@ class ZooExperiment(Experiment):
                 "image-size": 224,
                 "dtype": "float32",
                 "aux-weight": 0.4,
+                # slims.py:69-76 arg surface: train augmentation selection
+                # (preprocessing_factory) + thread counts accepted for
+                # drop-in compat (threading is --prefetch's job here)
+                "preprocessing": "",
+                "nb-fetcher-threads": 0,
+                "nb-batcher-threads": 0,
             },
         )
         self.batch_size = kv["batch-size"]
@@ -132,6 +138,12 @@ class ZooExperiment(Experiment):
         self.weight_decay = kv["weight-decay"]
         self.label_smoothing = kv["label-smoothing"]
         self.labels_offset = kv["labels-offset"]
+        from .preprocessing import check as check_preprocessing, default_for
+
+        # default follows the model name like slim's preprocessing_factory
+        self.preprocessing = check_preprocessing(
+            kv["preprocessing"] or default_for(self.model_name)
+        )
         self.aux_weight = kv["aux-weight"] if self.model_name in AUX_CAPABLE else 0.0
         self.dataset = DATASETS[self.dataset_name](kv)
         dtype = jnp.bfloat16 if kv["dtype"] == "bfloat16" else jnp.float32
@@ -186,8 +198,11 @@ class ZooExperiment(Experiment):
         return {"accuracy": (jnp.sum(hit), count)}
 
     def make_train_iterator(self, nb_workers, seed=0):
+        from .preprocessing import instantiate as make_preprocessing
+
         return WorkerBatchIterator(
-            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed
+            self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed,
+            transform=make_preprocessing(self.preprocessing, seed=seed),
         )
 
     def make_eval_iterator(self, nb_workers):

@@ -1,0 +1,95 @@
+"""Train-time preprocessing (augmentation) tests: slim preprocessing_factory parity."""
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu.models import preprocessing
+from aggregathor_tpu.utils import UserException
+
+
+def _block(seed=0, n=2, b=3, size=32):
+    rng = np.random.default_rng(seed)
+    bx = rng.random((n, b, size, size, 3)).astype(np.float32)
+    by = rng.integers(0, 10, size=(n, b)).astype(np.int32)
+    return bx, by
+
+
+def test_none_is_identity():
+    bx, by = _block()
+    tx, ty = preprocessing.instantiate("none")(bx, by)
+    np.testing.assert_array_equal(tx, bx)
+    np.testing.assert_array_equal(ty, by)
+
+
+def test_cifarnet_crop_flip_properties():
+    bx, by = _block()
+    transform = preprocessing.instantiate("cifarnet", seed=1)
+    tx, ty = transform(bx.copy(), by)
+    assert tx.shape == bx.shape and tx.dtype == bx.dtype
+    np.testing.assert_array_equal(ty, by)          # labels untouched
+    assert not np.array_equal(tx, bx)              # something moved
+    # values all come from the source images (crop of reflect-pad)
+    assert tx.min() >= bx.min() - 1e-6 and tx.max() <= bx.max() + 1e-6
+    # deterministic per seed
+    t2 = preprocessing.instantiate("cifarnet", seed=1)(bx.copy(), by)[0]
+    np.testing.assert_array_equal(tx, t2)
+    # different under a different seed
+    t3 = preprocessing.instantiate("cifarnet", seed=2)(bx.copy(), by)[0]
+    assert not np.array_equal(tx, t3)
+
+
+def test_worker_stream_independent_of_worker_count():
+    """Worker w's augmentation stream is f(seed, w) only — the same images
+    for worker 0 come out identically whether 2 or 4 workers run (the same
+    guarantee WorkerBatchIterator gives for the raw sample streams)."""
+    bx4, by4 = _block(seed=5, n=4)
+    bx2, by2 = bx4[:2].copy(), by4[:2].copy()
+    t4 = preprocessing.instantiate("cifarnet", seed=9)(bx4.copy(), by4)[0]
+    t2 = preprocessing.instantiate("cifarnet", seed=9)(bx2, by2)[0]
+    np.testing.assert_array_equal(t4[:2], t2)
+    f4 = preprocessing.instantiate("inception", seed=9)(bx4.copy(), by4)[0]
+    f2 = preprocessing.instantiate("inception", seed=9)(bx4[:2].copy(), by4[:2])[0]
+    np.testing.assert_array_equal(f4[:2], f2)
+
+
+def test_flip_only_flips():
+    bx, by = _block(seed=3)
+    tx, _ = preprocessing.instantiate("inception", seed=0)(bx.copy(), by)
+    flat_in = bx.reshape(-1, *bx.shape[2:])
+    flat_out = tx.reshape(-1, *tx.shape[2:])
+    for i in range(flat_in.shape[0]):
+        same = np.array_equal(flat_out[i], flat_in[i])
+        flipped = np.array_equal(flat_out[i], flat_in[i, :, ::-1])
+        assert same or flipped
+
+
+def test_unknown_preprocessing_rejected_at_init():
+    from aggregathor_tpu import models
+
+    with pytest.raises(UserException):
+        preprocessing.check("nope")
+    with pytest.raises(UserException):  # fails fast at experiment construction
+        models.instantiate("cnnet", ["preprocessing:nope"])
+
+
+def test_model_keyed_defaults():
+    assert preprocessing.default_for("lenet") == "lenet"
+    assert preprocessing.default_for("cifarnet") == "cifarnet"
+    assert preprocessing.default_for("vgg_16") == "vgg"
+    assert preprocessing.default_for("resnet_v2_50") == "vgg"
+    assert preprocessing.default_for("inception_v3") == "inception"
+    assert preprocessing.default_for("mobilenet_v2") == "inception"
+
+
+def test_experiments_accept_preprocessing_args():
+    from aggregathor_tpu import models
+
+    exp = models.instantiate("cnnet", [
+        "batch-size:4", "preprocessing:none", "nb-fetcher-threads:4", "nb-batcher-threads:2",
+    ])
+    batch = next(exp.make_train_iterator(2, seed=0))
+    assert batch["image"].shape[:2] == (2, 4)
+    zoo = models.instantiate("slim-lenet-cifar10", ["batch-size:2"])
+    assert zoo.preprocessing == "lenet"  # model-keyed default, not dataset-keyed
+    zb = next(zoo.make_train_iterator(2, seed=0))
+    assert zb["image"].shape[:2] == (2, 2)
