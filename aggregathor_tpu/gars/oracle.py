@@ -123,7 +123,8 @@ def bulyan(grads, f):
         selections[k] = np.mean(grads[order[: m - k]], axis=0)
         if k + 1 < t:
             best = order[0]
-            live_scores = live_scores - pruned[:, best]
+            with np.errstate(invalid="ignore"):  # inf - inf on dead rows; masked via isfinite above
+                live_scores = live_scores - pruned[:, best]
             live_scores[best] = np.inf
     # Coordinate-wise averaged-median over the t selections (cpu.cpp:163-187)
     out = np.empty(d)
