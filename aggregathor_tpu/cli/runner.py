@@ -260,6 +260,7 @@ def main(argv=None):
         engine = RobustEngine(
             mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
             exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
+            batch_transform=experiment.device_transform(),
         )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)

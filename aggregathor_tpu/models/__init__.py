@@ -59,5 +59,15 @@ class Experiment:
     def make_eval_iterator(self, nb_workers):
         raise NotImplementedError
 
+    def device_transform(self):
+        """Optional jnp train-batch transform run INSIDE the jitted step.
+
+        Experiments that support ``augment:device`` return the in-step
+        augmentation here (models/preprocessing.py ``device_transform``) and
+        leave their host iterator transform-free; the engine applies it per
+        worker with (seed, step, worker)-keyed randomness.  Default: none.
+        """
+        return None
+
 
 import_directory(__name__, __path__, skip=("datasets",))

@@ -48,6 +48,10 @@ class CNNetExperiment(Experiment):
             # are accepted for drop-in compat (input threading is the
             # prefetcher's job here, cli/runner.py --prefetch)
             "preprocessing": "cifarnet",
+            # augment:device moves the augmentation INSIDE the jitted step
+            # (TPU-idiomatic: host does only the gather + transfer; the crop/
+            # flip run fused on the VPU with in-step keyed randomness)
+            "augment": "host",
             "nb-fetcher-threads": 0,
             "nb-batcher-threads": 0,
         })
@@ -56,6 +60,11 @@ class CNNetExperiment(Experiment):
         self.batch_size = kv["batch-size"]
         self.eval_batch_size = kv["eval-batch-size"]
         self.preprocessing = check_preprocessing(kv["preprocessing"])  # fail fast
+        if kv["augment"] not in ("host", "device"):
+            from ..utils import UserException
+
+            raise UserException("augment must be host|device, got %r" % kv["augment"])
+        self.augment = kv["augment"]
         self.dataset = load_cifar10()
         self.model = CNNet(classes=self.dataset.nb_classes)
 
@@ -83,8 +92,16 @@ class CNNetExperiment(Experiment):
 
         return WorkerBatchIterator(
             self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed,
-            transform=make_preprocessing(self.preprocessing, seed=seed),
+            transform=(None if self.augment == "device"
+                       else make_preprocessing(self.preprocessing, seed=seed)),
         )
+
+    def device_transform(self):
+        if self.augment != "device":
+            return None
+        from .preprocessing import device_transform
+
+        return device_transform(self.preprocessing)
 
     def make_eval_iterator(self, nb_workers):
         return eval_batches(self.dataset.x_test, self.dataset.y_test, nb_workers, self.eval_batch_size)

@@ -93,6 +93,72 @@ PREPROCESSING = {
 }
 
 
+# --------------------------------------------------------------------- #
+# Device-side tier: the same augmentations as jnp transforms running
+# INSIDE the jitted training step (engine ``batch_transform``), so the host
+# input path is just a gather + transfer.  This is the TPU-idiomatic home
+# for per-sample augmentation — the crop is a vmapped dynamic_slice (VPU
+# work fused into the step, zero host cost), where the reference necessarily
+# burned CPU threads on it (slim preprocessing ran on the input pipeline's
+# fetcher threads, experiments/cnnet.py:115-146).
+#
+# Keying discipline matches the host tier: the engine derives the key from
+# (run seed, step, GLOBAL worker index), so worker w's augmentation stream
+# is independent of nb_workers and of the device it landed on, and a rerun
+# reproduces it exactly.
+
+
+def _device_cifarnet(pad=4):
+    import jax
+    import jax.numpy as jnp
+
+    def transform(batch, key):
+        img = batch["image"]
+        b, h, w = img.shape[0], img.shape[1], img.shape[2]
+        kc, kf = jax.random.split(key)
+        padded = jnp.pad(img, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")
+        off = jax.random.randint(kc, (b, 2), 0, 2 * pad + 1)
+        crop = jax.vmap(
+            lambda im, o: jax.lax.dynamic_slice(im, (o[0], o[1], 0), (h, w, im.shape[-1]))
+        )(padded, off)
+        flip = jax.random.bernoulli(kf, 0.5, (b,))
+        out = jnp.where(flip[:, None, None, None], crop[:, :, ::-1, :], crop)
+        return dict(batch, image=out)
+
+    return transform
+
+
+def _device_flip():
+    import jax
+    import jax.numpy as jnp
+
+    def transform(batch, key):
+        img = batch["image"]
+        flip = jax.random.bernoulli(key, 0.5, (img.shape[0],))
+        out = jnp.where(flip[:, None, None, None], img[:, :, ::-1, :], img)
+        return dict(batch, image=out)
+
+    return transform
+
+
+DEVICE_PREPROCESSING = {
+    "none": lambda: None,
+    "lenet": lambda: None,
+    "cifarnet": _device_cifarnet,
+    "inception": _device_flip,
+    "vgg": _device_flip,
+}
+
+
+def device_transform(name):
+    """The jnp in-step transform for ``name`` (None when it is the identity)."""
+    if name not in DEVICE_PREPROCESSING:
+        raise UserException(
+            "Unknown preprocessing %r (accepted: %s)" % (name, ", ".join(sorted(DEVICE_PREPROCESSING)))
+        )
+    return DEVICE_PREPROCESSING[name]()
+
+
 def check(name):
     """Validate a preprocessing name at arg-parse time (fail fast)."""
     if name not in PREPROCESSING:

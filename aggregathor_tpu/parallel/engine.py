@@ -61,13 +61,20 @@ class RobustEngine:
     """Builds jitted robust train/eval steps over a (worker, model) mesh."""
 
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
-                 exchange_dtype=None, worker_momentum=None):
+                 exchange_dtype=None, worker_momentum=None, batch_transform=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
+        # Device-side augmentation: ``batch_transform(worker_batch, key) ->
+        # worker_batch`` runs INSIDE the jitted step, per worker, train-only
+        # (eval paths never apply it).  Keys are a function of (run seed,
+        # step, global worker index) so worker w's augmentation stream is
+        # independent of nb_workers/device placement — the same discipline
+        # as the host tier (models/preprocessing.py).
+        self.batch_transform = batch_transform
         # History-aware robustness (Karimireddy et al. 2021): with
         # worker_momentum = beta in (0, 1), every worker sends its momentum
         # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
@@ -191,6 +198,17 @@ class RobustEngine:
 
         def body(state, batch):
             key = jax.random.fold_in(state.rng, state.step)
+            if self.batch_transform is not None:
+                k = self.workers_per_device
+                didx = jax.lax.axis_index(worker_axis)
+
+                def aug_one(worker_batch, j):
+                    # fold tag 3: disjoint from the attack (1) / lossy (2)
+                    # streams derived from the same (key, global worker) pair
+                    wkey = jax.random.fold_in(jax.random.fold_in(key, didx * k + j), 3)
+                    return self.batch_transform(worker_batch, wkey)
+
+                batch = jax.vmap(aug_one)(batch, jnp.arange(k))
             losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn)
             new_momentum, new_momentum_steps = None, None
             if self.worker_momentum is not None:

@@ -28,12 +28,29 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def time_fn(fn, reps):
-    fn()  # warmup / compile
+def time_fn(fn, sync, reps):
+    """Amortized per-call ms with a REAL device sync.
+
+    Under the tunneled TPU backend ``jax.block_until_ready`` returns
+    immediately (measured: a d=8M aggregation "completed" in 0.03 ms at an
+    impossible 20 TB/s); only a host fetch actually waits for the device
+    stream.  So: dispatch ``reps`` calls, fetch a scalar of the last result
+    once, and subtract the single-dispatch+fetch overhead measured the same
+    way (slope, not intercept).
+    """
+    sync(fn())  # warmup / compile + sync
     t0 = time.perf_counter()
+    sync(fn())
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = None
     for _ in range(reps):
-        fn()
-    return (time.perf_counter() - t0) / reps * 1e3  # ms
+        out = fn()
+    sync(out)
+    t_many = time.perf_counter() - t0
+    if reps > 1:
+        return max(t_many - t_one, 0.0) / (reps - 1) * 1e3  # ms
+    return t_many * 1e3
 
 
 def main():
@@ -65,6 +82,10 @@ def main():
     dims = [int(d) for d in args.dims.split(",")]
     rows = []
 
+    _first = jax.jit(lambda x: x.ravel()[0])
+    dev_sync = lambda out: float(_first(out))  # real sync: host fetch
+    host_sync = lambda out: out  # native tier is synchronous already
+
     for d in dims:
         g_host = rng.normal(size=(args.n, d)).astype(np.float32)
         g_dev = jax.device_put(g_host)
@@ -76,14 +97,14 @@ def main():
             # jit tier
             gar = gars.instantiate(rule, args.n, f)
             agg = jax.jit(gar.aggregate)
-            ms = time_fn(lambda: jax.block_until_ready(agg(g_dev)), args.reps)
+            ms = time_fn(lambda: agg(g_dev), dev_sync, args.reps)
             rows.append((rule, "jnp:" + platform, d, ms, f))
 
             # pallas tier (TPU only)
             if on_tpu and (rule + "-pallas") in gars.itemize():
                 pgar = gars.instantiate(rule + "-pallas", args.n, f)
                 pagg = jax.jit(pgar.aggregate)
-                ms = time_fn(lambda: jax.block_until_ready(pagg(g_dev)), args.reps)
+                ms = time_fn(lambda: pagg(g_dev), dev_sync, args.reps)
                 rows.append((rule, "pallas", d, ms, f))
 
             # native host tier
@@ -93,7 +114,7 @@ def main():
                     call = lambda nfn=nfn, f=f: nfn(g_host, f)
                 else:
                     call = lambda nfn=nfn: nfn(g_host)
-                ms = time_fn(call, max(3, args.reps // 4))
+                ms = time_fn(call, host_sync, max(3, args.reps // 4))
                 rows.append((rule, "native", d, ms, f))
 
     print("%-18s %-12s %12s %12s" % ("rule", "tier", "d", "ms"))
