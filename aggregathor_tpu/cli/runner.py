@@ -357,17 +357,50 @@ def main(argv=None):
 
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
+    def next_chunk():
+        """K distinct batches as one (K, n, ...) stack for the unrolled path
+        (one contiguous gather via next_many when the iterator provides it)."""
+        if hasattr(train_iter, "next_many"):
+            return train_iter.next_many(unroll)
+        return jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[next(train_iter) for _ in range(unroll)]
+        )
+
     prefetcher = None
-    if args.prefetch > 0 and unroll == 1 and nb_processes == 1:
+    chunk_prefetcher = None
+    if args.prefetch > 0 and nb_processes == 1:
         # Overlap host batch assembly + host->device transfer with compute
         # (the reference's fetcher/batcher threads + prefetch queue,
-        # cnnet.py:115-146).  Disabled under --unroll (the scanned chunk
-        # builder consumes train_iter directly) and in multi-process runs:
-        # a background device_put would interleave differently on each host,
-        # breaking the strict cross-process ordering collectives require.
+        # cnnet.py:115-146).  Under --unroll the prefetcher carries whole
+        # K-step chunks.  Disabled in multi-process runs: a background
+        # device_put would interleave differently on each host, breaking the
+        # strict cross-process ordering collectives require.
         from ..models.datasets import DevicePrefetcher
 
-        prefetcher = DevicePrefetcher(train_iter, engine.shard_batch, depth=args.prefetch)
+        if unroll == 1:
+            prefetcher = DevicePrefetcher(train_iter, engine.shard_batch, depth=args.prefetch)
+        elif not args.trace:
+            # FINITE producer: exactly the chunks the loop will consume
+            # ((max_step-offstep) // unroll — the loop's unrolled-branch
+            # count is deterministic).  An infinite producer would over-draw
+            # from the shared train_iter and the tail handoff would discard
+            # a thread-timing-dependent number of draws, skipping the tail's
+            # sample stream ahead nondeterministically.  By the time the
+            # per-step tail starts, all chunks were consumed, so the
+            # producer has exhausted its iterator and exited — the tail's
+            # direct train_iter use cannot race the daemon.  (--trace runs
+            # interleave per-step and unrolled dispatches, breaking the
+            # chunk count: they keep the synchronous path.)
+            chunks_total = max(0, (max_step - offstep)) // unroll
+            if chunks_total > 0:
+
+                def chunk_source():
+                    for _ in range(chunks_total):
+                        yield next_chunk()
+
+                chunk_prefetcher = DevicePrefetcher(
+                    chunk_source(), engine.shard_batches, depth=args.prefetch
+                )
 
     stop = {"requested": False}
 
@@ -427,11 +460,12 @@ def main(argv=None):
                 chunk = 1
                 if multi_fn is not None and max_step - step >= unroll and trace_ctx is None:
                     # Unrolled dispatch: K distinct batches, one executable
-                    stacked = jax.tree_util.tree_map(
-                        lambda *xs: np.stack(xs), *[next(train_iter) for _ in range(unroll)]
-                    )
+                    if chunk_prefetcher is not None:
+                        device_chunk = next(chunk_prefetcher)
+                    else:
+                        device_chunk = engine.shard_batches(next_chunk())
                     perf.step_begin()
-                    state, many = multi_fn(state, engine.shard_batches(stacked))
+                    state, many = multi_fn(state, device_chunk)
                     if pending_loss is not None:
                         check_divergence()
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], many)
@@ -439,6 +473,12 @@ def main(argv=None):
                     chunk = unroll
                     pending_loss = many["total_loss"]  # full vector: see check_divergence
                 else:
+                    if chunk_prefetcher is not None:
+                        # Entering the per-step tail: retire the chunk
+                        # producer FIRST — its daemon shares train_iter and
+                        # numpy Generators are not thread-safe.
+                        chunk_prefetcher.close()
+                        chunk_prefetcher = None
                     batch = next(prefetcher) if prefetcher is not None else engine.shard_batch(next(train_iter))
                     perf.step_begin()
                     state, metrics = step_fn(state, batch)
@@ -491,6 +531,8 @@ def main(argv=None):
                     summaries.scalars(step, {"total_loss": float(jax.device_get(metrics["total_loss"]))})
             if prefetcher is not None:
                 prefetcher.close()
+            if chunk_prefetcher is not None:
+                chunk_prefetcher.close()
             eval_file.close()
             summaries.close()
             perf.report()

@@ -88,7 +88,6 @@ def run_bench(force_cpu=False):
         # shape, runner.py:562-576).
         fresh_fn = resident_fn = engine.build_step(experiment.loss, tx)
         make_fresh = lambda: engine.shard_batch(next(it))
-        prefetcher = None
     else:
         # Scanned K-step trainers; the fresh form consumes K distinct batches
         # per dispatch so its timed loop pays the full input path (vectorized
