@@ -20,21 +20,24 @@ from .datasets import WorkerBatchIterator, eval_batches, load_cifar10
 
 class CNNet(nn.Module):
     classes: int = 10
+    dtype: jnp.dtype = jnp.float32  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(64, (5, 5), padding="SAME", name="conv1")(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = nn.GroupNorm(num_groups=8, name="norm1")(x)
-        x = nn.Conv(64, (5, 5), padding="SAME", name="conv2")(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype, name="norm1")(x)
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
         x = nn.relu(x)
-        x = nn.GroupNorm(num_groups=8, name="norm2")(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype, name="norm2")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(384, name="dense1")(x))
-        x = nn.relu(nn.Dense(192, name="dense2")(x))
-        return nn.Dense(self.classes, name="logits")(x)
+        x = nn.relu(nn.Dense(384, dtype=self.dtype, name="dense1")(x))
+        x = nn.relu(nn.Dense(192, dtype=self.dtype, name="dense2")(x))
+        # logits in f32: the softmax CE is numerically touchy in bf16
+        return nn.Dense(self.classes, name="logits")(x.astype(jnp.float32))
 
 
 class CNNetExperiment(Experiment):
@@ -52,6 +55,8 @@ class CNNetExperiment(Experiment):
             # (TPU-idiomatic: host does only the gather + transfer; the crop/
             # flip run fused on the VPU with in-step keyed randomness)
             "augment": "host",
+            # compute dtype (params stay f32; the MXU runs bf16 at ~2x f32)
+            "dtype": "float32",
             "nb-fetcher-threads": 0,
             "nb-batcher-threads": 0,
         })
@@ -64,9 +69,11 @@ class CNNetExperiment(Experiment):
             from ..utils import UserException
 
             raise UserException("augment must be host|device, got %r" % kv["augment"])
+        from .common import check_dtype
+
         self.augment = kv["augment"]
         self.dataset = load_cifar10()
-        self.model = CNNet(classes=self.dataset.nb_classes)
+        self.model = CNNet(classes=self.dataset.nb_classes, dtype=check_dtype(kv["dtype"]))
 
     def init(self, rng):
         sample = jnp.zeros((1, 32, 32, 3), jnp.float32)

@@ -2,6 +2,24 @@
 
 import flax.linen as nn
 import jax
+import jax.numpy as jnp
+
+from ..utils import UserException
+
+#: the compute dtypes experiments accept (params always stay float32)
+COMPUTE_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+def check_dtype(name):
+    """Validate a ``dtype:`` experiment arg at construction time (fail fast
+    with a clean UserException instead of a numpy TypeError mid-build, and
+    never silently coerce — ``dtype:bf16`` or ``dtype:int32`` must not
+    quietly train in float32 or truncate images to zeros)."""
+    if name not in COMPUTE_DTYPES:
+        raise UserException(
+            "Unknown dtype %r (accepted: %s)" % (name, ", ".join(sorted(COMPUTE_DTYPES)))
+        )
+    return COMPUTE_DTYPES[name]
 
 
 def group_norm(x, name, dtype):

@@ -146,7 +146,9 @@ class ZooExperiment(Experiment):
         )
         self.aux_weight = kv["aux-weight"] if self.model_name in AUX_CAPABLE else 0.0
         self.dataset = DATASETS[self.dataset_name](kv)
-        dtype = jnp.bfloat16 if kv["dtype"] == "bfloat16" else jnp.float32
+        from .common import check_dtype
+
+        dtype = check_dtype(kv["dtype"])
         classes = self.dataset.nb_classes - self.labels_offset
         small = self.dataset.x_train.shape[1] <= 64
         self.model = MODEL_FACTORY[self.model_name](classes, small, dtype)

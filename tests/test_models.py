@@ -130,3 +130,39 @@ def test_zoo_experiment_end_to_end():
     batch = next(iter(exp.make_eval_iterator(n)))
     sums = jax.device_get(ev(state, engine.shard_batch(batch)))
     assert float(sums["accuracy"][1]) > 0
+
+
+def test_cnnet_bfloat16_compute():
+    """dtype:bfloat16 runs the conv/dense stack in bf16 (MXU rate) while
+    params and logits stay float32; the loss matches f32 to bf16 tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aggregathor_tpu import models
+
+    losses = {}
+    for dt in ("float32", "bfloat16"):
+        ex = models.instantiate("cnnet", ["batch-size:4", "dtype:%s" % dt])
+        params = ex.init(jax.random.PRNGKey(0))
+        assert all(
+            leaf.dtype == jnp.float32 for leaf in jax.tree_util.tree_leaves(params)
+        )
+        batch = next(ex.make_train_iterator(1, seed=0))
+        one = {"image": batch["image"][0], "label": batch["label"][0]}
+        losses[dt] = float(jax.jit(ex.loss)(params, one))
+    assert np.isfinite(losses["bfloat16"])
+    assert abs(losses["float32"] - losses["bfloat16"]) < 0.1 * abs(losses["float32"]) + 0.1
+
+
+def test_bad_dtype_rejected_at_init():
+    import pytest
+
+    from aggregathor_tpu import models
+    from aggregathor_tpu.utils import UserException
+
+    for bad in ("bf16", "int32", "float64"):
+        with pytest.raises(UserException):
+            models.instantiate("cnnet", ["dtype:%s" % bad])
+    with pytest.raises(UserException):
+        models.instantiate("slim-resnet_v1_18-cifar10", ["dtype:bf16"])
