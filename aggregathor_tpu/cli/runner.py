@@ -65,6 +65,12 @@ def build_parser():
              "history-aware robustness (Karimireddy et al. 2021)",
     )
     parser.add_argument(
+        "--worker-metrics", action="store_true",
+        help="record per-worker suspicion diagnostics each summary: squared "
+             "distance to the aggregate and, for selection rules, the "
+             "worker's participation weight (detects persistent attackers)",
+    )
+    parser.add_argument(
         "--prefetch", type=int, default=2, metavar="DEPTH",
         help="device-resident input batches prepared ahead by a background "
              "thread (0 disables; applies to the per-step path, --unroll "
@@ -261,6 +267,7 @@ def main(argv=None):
             mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
             exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
             batch_transform=experiment.device_transform(),
+            worker_metrics=args.worker_metrics,
         )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
@@ -440,6 +447,26 @@ def main(argv=None):
         # because sess.run already blocked, runner.py:570-574).
         pending_loss = None
 
+        def summary_scalars(step, metrics):
+            """The summary event payload — shared by the cadence fires and
+            the final fire, so worker diagnostics never silently drop out of
+            the last event."""
+            scalars = {
+                "total_loss": float(jax.device_get(metrics["total_loss"])),
+                "grad_norm": float(jax.device_get(metrics["grad_norm"])),
+                "learning_rate": float(schedule(step)),
+                "steps_per_s": perf.steps_per_s_excl_first(),
+            }
+            if "worker_sq_dist" in metrics:
+                wd = np.asarray(jax.device_get(metrics["worker_sq_dist"]))
+                scalars["worker_sq_dist"] = wd
+                scalars["suspect_worker"] = int(np.argmax(wd))
+            if "worker_participation" in metrics:
+                scalars["worker_participation"] = np.asarray(
+                    jax.device_get(metrics["worker_participation"])
+                )
+            return scalars
+
         def check_divergence():
             nonlocal diverged
             # ``pending_loss`` is the full per-step loss vector when unrolled,
@@ -501,15 +528,7 @@ def main(argv=None):
                     ckpt_trigger.fired(step)
                 if summary_trigger.should_fire(step):
                     check_divergence()
-                    summaries.scalars(
-                        step,
-                        {
-                            "total_loss": float(jax.device_get(metrics["total_loss"])),
-                            "grad_norm": float(jax.device_get(metrics["grad_norm"])),
-                            "learning_rate": float(schedule(step)),
-                            "steps_per_s": perf.steps_per_s_excl_first(),
-                        },
-                    )
+                    summaries.scalars(step, summary_scalars(step, metrics))
                     summary_trigger.fired(step)
             if pending_loss is not None:
                 check_divergence()
@@ -528,7 +547,7 @@ def main(argv=None):
                 if save_snapshots and ckpt_trigger.last_step != step:
                     checkpoints.save(state, step)
                 if metrics and summary_trigger.last_step != step:
-                    summaries.scalars(step, {"total_loss": float(jax.device_get(metrics["total_loss"]))})
+                    summaries.scalars(step, summary_scalars(step, metrics))
             if prefetcher is not None:
                 prefetcher.close()
             if chunk_prefetcher is not None:

@@ -95,6 +95,15 @@ class GAR:
         """
         raise NotImplementedError
 
+    def worker_participation(self, dist2):
+        """Optional (n,) diagnostic: how much weight each worker's gradient
+        carried in the aggregate (sums to 1).  Selection-based rules override
+        this — a worker the rule consistently excludes is a suspect, the
+        observable the Byzantine-ML literature uses to *detect* attackers
+        rather than only absorb them.  None = not defined for this rule
+        (coordinate-wise rules select per coordinate, not per worker)."""
+        return None
+
 
 # Self-registering rule modules (reference: aggregators/__init__.py:76-85)
 import_directory(__name__, __path__, skip=("oracle",))

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from . import GAR, register
 from .averaged_median import averaged_median_columns
-from .common import nonfinite_to_inf, select_combine, selection_mean_weights
+from .common import memo_by_identity, nonfinite_to_inf, select_combine, selection_mean_weights
 
 
 class BulyanGAR(GAR):
@@ -43,6 +43,7 @@ class BulyanGAR(GAR):
 
             raise UserException("bulyan needs n >= 4f + 3 (got n=%d, f=%d)" % (n, f))
 
+    @memo_by_identity
     def selection_weights(self, dist2):
         """(t, n) weight matrix: row k averages the (m - k) smallest-scoring
         workers after k removals, reproducing the reference's selection loop."""
@@ -75,6 +76,11 @@ class BulyanGAR(GAR):
         assert dist2 is not None, "bulyan requires the pairwise distance matrix"
         selections = select_combine(self.selection_weights(dist2), block)
         return averaged_median_columns(selections, self.nb_selections, self.nb_closest)
+
+    def worker_participation(self, dist2):
+        # Mean over the t Krum-selection rounds of each worker's averaging
+        # weight: a worker every round excludes ends at exactly 0.
+        return jnp.mean(self.selection_weights(dist2), axis=0)
 
 
 register("bulyan", BulyanGAR)

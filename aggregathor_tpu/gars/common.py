@@ -87,6 +87,31 @@ def selection_mean_weights(scores, k):
     return smallest_k_mask(scores, k).astype(jnp.float32) / float(k)
 
 
+def memo_by_identity(method):
+    """Memoize a one-argument method on argument IDENTITY.
+
+    ``aggregate_block`` and ``worker_participation`` both derive from
+    ``selection_weights(dist2)`` within the same traced step; without this,
+    the selection graph (O(n² log n) rank sort + the Bulyan t-round loop) is
+    traced twice and dedup relies on XLA CSE.  Identity keying is
+    trace-safe: a retrace passes a fresh tracer, misses, and overwrites the
+    stale entry (which is never used again)."""
+    import functools
+
+    attr = "_memo_" + method.__name__
+
+    @functools.wraps(method)
+    def wrapped(self, arg):
+        cached = getattr(self, attr, None)
+        if cached is not None and cached[0] is arg:
+            return cached[1]
+        out = method(self, arg)
+        setattr(self, attr, (arg, out))
+        return out
+
+    return wrapped
+
+
 def select_combine(weights, block):
     """Weighted row combination that ignores NaNs in *unselected* rows.
 

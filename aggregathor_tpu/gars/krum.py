@@ -15,7 +15,13 @@ matrix — so the whole rule is MXU work plus a tiny replicated sort, and
 import jax.numpy as jnp
 
 from . import GAR, register
-from .common import nonfinite_to_inf, select_combine, selection_mean_weights, smallest_k_sum
+from .common import (
+    memo_by_identity,
+    nonfinite_to_inf,
+    select_combine,
+    selection_mean_weights,
+    smallest_k_sum,
+)
 
 
 def krum_scores(dist2, nb_workers, nb_byz_workers):
@@ -36,6 +42,7 @@ class KrumGAR(GAR):
 
             raise UserException("krum needs n >= f + 3 (got n=%d, f=%d)" % (nb_workers, nb_byz_workers))
 
+    @memo_by_identity
     def selection_weights(self, dist2):
         """(n,) averaging weights over the m smallest-scoring workers."""
         scores = krum_scores(dist2, self.nb_workers, self.nb_byz_workers)
@@ -44,6 +51,9 @@ class KrumGAR(GAR):
     def aggregate_block(self, block, dist2=None):
         assert dist2 is not None, "krum requires the pairwise distance matrix"
         return select_combine(self.selection_weights(dist2), block)
+
+    def worker_participation(self, dist2):
+        return self.selection_weights(dist2)
 
 
 register("krum", KrumGAR)

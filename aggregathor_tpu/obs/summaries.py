@@ -32,10 +32,23 @@ class SummaryWriter:
             self._fd = open(self.path, "x")
 
     def scalars(self, step, values):
+        """Write one event; values are scalars or small 1-D vectors (e.g. the
+        per-worker suspicion diagnostics), serialized as JSON numbers/lists."""
         if self._fd is None:
             return
+
+        def coerce(value):
+            import numpy as np
+
+            if isinstance(value, (int, np.integer)) and not isinstance(value, bool):
+                return int(value)  # e.g. suspect_worker stays an index
+            try:
+                return float(value)
+            except TypeError:
+                return [float(v) for v in value]
+
         event = {"wall": time.time(), "step": int(step)}
-        event.update({name: float(value) for name, value in values.items()})
+        event.update({name: coerce(value) for name, value in values.items()})
         self._fd.write(json.dumps(event) + "\n")
         self._fd.flush()
 

@@ -61,7 +61,8 @@ class RobustEngine:
     """Builds jitted robust train/eval steps over a (worker, model) mesh."""
 
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
-                 exchange_dtype=None, worker_momentum=None, batch_transform=None):
+                 exchange_dtype=None, worker_momentum=None, batch_transform=None,
+                 worker_metrics=False):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -75,6 +76,10 @@ class RobustEngine:
         # independent of nb_workers/device placement — the same discipline
         # as the host tier (models/preprocessing.py).
         self.batch_transform = batch_transform
+        # Opt-in per-worker suspicion diagnostics (worker_sq_dist / worker_
+        # participation metrics); off by default — the extra O(n·d) pass is
+        # a measurable HBM tax at scale.
+        self.worker_metrics = bool(worker_metrics)
         # History-aware robustness (Karimireddy et al. 2021): with
         # worker_momentum = beta in (0, 1), every worker sends its momentum
         # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
@@ -162,7 +167,11 @@ class RobustEngine:
         return gathered.reshape(self.nb_workers, blk)
 
     def _aggregate_block(self, block, key):
-        """Omniscient attack, distances (psum), blockwise GAR -> (d_block,)."""
+        """Omniscient attack, distances (psum), blockwise GAR.
+
+        Returns ``(agg_block, dist2, block)`` — ``dist2`` (or None) and the
+        post-attack ``block`` the rule actually consumed are surfaced for the
+        worker-suspicion diagnostics."""
         if self.attack is not None and self.attack.omniscient:
             byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
             block = self.attack.apply_matrix(block, byz_mask, key)
@@ -175,7 +184,7 @@ class RobustEngine:
             partial = _partial_pairwise_sq_distances(block)
             dist2 = jax.lax.psum(partial, worker_axis) if self.nb_devices > 1 else partial
             dist2 = jnp.maximum(dist2, 0.0)
-        return self.gar.aggregate_block(block, dist2)
+        return self.gar.aggregate_block(block, dist2), dist2, block
 
     # ------------------------------------------------------------------ #
 
@@ -227,7 +236,7 @@ class RobustEngine:
             block = self._reshard_to_blocks(gvecs, d)
             if self.exchange_dtype is not None:
                 block = block.astype(jnp.float32)  # GAR math always in f32
-            agg_block = self._aggregate_block(block, key)
+            agg_block, dist2, seen_block = self._aggregate_block(block, key)
             if self.exchange_dtype is not None:
                 agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
             if W > 1:
@@ -247,6 +256,20 @@ class RobustEngine:
                 "total_loss": total_loss,
                 "grad_norm": jnp.linalg.norm(agg),
             }
+            if self.worker_metrics:
+                # Suspicion diagnostics over what the aggregator actually saw
+                # (post-attack, post-lossy): squared distance of each worker's
+                # gradient to the aggregate (universal), plus the rule's own
+                # per-worker participation weight when it selects by worker.
+                diff = seen_block - agg_block[None, :]
+                wdist = jnp.sum(diff * diff, axis=1)
+                if W > 1:
+                    wdist = jax.lax.psum(wdist, worker_axis)
+                metrics["worker_sq_dist"] = wdist
+                if dist2 is not None:
+                    participation = self.gar.worker_participation(dist2)
+                    if participation is not None:
+                        metrics["worker_participation"] = participation
             return new_state, metrics
 
         return body

@@ -201,3 +201,25 @@ def test_unroll_prefetch_equivalence(tmp_path):
         [name] = [n for n in os.listdir(ckpt) if n.endswith("-25.ckpt")]
         blobs.append(open(os.path.join(ckpt, name), "rb").read())
     assert blobs[0] == blobs[1]
+
+
+def test_worker_metrics_summaries(tmp_path):
+    """--worker-metrics lands per-worker suspicion vectors in the summary
+    JSONL with a suspect_worker index."""
+    sum_dir = str(tmp_path / "sum")
+    assert 0 == run([
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "krum", "--nb-workers", "4", "--nb-decl-byz-workers", "1",
+        "--nb-real-byz-workers", "1", "--attack", "gaussian", "--attack-args", "deviation:100",
+        "--worker-metrics", "--max-step", "6",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--summary-dir", sum_dir, "--summary-delta", "2",
+    ])
+    [name] = os.listdir(sum_dir)
+    events = [json.loads(l) for l in open(os.path.join(sum_dir, name))]
+    assert events, "no summary events written"
+    for ev in events:
+        assert len(ev["worker_sq_dist"]) == 4
+        assert len(ev["worker_participation"]) == 4
+        # the deviation-100 attacker, serialized as a usable integer index
+        assert ev["suspect_worker"] == 0 and isinstance(ev["suspect_worker"], int)

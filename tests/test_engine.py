@@ -343,3 +343,46 @@ def test_multi_step_matches_single_step_chain():
     for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s3.params)),
                     jax.tree_util.tree_leaves(jax.device_get(s4.params))):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_worker_metrics_expose_attackers():
+    """Opt-in suspicion diagnostics: under a large-deviation Gaussian attack
+    with Multi-Krum, the attackers' participation weight is exactly 0 (never
+    selected) and their squared distance to the aggregate dominates the
+    honest workers'.  (A deviation-100 forgery is an unambiguous outlier at
+    every step; signflip can legitimately win Krum selection early on, when
+    honest gradients are still noise-dominated.)"""
+    import jax
+    import numpy as np
+    import optax
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.parallel.attacks import instantiate as make_attack
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+
+    n, f = 8, 2
+    ex = models.instantiate("mnist", ["batch-size:16"])
+    engine = RobustEngine(
+        make_mesh(nb_workers=4), gars.instantiate("krum", n, f), n,
+        nb_real_byz=f, attack=make_attack("gaussian", n, f, ["deviation:100"]),
+        worker_metrics=True,
+    )
+    tx = optax.sgd(1e-2)
+    state = engine.init_state(ex.init(jax.random.PRNGKey(0)), tx)
+    step = engine.build_step(ex.loss, tx)
+    it = ex.make_train_iterator(n, seed=0)
+    for _ in range(3):
+        state, metrics = step(state, engine.shard_batch(next(it)))
+    wdist = np.asarray(jax.device_get(metrics["worker_sq_dist"]))
+    part = np.asarray(jax.device_get(metrics["worker_participation"]))
+    assert wdist.shape == (n,) and part.shape == (n,)
+    np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-5)
+    # attackers (workers 0, 1) are excluded and far from the aggregate
+    np.testing.assert_allclose(part[:f], 0.0, atol=1e-7)
+    assert wdist[:f].min() > wdist[f:].max()
+    # diagnostics off by default: no extra metrics, no extra cost path
+    plain = RobustEngine(make_mesh(nb_workers=4), gars.instantiate("krum", n, f), n)
+    pstate = plain.init_state(ex.init(jax.random.PRNGKey(0)), tx)
+    _, pmetrics = plain.build_step(ex.loss, tx)(pstate, plain.shard_batch(next(it)))
+    assert "worker_sq_dist" not in pmetrics
