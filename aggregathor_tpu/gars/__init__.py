@@ -26,6 +26,11 @@ from ..utils import ClassRegister, import_directory
 
 gars = ClassRegister("GAR")
 
+#: reserved fold_in tag both engines use to derive the per-step GAR key from
+#: the step key — far above any per-worker stream index, so the randomized
+#: meta-rules' permutations never collide with the attack/lossy streams
+GAR_KEY_TAG = 0x6AC0BEA7
+
 
 def register(name, cls):
     return gars.register(name, cls)
@@ -59,6 +64,16 @@ class GAR:
 
     coordinate_wise = False
     needs_distances = False
+    #: True if ``aggregate_block`` accepts ``axis_name=`` for cross-block
+    #: reductions (iterative rules needing global row norms: the engine
+    #: passes the worker mesh axis so blockwise results match the dense tier
+    #: exactly, at one O(n) psum per internal iteration)
+    uses_axis = False
+    #: True if ``aggregate_block`` accepts ``key=`` (a replicated per-step
+    #: PRNG key) — randomized meta-rules (bucketing) re-draw their
+    #: permutation every step; the key is identical on every device and
+    #: block, so the randomness never breaks replication
+    uses_key = False
     #: typed key:value argument defaults accepted by this rule (strict: an
     #: unknown key raises instead of being silently ignored)
     ARG_DEFAULTS = {}
@@ -80,12 +95,23 @@ class GAR:
         if self.nb_byz_workers < 0:
             raise UserException("Negative declared Byzantine count")
 
-    def aggregate(self, grads):
+    def aggregate(self, grads, key=None):
         """Dense tier: reduce the full (n, d) matrix to (d,)."""
         from .common import pairwise_sq_distances
 
         dist2 = pairwise_sq_distances(grads) if self.needs_distances else None
-        return self.aggregate_block(grads, dist2)
+        return self._call_aggregate(grads, dist2, axis_name=None, key=key)
+
+    def _call_aggregate(self, block, dist2, axis_name=None, key=None):
+        """Invoke ``aggregate_block`` with exactly the keywords this rule
+        declares (``uses_axis``/``uses_key``) — the single dispatch point the
+        engines use, so plain rules keep their two-argument signature."""
+        kwargs = {}
+        if self.uses_axis:
+            kwargs["axis_name"] = axis_name
+        if self.uses_key:
+            kwargs["key"] = key
+        return self.aggregate_block(block, dist2, **kwargs)
 
     def aggregate_block(self, block, dist2=None):
         """Blockwise tier: reduce an (n, d_block) column block to (d_block,).
@@ -103,6 +129,16 @@ class GAR:
         rather than only absorb them.  None = not defined for this rule
         (coordinate-wise rules select per coordinate, not per worker)."""
         return None
+
+    def aggregate_block_and_participation(self, block, dist2=None, axis_name=None, key=None):
+        """Aggregate a block AND return the (n,) participation (or None).
+
+        One entry point so iterative rules (geometric-median) can expose the
+        weights their own iteration already computes — in one pass, with no
+        state stashed on the instance between calls (a stashed jnp value
+        would be a tracer leaking across trace boundaries)."""
+        agg = self._call_aggregate(block, dist2, axis_name=axis_name, key=key)
+        return agg, self.worker_participation(dist2)
 
 
 # Self-registering rule modules (reference: aggregators/__init__.py:76-85)

@@ -1,0 +1,108 @@
+"""Bucketing meta-GAR (Karimireddy, He, Jaggi 2022, "Byzantine-Robust
+Learning on Heterogeneous Datasets via Bucketing").
+
+An extension beyond the reference's rule set, pointed at by the retrieved
+meta-aggregation literature (PAPERS.md): randomly permute the n workers,
+average disjoint buckets of ``s``, and hand the n/s bucket means to any
+inner GAR,
+
+    buckets = mean over groups of s of  g_{pi(1)} ... g_{pi(n)}
+    output  = inner_gar(buckets)
+
+Bucket means have s-times lower variance, so honest heterogeneity (non-iid
+worker data) no longer looks Byzantine to the inner rule — the failure mode
+plain Krum/median provably hit on heterogeneous data.  Each Byzantine
+worker corrupts at most one bucket, so the inner rule runs with the same
+declared ``f`` over ``n/s`` rows (its (n/s, f) feasibility is validated at
+construction).
+
+TPU mapping: one replicated permutation + a (n/s, s, d)->mean reshape —
+pure VPU bandwidth — then the inner rule as usual.  The rule declares
+``uses_key``: the engine feeds the replicated per-step PRNG key, so the
+permutation re-draws every step (the paper's sampling) yet is identical on
+every device and dimension block — replication is never broken.  Inner
+pairwise distances are computed on the bucket means blockwise and completed
+with one psum (``uses_axis``), exactly like the engine does for direct
+distance rules.
+
+NaN rows (lossy link): a dead worker poisons its bucket's mean, and the
+inner rule's own NaN conventions then apply to that bucket row — with
+``inner:krum`` a NaN bucket is never selected, so up to f lossy/Byzantine
+workers still only cost f buckets.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import GAR, instantiate, register
+from .common import centered_gram_sq_distances
+
+
+class BucketingGAR(GAR):
+    coordinate_wise = False
+    needs_distances = False  # distances (if any) are over bucket means, computed here
+    uses_axis = True
+    uses_key = True
+    ARG_DEFAULTS = {"s": 2, "inner": "krum"}
+
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        from ..utils import UserException
+
+        self.s = int(self.args["s"])
+        if self.s < 1 or self.nb_workers % self.s != 0:
+            raise UserException(
+                "bucketing needs s >= 1 dividing n (got n=%d, s=%r)"
+                % (self.nb_workers, self.args["s"])
+            )
+        self.nb_buckets = self.nb_workers // self.s
+        # The inner rule sees n/s rows with (at most) the same f Byzantine
+        # ones — its own (n/s, f) feasibility check runs here, at parse time.
+        self.inner = instantiate(str(self.args["inner"]), self.nb_buckets, self.nb_byz_workers)
+
+    def _buckets(self, block, key):
+        n, s = self.nb_workers, self.s
+        perm = (
+            jax.random.permutation(key, n)
+            if key is not None
+            else jnp.arange(n)  # dense/oracle tier without a step key
+        )
+        grouped = block[perm].reshape(self.nb_buckets, s, block.shape[-1])
+        return jnp.mean(grouped, axis=1), perm
+
+    def _inner_dist2(self, buckets, axis_name):
+        if not self.inner.needs_distances:
+            return None
+        partial = centered_gram_sq_distances(buckets.astype(jnp.float32))
+        if axis_name is not None:
+            partial = jax.lax.psum(partial, axis_name)
+        return jnp.maximum(partial, 0.0)
+
+    def _inner_key(self, key):
+        # A nested uses_key inner (inner:bucketing) must re-randomize too —
+        # hand it a derived key, never the identity-permutation None.
+        return None if key is None else jax.random.fold_in(key, 1)
+
+    def aggregate_block(self, block, dist2=None, axis_name=None, key=None):
+        buckets, _ = self._buckets(block, key)
+        return self.inner._call_aggregate(
+            buckets, self._inner_dist2(buckets, axis_name),
+            axis_name=axis_name, key=self._inner_key(key),
+        )
+
+    def aggregate_block_and_participation(self, block, dist2=None, axis_name=None, key=None):
+        buckets, perm = self._buckets(block, key)
+        agg, bucket_part = self.inner.aggregate_block_and_participation(
+            buckets, self._inner_dist2(buckets, axis_name),
+            axis_name=axis_name, key=self._inner_key(key),
+        )
+        if bucket_part is None:
+            return agg, None
+        # Worker i inherits 1/s of its bucket's participation: scatter the
+        # (n/s,) bucket weights back through the permutation.
+        per_worker = jnp.repeat(bucket_part / self.s, self.s)
+        participation = jnp.zeros(self.nb_workers, per_worker.dtype).at[perm].set(per_worker)
+        return agg, participation
+
+
+register("bucketing", BucketingGAR)

@@ -13,12 +13,10 @@ a bias bounded by tau — and unlike Krum/Bulyan it needs NO pairwise
 distances (O(n·d) per iteration, bandwidth-bound, ideal on TPU).
 
 TPU mapping: each iteration is one norm reduction + one axpy over the
-(n, d) matrix — on the sharded engine the per-row norms need one extra
-O(n) psum per iteration across dimension blocks, so the rule is marked
-``coordinate_wise = False`` with ``needs_distances = False`` and aggregates
-on the gathered rows (the engine's existing blockwise path applies it per
-block with block-local norms, a documented approximation the dense tier
-does not make).
+(n, d) matrix.  The rule declares ``uses_axis``: on the dimension-sharded
+engine the per-row norms (and row finiteness) are completed with one O(n)
+``psum`` per iteration, so the blockwise result is EXACTLY the dense one —
+no block-local approximation.
 
 Non-finite rows clip to radius tau in an arbitrary direction would poison
 the center, so rows with any non-finite coordinate are excluded from every
@@ -29,22 +27,22 @@ of average-nan, which this rule generalizes.
 import jax.numpy as jnp
 
 from . import GAR, register
+from .common import alive_rows, global_row_sq_norms, masked_coordinate_median
 
 
-def centered_clip(rows, tau, iters):
-    """Iterative clipped-deviation center of the (n, d_block) rows."""
-    finite_row = jnp.all(jnp.isfinite(rows), axis=-1, keepdims=True)
-    safe = jnp.where(finite_row, rows, 0.0)
-    nb_alive = jnp.maximum(jnp.sum(finite_row.astype(jnp.float32)), 1.0)
-    # robust start: coordinate-wise median of the finite rows
-    center = jnp.nan_to_num(
-        jnp.nanmedian(jnp.where(finite_row, rows, jnp.nan), axis=0)
-    )
+def centered_clip(rows, tau, iters, axis_name=None):
+    """Iterative clipped-deviation center of the (n, d_block) rows.
+
+    With ``axis_name`` the row norms and row finiteness psum across
+    dimension blocks, making the blockwise result identical to dense."""
+    alive, safe = alive_rows(rows, axis_name)
+    nb_alive = jnp.maximum(jnp.sum(alive), 1.0)
+    center = masked_coordinate_median(rows, alive)
     for _ in range(iters):
         deviation = safe - center[None, :]
-        norms = jnp.sqrt(jnp.sum(deviation * deviation, axis=-1, keepdims=True))
+        norms = jnp.sqrt(global_row_sq_norms(deviation, axis_name))[:, None]
         scale = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-12))
-        clipped = deviation * scale * finite_row
+        clipped = deviation * scale * alive[:, None]
         center = center + jnp.sum(clipped, axis=0) / nb_alive
     return center
 
@@ -52,6 +50,7 @@ def centered_clip(rows, tau, iters):
 class CenteredClipGAR(GAR):
     coordinate_wise = False
     needs_distances = False
+    uses_axis = True  # exact blockwise norms via one psum per iteration
     ARG_DEFAULTS = {"tau": 10.0, "iters": 3}
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
@@ -68,8 +67,8 @@ class CenteredClipGAR(GAR):
             warning("centered-clip tolerates f < n/2; n=%d f=%d is out of bound"
                     % (self.nb_workers, self.nb_byz_workers))
 
-    def aggregate_block(self, block, dist2=None):
-        return centered_clip(block, self.tau, self.iters)
+    def aggregate_block(self, block, dist2=None, axis_name=None):
+        return centered_clip(block, self.tau, self.iters, axis_name)
 
 
 register("centered-clip", CenteredClipGAR)

@@ -87,6 +87,36 @@ def selection_mean_weights(scores, k):
     return smallest_k_mask(scores, k).astype(jnp.float32) / float(k)
 
 
+def alive_rows(rows, axis_name=None):
+    """Global row liveness for NaN-absorbing iterative rules.
+
+    Returns ``(alive, safe)``: the (n,) float mask of rows with NO
+    non-finite coordinate (counted across dimension blocks by psum when
+    ``axis_name`` is given, so every shard agrees) and the rows with dead
+    entries zero-filled.  The average-nan convention: dead rows weigh 0."""
+    nb_bad = jnp.sum(~jnp.isfinite(rows), axis=-1).astype(jnp.float32)
+    if axis_name is not None:
+        nb_bad = jax.lax.psum(nb_bad, axis_name)
+    alive = (nb_bad == 0.0).astype(jnp.float32)
+    return alive, jnp.where((alive > 0.0)[:, None], rows, 0.0)
+
+
+def masked_coordinate_median(rows, alive):
+    """Coordinate-wise median of the alive rows (0 where all rows are dead).
+    Per-coordinate: needs no cross-block information."""
+    return jnp.nan_to_num(
+        jnp.nanmedian(jnp.where((alive > 0.0)[:, None], rows, jnp.nan), axis=0)
+    )
+
+
+def global_row_sq_norms(deviation, axis_name=None):
+    """(n,) squared row norms, completed across dimension blocks by psum."""
+    sqn = jnp.sum(deviation * deviation, axis=-1)
+    if axis_name is not None:
+        sqn = jax.lax.psum(sqn, axis_name)
+    return sqn
+
+
 def memo_by_identity(method):
     """Memoize a one-argument method on argument IDENTITY.
 

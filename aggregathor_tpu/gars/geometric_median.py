@@ -1,0 +1,92 @@
+"""Geometric-median GAR (RFA — Pillutla, Kakade, Harchaoui 2022, "Robust
+Aggregation for Federated Learning").
+
+An extension beyond the reference's rule set: the aggregate is the point
+minimizing the sum of Euclidean distances to the worker gradients,
+
+    z* = argmin_z  sum_i |g_i - z|,
+
+approximated by a fixed number of Weiszfeld iterations from the
+coordinate-wise median,
+
+    w_i <- 1 / max(|g_i - z|, eps),    z <- sum_i w_i g_i / sum_i w_i.
+
+Breakdown point 1/2: any minority coalition (f < n/2) moves the estimate
+by a bounded amount regardless of forgery magnitude.  Like centered-clip it
+needs NO pairwise distance matrix — O(n·d) per iteration, bandwidth-bound.
+
+TPU mapping: each iteration is one row-norm reduction plus one weighted
+row combine, both MXU/VPU-friendly.  On dimension-sharded engines the
+per-row squared norms are completed with one O(n) ``psum`` per iteration
+across blocks (``uses_axis``), so the blockwise result is EXACTLY the
+dense one — every shard derives identical weights and the aggregate stays
+replicated.
+
+Non-finite rows (the lossy link's NaN infill) get weight 0 everywhere —
+the NaN-absorbing convention of average-nan; all-rows-dead yields 0 like
+an empty reassembly buffer.  The final normalized Weiszfeld weights double
+as per-worker participation (a far-away forgery converges to weight ~0),
+returned through ``aggregate_block_and_participation`` for the suspicion
+diagnostics — in one pass, no state carried between calls.
+"""
+
+import jax.numpy as jnp
+
+from . import GAR, register
+from .common import alive_rows, global_row_sq_norms, masked_coordinate_median
+
+
+def geometric_median(rows, iters, eps, axis_name=None):
+    """Weiszfeld geometric median of the (n, d_block) rows.
+
+    Returns ``(z, participation)`` — the (d_block,) estimate and the (n,)
+    final normalized weights.  With ``axis_name``, row norms and row
+    finiteness are completed across dimension blocks by ``psum``.
+    """
+    alive, safe = alive_rows(rows, axis_name)
+    # Robust start: a mean init begins ~|forgery| away from the honest
+    # cloud and Weiszfeld only closes that distance at a linear rate; the
+    # coordinate-wise median starts inside it.
+    z = masked_coordinate_median(rows, alive)
+    weights = alive  # overwritten by the first iteration (iters >= 1)
+    for _ in range(iters):
+        sqn = global_row_sq_norms(safe - z[None, :], axis_name)
+        weights = alive / jnp.maximum(jnp.sqrt(sqn), eps)
+        total = jnp.maximum(jnp.sum(weights), 1e-30)
+        z = jnp.sum(weights[:, None] * safe, axis=0) / total
+        weights = weights / total
+    return z, weights
+
+
+class GeometricMedianGAR(GAR):
+    coordinate_wise = False
+    needs_distances = False
+    uses_axis = True  # exact blockwise norms via one psum per iteration
+    ARG_DEFAULTS = {"iters": 8, "eps": 1e-6}
+
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        self.iters = int(self.args["iters"])
+        self.eps = float(self.args["eps"])
+        from ..utils import UserException
+
+        if self.iters < 1 or self.eps <= 0:
+            raise UserException("geometric-median needs iters >= 1 and eps > 0")
+        if self.nb_workers <= 2 * self.nb_byz_workers:
+            from ..utils import warning
+
+            warning(
+                "geometric-median tolerates f < n/2; n=%d f=%d is out of bound"
+                % (self.nb_workers, self.nb_byz_workers)
+            )
+
+    def aggregate_block(self, block, dist2=None, axis_name=None):
+        z, _ = geometric_median(block, self.iters, self.eps, axis_name)
+        return z
+
+    def aggregate_block_and_participation(self, block, dist2=None, axis_name=None, key=None):
+        return geometric_median(block, self.iters, self.eps, axis_name)
+
+
+register("geometric-median", GeometricMedianGAR)
+register("rfa", GeometricMedianGAR)  # the rule's common literature name

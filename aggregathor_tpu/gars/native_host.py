@@ -56,7 +56,7 @@ def _dense(host_fn):
     ``pure_callback`` so the native tier composes with jit.
     """
 
-    def aggregate(self, grads):
+    def aggregate(self, grads, key=None):
         if isinstance(grads, np.ndarray):
             return host_fn(self, grads)
         import jax

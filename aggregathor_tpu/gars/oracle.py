@@ -164,3 +164,29 @@ def centered_clip(grads, f, tau=10.0, iters=3):
         scale = np.minimum(1.0, tau / np.maximum(norms, 1e-12))
         center = center + (deviation * scale * finite_row).sum(axis=0) / nb_alive
     return center
+
+
+def geometric_median(grads, f, iters=8, eps=1e-6):
+    """Weiszfeld geometric median (extension; see gars/geometric_median.py)."""
+    grads = np.asarray(grads, dtype=np.float64)
+    alive = np.all(np.isfinite(grads), axis=-1).astype(np.float64)
+    safe = np.where(alive[:, None] > 0, grads, 0.0)
+    with np.errstate(all="ignore"):
+        z = np.nan_to_num(
+            np.nanmedian(np.where(alive[:, None] > 0, grads, np.nan), axis=0)
+        )
+    for _ in range(iters):
+        norms = np.sqrt(((safe - z[None, :]) ** 2).sum(axis=-1))
+        weights = alive / np.maximum(norms, eps)
+        z = (weights[:, None] * safe).sum(axis=0) / max(float(weights.sum()), 1e-30)
+    return z
+
+
+def bucketing(grads, f, perm, s, inner, **inner_kwargs):
+    """Permute, average buckets of s, apply the inner oracle (extension; see
+    gars/bucketing.py).  ``perm`` is supplied so tests can mirror the jit
+    tier's key-derived permutation."""
+    grads = np.asarray(grads, dtype=np.float64)
+    n, d = grads.shape
+    buckets = grads[np.asarray(perm)].reshape(n // s, s, d).mean(axis=1)
+    return inner(buckets, f, **inner_kwargs)

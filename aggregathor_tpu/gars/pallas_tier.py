@@ -38,13 +38,13 @@ class PallasAverageNaNGAR(AverageNaNGAR):
 
 
 class PallasKrumGAR(KrumGAR):
-    def aggregate(self, grads):
+    def aggregate(self, grads, key=None):
         dist2 = pk.pairwise_sq_distances(grads)
         return self.aggregate_block(grads, dist2)
 
 
 class PallasBulyanGAR(BulyanGAR):
-    def aggregate(self, grads):
+    def aggregate(self, grads, key=None):
         dist2 = pk.pairwise_sq_distances(grads)
         return self.aggregate_block(grads, dist2)
 

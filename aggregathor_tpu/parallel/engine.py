@@ -169,9 +169,10 @@ class RobustEngine:
     def _aggregate_block(self, block, key):
         """Omniscient attack, distances (psum), blockwise GAR.
 
-        Returns ``(agg_block, dist2, block)`` — ``dist2`` (or None) and the
-        post-attack ``block`` the rule actually consumed are surfaced for the
-        worker-suspicion diagnostics."""
+        Returns ``(agg_block, participation, block)`` — the (n,) worker
+        participation (or None; computed only under ``worker_metrics``) and
+        the post-attack ``block`` the rule actually consumed, surfaced for
+        the worker-suspicion diagnostics."""
         if self.attack is not None and self.attack.omniscient:
             byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
             block = self.attack.apply_matrix(block, byz_mask, key)
@@ -184,7 +185,19 @@ class RobustEngine:
             partial = _partial_pairwise_sq_distances(block)
             dist2 = jax.lax.psum(partial, worker_axis) if self.nb_devices > 1 else partial
             dist2 = jnp.maximum(dist2, 0.0)
-        return self.gar.aggregate_block(block, dist2), dist2, block
+        axis = worker_axis if self.nb_devices > 1 else None
+        # Replicated per-step key for randomized meta-rules (bucketing's
+        # permutation); the reserved tag keeps it disjoint from the
+        # per-worker attack/lossy streams.
+        from ..gars import GAR_KEY_TAG
+
+        gar_key = jax.random.fold_in(key, GAR_KEY_TAG)
+        if self.worker_metrics:
+            agg, participation = self.gar.aggregate_block_and_participation(
+                block, dist2, axis_name=axis, key=gar_key
+            )
+            return agg, participation, block
+        return self.gar._call_aggregate(block, dist2, axis_name=axis, key=gar_key), None, block
 
     # ------------------------------------------------------------------ #
 
@@ -236,7 +249,7 @@ class RobustEngine:
             block = self._reshard_to_blocks(gvecs, d)
             if self.exchange_dtype is not None:
                 block = block.astype(jnp.float32)  # GAR math always in f32
-            agg_block, dist2, seen_block = self._aggregate_block(block, key)
+            agg_block, participation, seen_block = self._aggregate_block(block, key)
             if self.exchange_dtype is not None:
                 agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
             if W > 1:
@@ -266,10 +279,8 @@ class RobustEngine:
                 if W > 1:
                     wdist = jax.lax.psum(wdist, worker_axis)
                 metrics["worker_sq_dist"] = wdist
-                if dist2 is not None:
-                    participation = self.gar.worker_participation(dist2)
-                    if participation is not None:
-                        metrics["worker_participation"] = participation
+                if participation is not None:
+                    metrics["worker_participation"] = participation
             return new_state, metrics
 
         return body

@@ -103,6 +103,16 @@ class ShardedRobustEngine:
         self.carries_gradients = lossy_link is not None and lossy_link.clever
         if granularity not in ("layer", "leaf", "global"):
             raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
+        if granularity == "global" and (gar.uses_axis or gar.uses_key) and not gar.needs_distances:
+            # The global path concatenates DISTANCES across leaves; iterative
+            # rules would need their per-iteration row norms accumulated
+            # across every leaf instead, which the per-leaf loop cannot do —
+            # refuse rather than silently degrade to per-leaf semantics.
+            raise UserException(
+                "granularity:global is not supported for %s (whole-vector norms "
+                "across leaves are not implemented); use granularity:layer"
+                % type(gar).__name__
+            )
         self.granularity = granularity
         if gar.nb_workers != self.nb_workers:
             raise UserException(
@@ -308,6 +318,20 @@ class ShardedRobustEngine:
                     else:
                         dist2 = self._bucket_distances(rows, s)
                     agg = jax.vmap(gar.aggregate_block)(rows, dist2)
+                elif gar.uses_axis or gar.uses_key:
+                    # Iterative rules' row norms complete over the model axis
+                    # when this leaf's dimensions are sharded across it —
+                    # exactly _bucket_distances' discipline — so every shard
+                    # derives identical weights and the result matches dense.
+                    # Randomized meta-rules get the replicated step key (one
+                    # permutation per step, same on every device and leaf).
+                    axis = model_axis if model_axis in _spec_axis_names(s) else None
+                    from ..gars import GAR_KEY_TAG
+
+                    gkey = jax.random.fold_in(key, GAR_KEY_TAG)
+                    agg = jax.vmap(
+                        lambda r, axis=axis: gar._call_aggregate(r, None, axis_name=axis, key=gkey)
+                    )(rows)
                 else:
                     agg = jax.vmap(lambda r: gar.aggregate_block(r, None))(rows)
                 agg_leaves.append(agg.reshape(g.shape).astype(g.dtype))

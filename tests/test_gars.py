@@ -7,7 +7,7 @@ from aggregathor_tpu import gars
 from aggregathor_tpu.gars import oracle
 
 RULES = ["average", "average-nan", "median", "averaged-median", "krum", "bulyan",
-         "trimmed-mean", "centered-clip"]
+         "trimmed-mean", "centered-clip", "geometric-median"]
 ORACLES = {
     "average": oracle.average,
     "average-nan": oracle.average_nan,
@@ -17,6 +17,7 @@ ORACLES = {
     "bulyan": oracle.bulyan,
     "trimmed-mean": oracle.trimmed_mean,
     "centered-clip": oracle.centered_clip,
+    "geometric-median": oracle.geometric_median,
 }
 
 
@@ -53,7 +54,8 @@ def test_permutation_equivariance(rule, rng):
 
 
 @pytest.mark.parametrize(
-    "rule", ["median", "averaged-median", "krum", "bulyan", "trimmed-mean", "centered-clip"]
+    "rule", ["median", "averaged-median", "krum", "bulyan", "trimmed-mean",
+             "centered-clip", "geometric-median"]
 )
 def test_byzantine_robustness(rule, rng):
     """With f adversarial rows pushing a huge vector, the aggregate must stay
@@ -192,3 +194,178 @@ def test_centered_clip_excludes_nonfinite_rows(rng):
     # removing the poisoned row entirely gives a nearby center
     alone = np.asarray(gars.instantiate("centered-clip", 7, 1).aggregate(grads[[0] + list(range(2, 8))]))
     np.testing.assert_allclose(out, alone, rtol=1e-3, atol=1e-4)
+
+
+def test_geometric_median_blockwise_exact(rng):
+    """uses_axis rules on the sharded engine match the dense tier EXACTLY:
+    n=8 over 8, 4 and 1 devices yields the same aggregate (global row norms
+    via psum — no block-local approximation)."""
+    import jax
+
+    from aggregathor_tpu.core.flatten import FlatMap  # noqa: F401 (engine dep)
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+    import optax
+
+    from aggregathor_tpu import models
+
+    ex = models.instantiate("mnist", ["batch-size:8"])
+    batch = next(ex.make_train_iterator(8, seed=3))
+    results = {}
+    for rule in ("geometric-median", "centered-clip"):
+        for nb_devices in (8, 4, 1):
+            eng = RobustEngine(make_mesh(nb_workers=nb_devices), gars.instantiate(rule, 8, 2), 8)
+            tx = optax.sgd(1e-2)
+            state = eng.init_state(ex.init(jax.random.PRNGKey(0)), tx)
+            state, m = eng.build_step(ex.loss, tx)(state, eng.shard_batch(batch))
+            results[nb_devices] = jax.device_get(state.params)
+        for d in (4, 1):
+            for a, b in zip(
+                jax.tree_util.tree_leaves(results[8]), jax.tree_util.tree_leaves(results[d])
+            ):
+                np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6, err_msg=rule)
+
+
+def test_geometric_median_nan_rows_ignored(rng):
+    """Rows with any non-finite coordinate get weight 0 (average-nan
+    convention); all-dead yields zeros."""
+    grads = make_grads(rng, n=9)
+    grads[2, 5] = np.nan
+    grads[6, :] = np.inf
+    gar = gars.instantiate("geometric-median", 9, 2)
+    out = np.asarray(gar.aggregate(grads))
+    assert np.all(np.isfinite(out))
+    honest = np.delete(grads, (2, 6), axis=0)
+    want = oracle.geometric_median(honest, 2)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    dead = np.full((5, 7), np.nan, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(gars.instantiate("geometric-median", 5, 1).aggregate(dead)), 0.0)
+
+
+def test_geometric_median_participation_downweights_outlier(rng):
+    """The final Weiszfeld weights expose the outlier: its participation is
+    far below every honest worker's.  (Weights come back from the same pass
+    as the aggregate — no state stashed between calls.)"""
+    import jax
+
+    grads = make_grads(rng, n=9)
+    grads[0] = 1e4
+    gar = gars.instantiate("geometric-median", 9, 2)
+    agg, part = jax.jit(gar.aggregate_block_and_participation)(grads)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(gar.aggregate(grads)), rtol=1e-5)
+    part = np.asarray(jax.device_get(part))
+    assert part.shape == (9,)
+    np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-4)
+    assert part[0] < 0.1 * part[1:].min()
+
+
+def test_bucketing_matches_oracle_composition(rng):
+    """bucketing(inner=krum) == numpy bucket means (same permutation) fed to
+    the krum oracle; key=None uses the identity permutation."""
+    import jax
+
+    n, s, f = 12, 2, 1
+    grads = make_grads(rng, n=n)
+    gar = gars.instantiate("bucketing", n, f, ["s:2", "inner:krum"])
+    key = jax.random.PRNGKey(5)
+    got = np.asarray(jax.jit(gar.aggregate)(grads, key=key))
+    perm = np.asarray(jax.random.permutation(key, n))
+    want = oracle.bucketing(grads, f, perm, s, oracle.krum)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got_id = np.asarray(gar.aggregate(grads))
+    want_id = oracle.bucketing(grads, f, np.arange(n), s, oracle.krum)
+    np.testing.assert_allclose(got_id, want_id, rtol=1e-4, atol=1e-5)
+    # the key really drives the permutation (different key -> different buckets)
+    assert not np.allclose(got, got_id)
+
+
+def test_bucketing_robustness_and_participation(rng):
+    """f huge outliers corrupt at most f buckets: the inner krum never picks
+    them, the aggregate stays in the honest cloud, and the scattered-back
+    participation is 0 for every attacker."""
+    import jax
+
+    n, f = 12, 2
+    grads = make_grads(rng, n=n)
+    attacked = grads.copy()
+    attacked[:f] = 1e6
+    gar = gars.instantiate("bucketing", n, f, ["s:2", "inner:krum"])
+    key = jax.random.PRNGKey(9)
+    dist2 = None
+    agg, part = jax.jit(
+        lambda g: gar.aggregate_block_and_participation(g, dist2, key=key)
+    )(attacked)
+    agg, part = np.asarray(agg), np.asarray(part)
+    honest_max = np.abs(grads[f:]).max() * n
+    assert np.all(np.abs(agg) <= honest_max)
+    assert part.shape == (n,)
+    np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(part[:f], 0.0, atol=1e-7)
+
+
+def test_bucketing_validation():
+    import pytest
+
+    from aggregathor_tpu.utils import UserException
+
+    with pytest.raises(UserException):
+        gars.instantiate("bucketing", 10, 1, ["s:3"])  # s must divide n
+    with pytest.raises(UserException):
+        # inner krum feasibility at n/s rows: 8/2=4 buckets < f+3
+        gars.instantiate("bucketing", 8, 2, ["s:2", "inner:krum"])
+    gar = gars.instantiate("bucketing", 8, 1, ["s:2", "inner:median"])
+    assert gar.nb_buckets == 4
+
+
+def test_bucketing_engine_device_invariance(rng):
+    """The per-step permutation key is replicated: n=8 over 8 and 1 devices
+    produce identical params, and per-step permutations actually differ."""
+    import jax
+    import optax
+
+    from aggregathor_tpu import models
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+
+    ex = models.instantiate("mnist", ["batch-size:8"])
+    batches = [next(ex.make_train_iterator(8, seed=6)) for _ in range(3)]
+    outs = {}
+    for nb_devices in (8, 1):
+        eng = RobustEngine(
+            make_mesh(nb_workers=nb_devices),
+            gars.instantiate("bucketing", 8, 1, ["s:2", "inner:krum"]), 8,
+        )
+        tx = optax.sgd(1e-2)
+        state = eng.init_state(ex.init(jax.random.PRNGKey(0)), tx)
+        step = eng.build_step(ex.loss, tx)
+        for b in batches:
+            state, _ = step(state, eng.shard_batch(b))
+        outs[nb_devices] = jax.device_get(state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[8]), jax.tree_util.tree_leaves(outs[1])):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_nested_bucketing_forwards_key(rng):
+    """inner:bucketing re-randomizes too: with a key the nested permutation
+    differs from identity, so the output differs from the key=None run."""
+    import jax
+
+    grads = make_grads(rng, n=16, d=23)
+    gar = gars.instantiate("bucketing", 16, 1, ["s:2", "inner:bucketing"])
+    with_key = np.asarray(gar.aggregate(grads, key=jax.random.PRNGKey(3)))
+    identity = np.asarray(gar.aggregate(grads))
+    assert with_key.shape == identity.shape == (23,)
+    assert not np.allclose(with_key, identity)
+
+
+def test_global_granularity_rejected_for_iterative_rules():
+    import pytest
+
+    from aggregathor_tpu.parallel.mesh import make_mesh
+    from aggregathor_tpu.parallel.sharded_engine import ShardedRobustEngine
+    from aggregathor_tpu.utils import UserException
+
+    mesh = make_mesh(nb_workers=2, model_parallelism=2, pipeline_parallelism=2)
+    for rule in ("geometric-median", "bucketing"):
+        with pytest.raises(UserException):
+            ShardedRobustEngine(mesh, gars.instantiate(rule, 2, 0), granularity="global")

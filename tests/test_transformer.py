@@ -243,3 +243,30 @@ def test_sharded_engine_clever_lossy(rng):
     assert losses[-1] < losses[0], losses
     finite = [bool(np.isfinite(np.asarray(l)).all()) for l in jax.tree_util.tree_leaves(state.params)]
     assert all(finite)
+
+
+def test_sharded_engine_uses_axis_rules_exact_across_tp(rng):
+    """uses_axis rules (geometric-median, centered-clip) psum their row norms
+    over the model axis: a tp=2 run must produce the tp=1 params (no
+    shard-local-norm approximation)."""
+    batch = _batch(rng, 2)
+    loss1 = tfm.make_pipeline_loss(CFG, n_stages=1, microbatches=2)
+    for rule in ("geometric-median", "centered-clip"):
+        outs = {}
+        for tp in (1, 2):
+            mesh = make_mesh(nb_workers=2, model_parallelism=tp, pipeline_parallelism=1)
+            gar = gars.instantiate(rule, 2, 0)
+            eng = ShardedRobustEngine(mesh, gar, granularity="layer")
+            tx = optax.sgd(0.05)
+            state = eng.init_state(
+                lambda k: tfm.init_params(CFG, k, n_stages=1), tfm.param_specs(CFG), tx
+            )
+            step = eng.build_step(loss1, tx, state)
+            state, _ = step(state, eng.shard_batch(batch))
+            outs[tp] = jax.device_get(state.params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(outs[1]), jax.tree_util.tree_leaves(outs[2])
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5, err_msg=rule
+            )
