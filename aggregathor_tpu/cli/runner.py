@@ -328,6 +328,11 @@ def main(argv=None):
         args.checkpoint_dir,
         pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
         args.checkpoint_keep,
+        # Serialization + disk I/O run on a writer thread (the host fetch
+        # stays synchronous — the step donates the state buffers); wait()
+        # joins at every later fire and at exit, so a failing write surfaces
+        # within one cadence and a returned run is fully flushed.
+        background=True,
     ) if args.checkpoint_dir else None
     save_snapshots = checkpoints is not None and lead
     eval_file = EvalFile(args.evaluation_file if lead else None)
@@ -524,6 +529,7 @@ def main(argv=None):
                     eval_trigger.fired(step)
                 if save_snapshots and ckpt_trigger.should_fire(step):
                     check_divergence()
+                    checkpoints.wait()  # surface a previous write's failure
                     checkpoints.save(state, step)
                     ckpt_trigger.fired(step)
                 if summary_trigger.should_fire(step):
@@ -555,6 +561,18 @@ def main(argv=None):
             eval_file.close()
             summaries.close()
             perf.report()
+            if checkpoints is not None:
+                # LAST cleanup step, so a flush failure can no longer skip
+                # the closes/report above: a returned run is fully flushed
+                # to disk.  If an exception is already propagating, the
+                # flush failure must not mask it — log it instead.
+                if sys.exc_info()[0] is None:
+                    checkpoints.wait()
+                else:
+                    try:
+                        checkpoints.wait()
+                    except Exception as exc:
+                        warning("Checkpoint write failed during abort: %s" % exc)
     return 0
 
 

@@ -15,6 +15,14 @@ Optional authentication: pass ``authenticator`` (a
 ``parallel.auth.GradientAuthenticator``) and every snapshot is HMAC-tagged
 in a ``.tag`` sidecar and verified on restore — the host-boundary
 counterpart of the reference's signed tensor pushes (docs/transport.md).
+
+Optional background writes (``background=True``, orbax-style): ``save``
+fetches the state to host synchronously — the caller may donate the device
+buffers to its very next step dispatch, so the device_get cannot be
+deferred — then hands serialization + HMAC + disk I/O + pruning to a
+single worker thread and returns.  ``wait()`` joins pending writes and
+re-raises any failure; the runner calls it before exiting and the reference
+semantics (a completed ``save`` is restorable) hold once it returns.
 """
 
 import os
@@ -27,12 +35,22 @@ from ..utils import UserException, info
 
 
 class Checkpoints:
-    def __init__(self, directory, base_name="model", max_to_keep=5, authenticator=None):
+    def __init__(self, directory, base_name="model", max_to_keep=5, authenticator=None,
+                 background=False):
         self.directory = directory
         self.base_name = base_name
         self.max_to_keep = int(max_to_keep)
         self.authenticator = authenticator
         self._pattern = re.compile(re.escape(base_name) + r"-(\d+)\.ckpt$")
+        self._pool = None
+        self._pending = []
+        if background:
+            import concurrent.futures
+
+            # One worker: writes (and their prunes) stay strictly ordered.
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt"
+            )
         if directory:
             os.makedirs(directory, exist_ok=True)
 
@@ -82,7 +100,10 @@ class Checkpoints:
         return state, step
 
     def save(self, state, step=None):
-        """Snapshot ``state``; prunes beyond ``max_to_keep`` oldest-first."""
+        """Snapshot ``state``; prunes beyond ``max_to_keep`` oldest-first.
+
+        With ``background=True`` only the host fetch happens here; the rest
+        runs on the writer thread and ``wait()`` surfaces its failures."""
         if step is None:
             step = int(jax.device_get(state.step))
         for field in ("carry", "momentum"):
@@ -90,7 +111,29 @@ class Checkpoints:
                 # Not serialized (core/train_state.py) — drop BEFORE device_get
                 # or the (n, d) matrix crosses to the host just to be discarded.
                 state = state.replace(**{field: None})
-        data = flax.serialization.to_bytes(jax.device_get(state))
+        host_state = jax.device_get(state)
+        if self._pool is not None:
+            self._pending.append(self._pool.submit(self._write, host_state, step))
+            return self._path(step)
+        return self._write(host_state, step)
+
+    def wait(self):
+        """Join ALL pending background writes, then re-raise the first
+        failure — a later write is never left unjoined (or its failure
+        silently dropped) because an earlier one raised."""
+        pending, self._pending = self._pending, []
+        first_error = None
+        for future in pending:
+            try:
+                future.result()
+            except Exception as exc:
+                if first_error is None:
+                    first_error = exc
+        if first_error is not None:
+            raise first_error
+
+    def _write(self, host_state, step):
+        data = flax.serialization.to_bytes(host_state)
         path = self._path(step)
         if self.authenticator is not None:
             # Slot 0 = the controller identity; the step binding ties each tag

@@ -58,7 +58,11 @@ def main():
     ap.add_argument("--n", type=int, default=32, help="worker count")
     ap.add_argument("--f", type=int, default=8, help="declared Byzantine count")
     ap.add_argument("--dims", default="65536,1048576,8388608", help="comma list of d")
-    ap.add_argument("--rules", default="average,average-nan,median,averaged-median,krum,bulyan")
+    ap.add_argument(
+        "--rules",
+        default="average,average-nan,median,averaged-median,krum,bulyan,"
+                "trimmed-mean,centered-clip,geometric-median,bucketing",
+    )
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--platform", default=None, help="force a JAX platform")
     args = ap.parse_args()
