@@ -77,7 +77,7 @@ class ShardedRobustEngine:
     """Robust Byzantine-DP over logical workers that each span a submesh."""
 
     def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
-                 exchange_dtype=None, worker_momentum=None):
+                 exchange_dtype=None, worker_momentum=None, worker_metrics=False):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
@@ -101,6 +101,10 @@ class ShardedRobustEngine:
         # (the reference's >1 MB UDP threshold is per-tensor too,
         # mpi_rendezvous_mgr.patch:507-513); buffer layout mirrors momentum.
         self.carries_gradients = lossy_link is not None and lossy_link.clever
+        # Opt-in per-worker suspicion diagnostics, the flat engine's
+        # worker_metrics: whole-model squared distance to the aggregate and
+        # the mean per-bucket participation (see parallel/engine.py).
+        self.worker_metrics = bool(worker_metrics)
         if granularity not in ("layer", "leaf", "global"):
             raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
         if granularity == "global" and (gar.uses_axis or gar.uses_key) and not gar.needs_distances:
@@ -311,13 +315,33 @@ class ShardedRobustEngine:
                 global_dist2 = jnp.maximum(jax.lax.psum(acc, _IN_GROUP_AXES), 0.0)
 
             agg_leaves = []
+            # Suspicion accumulators (worker_metrics): whole-model per-worker
+            # squared distance to the aggregate — per-leaf partials scaled by
+            # the replication factor exactly like grad_norm's, psum-completed
+            # below — and the mean per-bucket participation.  Participation
+            # values are identical on every in-group device EXCEPT along the
+            # pipe axis of stage-stacked leaves (distinct buckets), so each
+            # contribution is scaled by 1/(replicating axes' size) and the
+            # in-group psum then counts every distinct bucket exactly once.
+            wdist = jnp.zeros((self.nb_workers,), jnp.float32)
+            part_sum = jnp.zeros((self.nb_workers,), jnp.float32)
+            part_count = 0.0  # global distinct-bucket count (static)
             for rows, g, s in zip(all_rows, g_leaves, s_leaves):
+                participation = None
                 if gar.needs_distances:
                     if global_dist2 is not None:
                         dist2 = jnp.broadcast_to(global_dist2, rows.shape[:1] + global_dist2.shape)
                     else:
                         dist2 = self._bucket_distances(rows, s)
-                    agg = jax.vmap(gar.aggregate_block)(rows, dist2)
+                    if self.worker_metrics:
+                        # One pass: the memoized selection graph serves both
+                        # the aggregate and the participation (two separate
+                        # vmaps would trace it twice per leaf).
+                        agg, participation = jax.vmap(gar.aggregate_block_and_participation)(
+                            rows, dist2
+                        )
+                    else:
+                        agg = jax.vmap(gar.aggregate_block)(rows, dist2)
                 elif gar.uses_axis or gar.uses_key:
                     # Iterative rules' row norms complete over the model axis
                     # when this leaf's dimensions are sharded across it —
@@ -329,11 +353,34 @@ class ShardedRobustEngine:
                     from ..gars import GAR_KEY_TAG
 
                     gkey = jax.random.fold_in(key, GAR_KEY_TAG)
-                    agg = jax.vmap(
-                        lambda r, axis=axis: gar._call_aggregate(r, None, axis_name=axis, key=gkey)
-                    )(rows)
+                    if self.worker_metrics:
+                        agg, participation = jax.vmap(
+                            lambda r, axis=axis: gar.aggregate_block_and_participation(
+                                r, None, axis_name=axis, key=gkey
+                            )
+                        )(rows)
+                    else:
+                        agg = jax.vmap(
+                            lambda r, axis=axis: gar._call_aggregate(r, None, axis_name=axis, key=gkey)
+                        )(rows)
                 else:
                     agg = jax.vmap(lambda r: gar.aggregate_block(r, None))(rows)
+                if self.worker_metrics:
+                    diff = rows.astype(jnp.float32) - agg.astype(jnp.float32)[:, None, :]
+                    wdist = wdist + jnp.sum(diff * diff, axis=(0, 2)) * self._replication_scale(s)
+                    if participation is not None:
+                        stacked = (
+                            self.granularity == "layer" and s is not None
+                            and len(s) >= 2 and s[0] == pipe_axis
+                        )
+                        rep = (model_axis,) + (() if stacked else (pipe_axis,))
+                        pscale = 1.0
+                        for a in rep:
+                            pscale /= self.mesh.shape[a]
+                        part_sum = part_sum + jnp.sum(participation, axis=0) * pscale
+                        part_count += participation.shape[0] * (
+                            self.mesh.shape[pipe_axis] if stacked else 1
+                        )
                 agg_leaves.append(agg.reshape(g.shape).astype(g.dtype))
             agg_tree = jax.tree_util.tree_unflatten(treedef, agg_leaves)
 
@@ -354,6 +401,12 @@ class ShardedRobustEngine:
                 "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),
                 "grad_norm": grad_norm,
             }
+            if self.worker_metrics:
+                metrics["worker_sq_dist"] = jax.lax.psum(wdist, _IN_GROUP_AXES)
+                if part_count:
+                    metrics["worker_participation"] = (
+                        jax.lax.psum(part_sum, _IN_GROUP_AXES) / part_count
+                    )
             return new_state, metrics
 
         sharded = jax.shard_map(

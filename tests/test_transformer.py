@@ -270,3 +270,31 @@ def test_sharded_engine_uses_axis_rules_exact_across_tp(rng):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5, err_msg=rule
             )
+
+
+def test_sharded_engine_worker_metrics(rng):
+    """Suspicion diagnostics on the sharded engine: under a deviation-100
+    Gaussian attack with per-layer Krum, the attacker's mean participation is
+    exactly 0, participation sums to 1, and its whole-model distance to the
+    aggregate dominates — across both tp=1 and tp=2 meshes."""
+    from aggregathor_tpu.parallel.attacks import instantiate as make_attack
+
+    for pp, tp in ((2, 1), (1, 2)):
+        w = 4
+        mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+        gar = gars.instantiate("krum", w, 1)
+        eng = ShardedRobustEngine(
+            mesh, gar, nb_real_byz=1,
+            attack=make_attack("gaussian", w, 1, ["deviation:100"]),
+            granularity="layer", worker_metrics=True,
+        )
+        tx = optax.sgd(0.05)
+        state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+        step = eng.build_step(tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2), tx, state)
+        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+        wdist = np.asarray(jax.device_get(metrics["worker_sq_dist"]))
+        part = np.asarray(jax.device_get(metrics["worker_participation"]))
+        assert wdist.shape == part.shape == (w,)
+        np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(part[0], 0.0, atol=1e-7)  # the attacker
+        assert wdist[0] > wdist[1:].max()
