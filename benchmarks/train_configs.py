@@ -45,6 +45,24 @@ CONFIGS = {
                  "--nb-workers", "8", "--nb-decl-byz-workers", "2",
                  "--experiment-args", "batch-size:128"],
     },
+    "2b": {
+        "name": "cnnet_krum_n8_f2_bf16_deviceaug",
+        "note": "config 2 with the TPU-lean options on: bfloat16 compute, "
+                "device-side augmentation (the f32/host-augment row stays "
+                "the like-for-like baseline)",
+        "args": ["--experiment", "cnnet", "--aggregator", "krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+                 "--experiment-args", "batch-size:128", "dtype:bfloat16", "augment:device"],
+    },
+    "2c": {
+        "name": "cnnet_bucketing_krum_n8_f1",
+        "note": "config 2's model with the bucketing meta-rule (s=2, inner "
+                "krum over 4 buckets needs f <= 1): extension-rule throughput",
+        "args": ["--experiment", "cnnet", "--aggregator", "bucketing",
+                 "--aggregator-args", "s:2", "inner:krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "1",
+                 "--experiment-args", "batch-size:128"],
+    },
     "3": {
         "name": "resnet50_bulyan_n32_f8",
         "note": "BASELINE config 3; ImageNet-shaped synthetic stand-in, "
