@@ -1,0 +1,111 @@
+"""Divide-and-Conquer (DnC) GAR (Shejwalkar & Houmansadr, NDSS 2021,
+"Manipulating the Byzantine: Optimizing Model Poisoning Attacks and
+Defenses for Federated Learning").
+
+An extension beyond the reference's rule set — empirically among the
+strongest known defenses: colluding attacks concentrate along a common
+direction, so project the centered gradients onto their top singular
+direction and drop the rows with the largest squared projections,
+
+    C = G - mean(G);   v = top right-singular vector of C
+    s_i = (C_i · v)²;  drop the ``remove`` largest s_i;  average the rest.
+
+TPU formulation (exact, never materializing a (d,) singular vector): with
+C = UΣVᵀ, the (n, n) Gram K = CCᵀ = UΣ²Uᵀ is one MXU matmul (psum-completed
+across dimension blocks under ``uses_axis``), the top eigenvector u of K
+comes from a fixed number of replicated O(n²) power-iteration steps, and
+the outlier scores are s_i = λ·u_i² — no d-sized spectral work at all.
+The paper subsamples coordinates to make the spectral step affordable;
+the Gram trick makes it exact instead.
+
+Non-finite rows (lossy links) are excluded up front: weight 0, zero-filled
+in the mean/Gram, +inf score, and OUTSIDE the removal budget (``remove``
+counts live outliers, so a lossy worker never shields a colluder).  Final
+averaging weights double as per-worker participation for the suspicion
+diagnostics.
+
+Regime note: with no attack the centered spectrum is flat and the top
+singular direction of pure noise is ill-defined — which honest rows get
+dropped is then arbitrary (and precision-sensitive), though the kept mean
+stays an unbiased honest average.  Under a genuine colluding signal the
+spectrum is decisive and the selection is stable (tests/test_gars.py
+``test_dnc_regime_properties``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import GAR, register
+from .common import alive_rows, smallest_k_mask
+
+
+def dnc(rows, nb_remove, iters, axis_name=None):
+    """DnC over the (n, d_block) rows; returns ``(mean, participation)``."""
+    alive, safe = alive_rows(rows, axis_name)
+    nb_alive = jnp.maximum(jnp.sum(alive), 1.0)
+    mean = jnp.sum(safe, axis=0) / nb_alive  # safe is already zero-filled
+    centered = (safe - mean[None, :]) * alive[:, None]
+    # (n, n) Gram of the centered rows, completed across dimension blocks.
+    gram = jax.lax.dot_general(
+        centered, centered, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST
+    )
+    if axis_name is not None:
+        gram = jax.lax.psum(gram, axis_name)
+    # Replicated O(n²) power iteration for the top eigenvector of K = CCᵀ.
+    # Init from diag(K) = ||C_i||², NOT the ones vector: 1 is EXACTLY in K's
+    # null space (1ᵀC = 0 by mean-centering), so a ones start would converge
+    # only via rounding residue.  The diagonal is Σ_j λ_j·(u_j∘u_j), which
+    # generically carries a top-eigenvector component.
+    u = jnp.diagonal(gram)
+    u = u / jnp.maximum(jnp.linalg.norm(u), 1e-30)
+    for _ in range(iters):
+        u = gram @ u
+        u = u / jnp.maximum(jnp.linalg.norm(u), 1e-30)
+    lam = u @ (gram @ u)
+    # Outlier scores s_i = λ·u_i² = (C_i · v)².  Dead rows score +inf and are
+    # excluded OUTSIDE the removal budget: ``nb_remove`` counts live
+    # outliers, so a lossy worker never shields a colluder from removal
+    # (k = nb_alive - nb_remove is data-dependent; the rank mask accepts a
+    # traced threshold).
+    scores = jnp.where(alive > 0.0, lam * u * u, jnp.inf)
+    kept = smallest_k_mask(scores, nb_alive - nb_remove).astype(jnp.float32) * alive
+    weights = kept / jnp.maximum(jnp.sum(kept), 1.0)
+    return jnp.sum(weights[:, None] * safe, axis=0), weights
+
+
+class DnCGAR(GAR):
+    coordinate_wise = False
+    needs_distances = False
+    uses_axis = True  # exact blockwise Gram via one psum
+    ARG_DEFAULTS = {"remove": -1, "iters": 8}
+
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        from ..utils import UserException
+
+        self.nb_remove = int(self.args["remove"])
+        if self.nb_remove < 0:
+            self.nb_remove = self.nb_byz_workers  # the paper's c·f with c = 1
+        self.iters = int(self.args["iters"])
+        if self.iters < 1:
+            raise UserException("dnc needs iters >= 1")
+        if not 0 <= self.nb_remove < self.nb_workers:
+            raise UserException(
+                "dnc must keep at least one worker (n=%d, remove=%d)"
+                % (self.nb_workers, self.nb_remove)
+            )
+        if self.nb_workers <= 2 * self.nb_byz_workers:
+            from ..utils import warning
+
+            warning("dnc tolerates f < n/2; n=%d f=%d is out of bound"
+                    % (self.nb_workers, self.nb_byz_workers))
+
+    def aggregate_block(self, block, dist2=None, axis_name=None):
+        agg, _ = dnc(block, self.nb_remove, self.iters, axis_name)
+        return agg
+
+    def aggregate_block_and_participation(self, block, dist2=None, axis_name=None, key=None):
+        return dnc(block, self.nb_remove, self.iters, axis_name)
+
+
+register("dnc", DnCGAR)

@@ -190,3 +190,37 @@ def bucketing(grads, f, perm, s, inner, **inner_kwargs):
     n, d = grads.shape
     buckets = grads[np.asarray(perm)].reshape(n // s, s, d).mean(axis=1)
     return inner(buckets, f, **inner_kwargs)
+
+
+def dnc(grads, f, remove=None, iters=8):
+    """Spectral outlier removal (extension; see gars/dnc.py).
+
+    Mirrors the jit tier's ALGORITHM — the same fixed-iteration power method
+    on the Gram, not an exact SVD: on a flat spectrum (no attack) the top
+    direction is ill-defined and only the matching method gives matching
+    selections.  ``remove`` counts LIVE outliers (dead rows are excluded
+    outside the budget)."""
+    grads = np.asarray(grads, dtype=np.float64)
+    n, _ = grads.shape
+    remove = f if remove is None else remove
+    alive = np.all(np.isfinite(grads), axis=-1)
+    safe = np.where(alive[:, None], grads, 0.0)
+    nb_alive = max(float(alive.sum()), 1.0)
+    mean = safe.sum(axis=0) / nb_alive  # safe is already zero-filled
+    centered = (safe - mean[None, :]) * alive[:, None]
+    gram = centered @ centered.T
+    # diag init, mirroring the jit tier (ones is exactly in K's null space)
+    u = np.diagonal(gram).copy()
+    u = u / max(np.linalg.norm(u), 1e-30)
+    for _ in range(iters):
+        u = gram @ u
+        u = u / max(np.linalg.norm(u), 1e-30)
+    lam = u @ (gram @ u)
+    scores = np.where(alive, lam * u * u, np.inf)
+    kept_idx = np.argsort(scores, kind="stable")[: max(int(alive.sum()) - remove, 0)]
+    kept = np.zeros(n, dtype=bool)
+    kept[kept_idx] = True
+    kept &= alive
+    if not kept.any():
+        return np.zeros(grads.shape[1])
+    return safe[kept].mean(axis=0)

@@ -173,6 +173,19 @@ def _child(force_cpu):
     print(RESULT_TOKEN + json.dumps(result), flush=True)
 
 
+def _probe():
+    """Minimal accelerator liveness check: init + matmul + HOST FETCH.
+
+    The fetch is the real test — on the tunneled backend a wedged chip
+    happily accepts dispatches and only the sync hangs."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256), jnp.float32)
+    value = float((x @ x)[0, 0])
+    print(RESULT_TOKEN + json.dumps({"probe": value, "platform": jax.devices()[0].platform}), flush=True)
+
+
 def _attempt(args, timeout):
     """Run one watchdog-guarded child; return its parsed result or None.
 
@@ -219,9 +232,16 @@ def _attempt(args, timeout):
 def main(cpu_only=False):
     result = None
     if not cpu_only:
-        result = _attempt(["--child"], timeout=480)
-        if result is None:
-            print("bench: accelerator attempt unusable, falling back to CPU", file=sys.stderr)
+        # Fast preflight: a wedged chip hangs on the first host fetch, so a
+        # 90 s probe child decides in ~10 s (healthy) or 90 s (wedged)
+        # whether the full 480 s measurement attempt is worth starting.
+        probe = _attempt(["--child-probe"], timeout=90)
+        if probe is None:
+            print("bench: accelerator preflight failed, falling back to CPU", file=sys.stderr)
+        else:
+            result = _attempt(["--child"], timeout=480)
+            if result is None:
+                print("bench: accelerator attempt unusable, falling back to CPU", file=sys.stderr)
     if result is None:
         result = _attempt(["--child", "--cpu"], timeout=480)
     if result is None:
@@ -239,7 +259,9 @@ def main(cpu_only=False):
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--child-probe" in sys.argv:
+        _probe()
+    elif "--child" in sys.argv:
         _child(force_cpu="--cpu" in sys.argv)
     else:
         main(cpu_only="--cpu" in sys.argv)

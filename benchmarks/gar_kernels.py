@@ -61,7 +61,7 @@ def main():
     ap.add_argument(
         "--rules",
         default="average,average-nan,median,averaged-median,krum,bulyan,"
-                "trimmed-mean,centered-clip,geometric-median,bucketing",
+                "trimmed-mean,centered-clip,geometric-median,bucketing,dnc",
     )
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--platform", default=None, help="force a JAX platform")

@@ -18,6 +18,7 @@ ORACLES = {
     "trimmed-mean": oracle.trimmed_mean,
     "centered-clip": oracle.centered_clip,
     "geometric-median": oracle.geometric_median,
+    "dnc": oracle.dnc,
 }
 
 
@@ -55,7 +56,7 @@ def test_permutation_equivariance(rule, rng):
 
 @pytest.mark.parametrize(
     "rule", ["median", "averaged-median", "krum", "bulyan", "trimmed-mean",
-             "centered-clip", "geometric-median"]
+             "centered-clip", "geometric-median", "dnc"]  # dnc: 1e6 colluders = strong spectrum
 )
 def test_byzantine_robustness(rule, rng):
     """With f adversarial rows pushing a huge vector, the aggregate must stay
@@ -369,3 +370,57 @@ def test_global_granularity_rejected_for_iterative_rules():
     for rule in ("geometric-median", "bucketing"):
         with pytest.raises(UserException):
             ShardedRobustEngine(mesh, gars.instantiate(rule, 2, 0), granularity="global")
+
+
+def test_dnc_drops_colluders_and_reports_participation(rng):
+    """DnC's spectral scores concentrate on a colluding direction: the f
+    coordinated outliers (and a NaN row) are dropped, the kept mean matches
+    the oracle, and the participation weights expose the drop."""
+    import jax
+
+    n, f = 12, 3
+    grads = make_grads(rng, n=n)
+    grads[:f] += 50.0 * rng.normal(size=(1, grads.shape[1])).astype(np.float32)  # common direction
+    grads[5, 7] = np.nan
+    gar = gars.instantiate("dnc", n, f)
+    agg, part = jax.jit(gar.aggregate_block_and_participation)(grads)
+    agg, part = np.asarray(agg), np.asarray(part)
+    want = oracle.dnc(grads, f)
+    np.testing.assert_allclose(agg, want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(part[:f], 0.0, atol=1e-7)  # colluders dropped
+    np.testing.assert_allclose(part[5], 0.0, atol=1e-7)   # dead row dropped
+    np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-5)
+    # remove: arg overrides the default f
+    wider = gars.instantiate("dnc", n, f, ["remove:5"])
+    assert float(np.asarray(wider.aggregate_block_and_participation(grads)[1]).astype(bool).sum()) <= n - 5
+
+
+def test_dnc_regime_properties(rng):
+    """DnC's flat-spectrum selection is precision-sensitive (the top singular
+    direction of pure noise is ill-defined), so the RULES-wide oracle and
+    permutation comparisons exclude it; under a genuine colluding signal the
+    spectrum is decisive and both properties hold."""
+    import jax
+
+    n, f = 12, 3
+    grads = make_grads(rng, n=n)
+    grads[:f] += 50.0 * rng.normal(size=(1, grads.shape[1])).astype(np.float32)
+    gar = gars.instantiate("dnc", n, f)
+    base = np.asarray(gar.aggregate(grads))
+    np.testing.assert_allclose(base, oracle.dnc(grads, f), rtol=1e-4, atol=1e-5)
+    perm = rng.permutation(n)
+    np.testing.assert_allclose(np.asarray(gar.aggregate(grads[perm])), base, rtol=1e-4, atol=1e-4)
+    # consensus: zero spectrum, index tie-break — every rule returns the input
+    g = rng.normal(size=(37,)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(gar.aggregate(np.tile(g, (n, 1)))), g, rtol=1e-5, atol=1e-6)
+    assert "dnc" in gars.itemize()
+
+
+def test_dnc_more_dead_than_budget_yields_zero(rng):
+    """When fewer live rows remain than the removal budget keeps, both tiers
+    refuse to average anything (zeros) rather than keeping live colluders."""
+    grads = make_grads(rng, n=12)
+    grads[:8] = np.nan  # 4 alive, remove=5
+    gar = gars.instantiate("dnc", 12, 3, ["remove:5"])
+    np.testing.assert_array_equal(np.asarray(gar.aggregate(grads)), 0.0)
+    np.testing.assert_array_equal(oracle.dnc(grads, 3, remove=5), 0.0)
