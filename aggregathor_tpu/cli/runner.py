@@ -65,6 +65,19 @@ def build_parser():
              "history-aware robustness (Karimireddy et al. 2021)",
     )
     parser.add_argument(
+        "--reputation-decay", type=float, default=None, metavar="BETA",
+        help="track a per-worker reputation EMA (1 = trusted) of a rank "
+             "signal: was the worker's raw gradient among the n-f closest "
+             "to the applied aggregate this step",
+    )
+    parser.add_argument(
+        "--quarantine-threshold", type=float, default=0.0, metavar="T",
+        help="workers whose reputation falls below T are excluded from "
+             "aggregation (row masked NaN — needs a NaN-tolerant rule); "
+             "they are re-admitted automatically when their raw gradients "
+             "re-approach the aggregate (requires --reputation-decay)",
+    )
+    parser.add_argument(
         "--worker-metrics", action="store_true",
         help="record per-worker suspicion diagnostics each summary: squared "
              "distance to the aggregate and, for selection rules, the "
@@ -268,6 +281,8 @@ def main(argv=None):
             exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
             batch_transform=experiment.device_transform(),
             worker_metrics=args.worker_metrics,
+            reputation_decay=args.reputation_decay,
+            quarantine_threshold=args.quarantine_threshold,
         )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
@@ -470,6 +485,12 @@ def main(argv=None):
                 scalars["worker_participation"] = np.asarray(
                     jax.device_get(metrics["worker_participation"])
                 )
+            if "worker_reputation" in metrics:
+                scalars["worker_reputation"] = np.asarray(
+                    jax.device_get(metrics["worker_reputation"])
+                )
+            if "nb_quarantined" in metrics:
+                scalars["nb_quarantined"] = int(jax.device_get(metrics["nb_quarantined"]))
             return scalars
 
         def check_divergence():

@@ -44,6 +44,10 @@ class TrainState:
     carry: object = None
     momentum: object = None
     momentum_steps: object = None
+    #: (nb_workers,) replicated reputation EMA for the quarantine mechanism
+    #: (parallel/engine.py); a side buffer like carry/momentum — never
+    #: serialized, re-warms from 1.0 after restore
+    reputation: object = None
 
     @classmethod
     def create(cls, params, tx, rng=None, carry=None, momentum=None):

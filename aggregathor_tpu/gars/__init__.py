@@ -74,6 +74,10 @@ class GAR:
     #: permutation every step; the key is identical on every device and
     #: block, so the randomness never breaks replication
     uses_key = False
+    #: True if an all-NaN row is cleanly EXCLUDED from the aggregate (never
+    #: selected / weight 0) rather than poisoning it — the property the
+    #: lossy link's NaN infill and the reputation quarantine rely on
+    nan_row_tolerant = False
     #: typed key:value argument defaults accepted by this rule (strict: an
     #: unknown key raises instead of being silently ignored)
     ARG_DEFAULTS = {}

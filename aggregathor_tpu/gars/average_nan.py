@@ -18,6 +18,7 @@ from . import GAR, register
 
 class AverageNaNGAR(GAR):
     coordinate_wise = True
+    nan_row_tolerant = True
 
     def aggregate_block(self, block, dist2=None):
         finite = jnp.isfinite(block)

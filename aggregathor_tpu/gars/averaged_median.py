@@ -30,6 +30,8 @@ def averaged_median_columns(block, nb_rows, beta):
 
 class AveragedMedianGAR(GAR):
     coordinate_wise = True
+    # NOT nan_row_tolerant: with more dead rows than the beta = n - f budget
+    # covers, inf-deviation rows are force-selected and the mean goes NaN
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
         super().__init__(nb_workers, nb_byz_workers, args)

@@ -59,6 +59,8 @@ class BucketingGAR(GAR):
         # The inner rule sees n/s rows with (at most) the same f Byzantine
         # ones — its own (n/s, f) feasibility check runs here, at parse time.
         self.inner = instantiate(str(self.args["inner"]), self.nb_buckets, self.nb_byz_workers)
+        # A NaN worker makes its whole bucket NaN; tolerance is the inner's.
+        self.nan_row_tolerant = self.inner.nan_row_tolerant
 
     def _buckets(self, block, key):
         n, s = self.nb_workers, self.s

@@ -31,6 +31,7 @@ from .common import memo_by_identity, nonfinite_to_inf, select_combine, selectio
 
 class BulyanGAR(GAR):
     needs_distances = True
+    nan_row_tolerant = True  # as krum: +inf distances, never selected
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
         super().__init__(nb_workers, nb_byz_workers, args)

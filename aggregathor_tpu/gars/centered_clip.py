@@ -50,6 +50,7 @@ def centered_clip(rows, tau, iters, axis_name=None):
 class CenteredClipGAR(GAR):
     coordinate_wise = False
     needs_distances = False
+    nan_row_tolerant = True  # dead rows contribute zero clipped deviation
     uses_axis = True  # exact blockwise norms via one psum per iteration
     ARG_DEFAULTS = {"tau": 10.0, "iters": 3}
 

@@ -76,6 +76,7 @@ def dnc(rows, nb_remove, iters, axis_name=None):
 class DnCGAR(GAR):
     coordinate_wise = False
     needs_distances = False
+    nan_row_tolerant = True  # dead rows excluded outside the removal budget
     uses_axis = True  # exact blockwise Gram via one psum
     ARG_DEFAULTS = {"remove": -1, "iters": 8}
 

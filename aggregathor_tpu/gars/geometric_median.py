@@ -61,6 +61,7 @@ def geometric_median(rows, iters, eps, axis_name=None):
 class GeometricMedianGAR(GAR):
     coordinate_wise = False
     needs_distances = False
+    nan_row_tolerant = True  # dead rows get Weiszfeld weight 0
     uses_axis = True  # exact blockwise norms via one psum per iteration
     ARG_DEFAULTS = {"iters": 8, "eps": 1e-6}
 

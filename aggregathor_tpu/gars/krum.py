@@ -33,6 +33,7 @@ def krum_scores(dist2, nb_workers, nb_byz_workers):
 
 class KrumGAR(GAR):
     needs_distances = True
+    nan_row_tolerant = True  # NaN row -> +inf distances -> never selected
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
         super().__init__(nb_workers, nb_byz_workers, args)

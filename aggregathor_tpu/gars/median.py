@@ -27,6 +27,9 @@ def median_columns(block, nb_rows):
 
 class MedianGAR(GAR):
     coordinate_wise = True
+    # NOT nan_row_tolerant: NaN values sort last but still occupy order-
+    # statistic slots — an unbounded number of dead rows shifts the upper
+    # median toward the maximum instead of being excluded
 
     def aggregate_block(self, block, dist2=None):
         return median_columns(block, self.nb_workers)

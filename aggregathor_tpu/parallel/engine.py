@@ -62,7 +62,7 @@ class RobustEngine:
 
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
-                 worker_metrics=False):
+                 worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -80,6 +80,31 @@ class RobustEngine:
         # participation metrics); off by default — the extra O(n·d) pass is
         # a measurable HBM tax at scale.
         self.worker_metrics = bool(worker_metrics)
+        # Reputation-gated quarantine: an EMA of a per-step rank signal
+        # (1 if the worker's RAW gradient is among the n-f closest to the
+        # applied aggregate, else 0); workers whose reputation falls below
+        # the threshold have their row masked NaN for that round — the
+        # engine treats them exactly like fully-lossy workers, so the rule
+        # must absorb NaN rows.  The signal is measured on the raw
+        # (pre-quarantine) submissions, so an honest worker whose gradients
+        # re-approach the aggregate recovers and is re-admitted.
+        self.reputation_decay = None if reputation_decay is None else float(reputation_decay)
+        self.quarantine_threshold = float(quarantine_threshold)
+        if self.reputation_decay is not None and not 0.0 < self.reputation_decay < 1.0:
+            raise UserException("reputation_decay must lie in (0, 1), got %r" % reputation_decay)
+        if self.quarantine_threshold:
+            if self.reputation_decay is None:
+                raise UserException("quarantine_threshold needs reputation_decay set")
+            if not 0.0 < self.quarantine_threshold < 1.0:
+                raise UserException(
+                    "quarantine_threshold must lie in (0, 1), got %r" % quarantine_threshold
+                )
+            if not gar.nan_row_tolerant:
+                raise UserException(
+                    "Quarantine masks rows to NaN, which %s does not cleanly "
+                    "exclude (pick a NaN-excluding rule: krum, bulyan, "
+                    "average-nan, rfa, dnc, centered-clip)" % type(gar).__name__
+                )
         # History-aware robustness (Karimireddy et al. 2021): with
         # worker_momentum = beta in (0, 1), every worker sends its momentum
         # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
@@ -212,6 +237,7 @@ class RobustEngine:
             carry=P(worker_axis) if self.carries_gradients else None,
             momentum=P(worker_axis) if self.worker_momentum is not None else None,
             momentum_steps=P() if self.worker_momentum is not None else None,
+            reputation=P() if self.reputation_decay is not None else None,
         )
 
     def _make_body(self, loss_fn, tx):
@@ -245,6 +271,14 @@ class RobustEngine:
                 new_momentum_steps = state.momentum_steps + 1
                 gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
             gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
+            raw_gvecs = gvecs  # post-attack/lossy, PRE-quarantine (reputation input)
+            if self.quarantine_threshold:
+                k = self.workers_per_device
+                didx = jax.lax.axis_index(worker_axis)
+                local_rep = jax.lax.dynamic_slice(state.reputation, (didx * k,), (k,))
+                gvecs = jnp.where(
+                    (local_rep < self.quarantine_threshold)[:, None], jnp.nan, gvecs
+                )
             d = gvecs.shape[-1]
             block = self._reshard_to_blocks(gvecs, d)
             if self.exchange_dtype is not None:
@@ -257,6 +291,26 @@ class RobustEngine:
             else:
                 agg = agg_block[:d]
             agg = agg.astype(jnp.float32)
+            new_reputation = state.reputation
+            if self.reputation_decay is not None:
+                # Rank signal on the RAW submissions: 1 if among the n-f
+                # closest to the applied aggregate (NaN-infilled lossy rows
+                # read +inf -> signal 0 -> lossy workers decay too).
+                from ..gars.common import nonfinite_to_inf, smallest_k_mask
+
+                ldist = jnp.sum((raw_gvecs - agg[None, :]) ** 2, axis=1)
+                wdist_raw = (
+                    jax.lax.all_gather(ldist, worker_axis).reshape(-1) if W > 1 else ldist
+                )
+                # Finiteness gate: +inf ties break by index inside the rank
+                # mask, which would otherwise boost the LOWEST-INDEX dead
+                # workers whenever fewer than n-f rows are finite.
+                signal = smallest_k_mask(
+                    nonfinite_to_inf(wdist_raw),
+                    self.nb_workers - self.gar.nb_byz_workers,
+                ).astype(jnp.float32) * jnp.isfinite(wdist_raw).astype(jnp.float32)
+                beta = self.reputation_decay
+                new_reputation = beta * state.reputation + (1.0 - beta) * signal
             agg_tree = flatmap.inflate(agg)
             updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
@@ -264,6 +318,7 @@ class RobustEngine:
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state,
                 carry=new_carry, momentum=new_momentum, momentum_steps=new_momentum_steps,
+                reputation=new_reputation,
             )
             metrics = {
                 "total_loss": total_loss,
@@ -281,6 +336,12 @@ class RobustEngine:
                 metrics["worker_sq_dist"] = wdist
                 if participation is not None:
                     metrics["worker_participation"] = participation
+                if self.reputation_decay is not None:
+                    metrics["worker_reputation"] = new_reputation
+                    if self.quarantine_threshold:
+                        metrics["nb_quarantined"] = jnp.sum(
+                            (state.reputation < self.quarantine_threshold).astype(jnp.int32)
+                        )
             return new_state, metrics
 
         return body
@@ -436,5 +497,10 @@ class RobustEngine:
             state = state.replace(
                 momentum=self._worker_sharded(None, d),
                 momentum_steps=self.replicate(jnp.zeros((), jnp.int32)),
+            )
+        if self.reputation_decay is not None:
+            # everyone starts trusted; quarantine only after evidence accrues
+            state = state.replace(
+                reputation=self.replicate(jnp.ones((self.nb_workers,), jnp.float32))
             )
         return state

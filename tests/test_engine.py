@@ -386,3 +386,108 @@ def test_worker_metrics_expose_attackers():
     pstate = plain.init_state(ex.init(jax.random.PRNGKey(0)), tx)
     _, pmetrics = plain.build_step(ex.loss, tx)(pstate, plain.shard_batch(next(it)))
     assert "worker_sq_dist" not in pmetrics
+
+
+def test_reputation_quarantine_excludes_attacker():
+    """Reputation EMA + quarantine: a persistent deviation-100 attacker's
+    reputation decays below threshold within a few steps, it gets quarantined
+    (row masked NaN, never selected), honest workers stay trusted, and
+    training converges."""
+    import jax
+    import numpy as np
+    import optax
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.parallel.attacks import instantiate as make_attack
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+
+    n, f = 8, 2
+    ex = models.instantiate("mnist", ["batch-size:16"])
+    engine = RobustEngine(
+        make_mesh(nb_workers=4), gars.instantiate("krum", n, f), n,
+        nb_real_byz=f, attack=make_attack("gaussian", n, f, ["deviation:100"]),
+        worker_metrics=True, reputation_decay=0.5, quarantine_threshold=0.4,
+    )
+    tx = optax.sgd(1e-2)
+    state = engine.init_state(ex.init(jax.random.PRNGKey(0)), tx)
+    step = engine.build_step(ex.loss, tx)
+    it = ex.make_train_iterator(n, seed=0)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, engine.shard_batch(next(it)))
+        losses.append(float(metrics["total_loss"]))
+    rep = np.asarray(jax.device_get(metrics["worker_reputation"]))
+    assert rep.shape == (n,)
+    # both attackers: the rank signal drops exactly the f farthest, which the
+    # deviation-100 forgeries always are -> signal 0 every step
+    assert rep[:f].max() < 0.1, rep
+    assert rep[f:].min() > 0.9, rep    # honest workers stay trusted
+    assert int(jax.device_get(metrics["nb_quarantined"])) == f
+    assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_quarantine_requires_nan_tolerant_rule():
+    import pytest
+
+    from aggregathor_tpu import gars
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+    from aggregathor_tpu.utils import UserException
+
+    mesh = make_mesh(nb_workers=4)
+    with pytest.raises(UserException):  # plain average propagates NaN
+        RobustEngine(mesh, gars.instantiate("average", 4, 0), 4,
+                     reputation_decay=0.5, quarantine_threshold=0.5)
+    with pytest.raises(UserException):  # median SHIFTS under NaN rows, not excludes
+        RobustEngine(mesh, gars.instantiate("median", 4, 1), 4,
+                     reputation_decay=0.5, quarantine_threshold=0.5)
+    with pytest.raises(UserException):  # threshold without decay
+        RobustEngine(mesh, gars.instantiate("krum", 4, 1), 4, quarantine_threshold=0.5)
+    with pytest.raises(UserException):  # decay out of bounds
+        RobustEngine(mesh, gars.instantiate("krum", 4, 1), 4, reputation_decay=1.5)
+    # bucketing's tolerance is the inner rule's
+    assert gars.instantiate("bucketing", 8, 1, ["s:2", "inner:krum"]).nan_row_tolerant
+    assert not gars.instantiate("bucketing", 8, 1, ["s:2", "inner:average"]).nan_row_tolerant
+
+
+def test_quarantined_worker_really_excluded():
+    """With average-nan and worker 3 quarantined, the step EXACTLY equals
+    SGD on the mean of workers 0-2's gradients — the masked row is gone,
+    and it is the RIGHT row."""
+    import jax
+    import numpy as np
+    import optax
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.parallel.engine import RobustEngine
+    from aggregathor_tpu.parallel.mesh import make_mesh
+
+    n, lr = 4, 0.1
+    ex = models.instantiate("mnist", ["batch-size:8"])
+    params0 = ex.init(jax.random.PRNGKey(0))
+    # host copy: build_step donates the state, deleting the device params
+    params0 = jax.tree_util.tree_map(np.asarray, params0)
+    batch = next(ex.make_train_iterator(n, seed=5))
+
+    eng = RobustEngine(
+        make_mesh(nb_workers=4), gars.instantiate("average-nan", n, 0), n,
+        reputation_decay=0.9, quarantine_threshold=0.5,
+    )
+    tx = optax.sgd(lr)
+    state = eng.init_state(params0, tx)
+    state = eng.put_state(
+        state.replace(reputation=np.asarray([1.0, 1.0, 1.0, 0.1], np.float32))
+    )
+    state, _ = eng.build_step(ex.loss, tx)(state, eng.shard_batch(batch))
+    got = jax.device_get(state.params)
+
+    # oracle: mean gradient of workers 0-2 only, one SGD step
+    grads = [
+        jax.grad(ex.loss)(params0, jax.tree_util.tree_map(lambda x: x[i], batch))
+        for i in range(3)
+    ]
+    mean = jax.tree_util.tree_map(lambda *g: sum(np.asarray(x) for x in g) / 3.0, *grads)
+    want = jax.tree_util.tree_map(lambda p, g: np.asarray(p) - lr * g, params0, mean)
+    for a, b in zip(jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
