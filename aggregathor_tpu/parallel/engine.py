@@ -46,6 +46,55 @@ from ..utils import UserException
 from .mesh import worker_axis
 
 
+def validate_reputation_args(gar, reputation_decay, quarantine_threshold):
+    """Shared validation of the reputation/quarantine knobs (both engines).
+
+    Returns the normalized ``(decay, threshold)`` pair.  Quarantine is
+    bounded by the rule's declared budget: at most ``f`` workers are masked
+    per step (``quarantine_mask``), so a NaN-excluding rule sized for f
+    Byzantine rows never sees more dead rows than it tolerates — which is
+    why ``f >= 1`` is required to quarantine at all."""
+    decay = None if reputation_decay is None else float(reputation_decay)
+    threshold = float(quarantine_threshold)
+    if decay is not None and not 0.0 < decay < 1.0:
+        raise UserException("reputation_decay must lie in (0, 1), got %r" % reputation_decay)
+    if threshold:
+        if decay is None:
+            raise UserException("quarantine_threshold needs reputation_decay set")
+        if not 0.0 < threshold < 1.0:
+            raise UserException(
+                "quarantine_threshold must lie in (0, 1), got %r" % quarantine_threshold
+            )
+        if gar.nb_byz_workers < 1:
+            raise UserException(
+                "Quarantine masks up to f workers per step; declare "
+                "--nb-decl-byz-workers >= 1 to use it"
+            )
+        if not gar.nan_row_tolerant:
+            from ..gars import gars as _registry
+
+            tolerant = sorted(
+                name for name in _registry.itemize()
+                if getattr(_registry.get(name), "nan_row_tolerant", False)
+            )
+            raise UserException(
+                "Quarantine masks rows to NaN, which %s does not cleanly "
+                "exclude (pick a NaN-excluding rule: %s)"
+                % (type(gar).__name__, ", ".join(tolerant))
+            )
+    return decay, threshold
+
+
+def quarantine_mask(reputation, threshold, nb_byz):
+    """(n,) bool: below-threshold AND among the ``nb_byz`` lowest
+    reputations — the cap keeps the masked count within the NaN budget the
+    rule's (n, f) sizing tolerates (an unbounded mask could exceed it when
+    the rank signal rotates across honest stragglers)."""
+    from ..gars.common import smallest_k_mask
+
+    return (reputation < threshold) & smallest_k_mask(reputation, nb_byz)
+
+
 def _partial_pairwise_sq_distances(block):
     """Per-block contribution to the (n, n) squared-distance matrix.
 
@@ -88,23 +137,9 @@ class RobustEngine:
         # must absorb NaN rows.  The signal is measured on the raw
         # (pre-quarantine) submissions, so an honest worker whose gradients
         # re-approach the aggregate recovers and is re-admitted.
-        self.reputation_decay = None if reputation_decay is None else float(reputation_decay)
-        self.quarantine_threshold = float(quarantine_threshold)
-        if self.reputation_decay is not None and not 0.0 < self.reputation_decay < 1.0:
-            raise UserException("reputation_decay must lie in (0, 1), got %r" % reputation_decay)
-        if self.quarantine_threshold:
-            if self.reputation_decay is None:
-                raise UserException("quarantine_threshold needs reputation_decay set")
-            if not 0.0 < self.quarantine_threshold < 1.0:
-                raise UserException(
-                    "quarantine_threshold must lie in (0, 1), got %r" % quarantine_threshold
-                )
-            if not gar.nan_row_tolerant:
-                raise UserException(
-                    "Quarantine masks rows to NaN, which %s does not cleanly "
-                    "exclude (pick a NaN-excluding rule: krum, bulyan, "
-                    "average-nan, rfa, dnc, centered-clip)" % type(gar).__name__
-                )
+        self.reputation_decay, self.quarantine_threshold = validate_reputation_args(
+            gar, reputation_decay, quarantine_threshold
+        )
         # History-aware robustness (Karimireddy et al. 2021): with
         # worker_momentum = beta in (0, 1), every worker sends its momentum
         # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
@@ -191,13 +226,18 @@ class RobustEngine:
             gathered = gathered.reshape(W, k, blk)
         return gathered.reshape(self.nb_workers, blk)
 
-    def _aggregate_block(self, block, key):
-        """Omniscient attack, distances (psum), blockwise GAR.
+    def _aggregate_block(self, block, key, reputation=None):
+        """Omniscient attack, quarantine gate, distances (psum), blockwise GAR.
 
-        Returns ``(agg_block, participation, block)`` — the (n,) worker
-        participation (or None; computed only under ``worker_metrics``) and
-        the post-attack ``block`` the rule actually consumed, surfaced for
-        the worker-suspicion diagnostics."""
+        Returns ``(agg_block, participation, block, raw_block)`` — the (n,)
+        worker participation (or None; computed only under
+        ``worker_metrics``), the post-quarantine ``block`` the rule actually
+        consumed, and the post-attack PRE-quarantine ``raw_block`` the
+        reputation signal measures.  The quarantine mask applies AFTER the
+        omniscient attack so the reputation signal sees what attackers
+        actually submitted (an omniscient forgery happens in block space —
+        masking earlier would measure the attacker's honest gradient and
+        never suspect it)."""
         if self.attack is not None and self.attack.omniscient:
             byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
             block = self.attack.apply_matrix(block, byz_mask, key)
@@ -205,6 +245,12 @@ class RobustEngine:
                 # The forged rows crossed the same wire as honest ones: they
                 # cannot carry sub-exchange-precision structure.
                 block = block.astype(self.exchange_dtype).astype(jnp.float32)
+        raw_block = block
+        if self.quarantine_threshold:
+            qmask = quarantine_mask(
+                reputation, self.quarantine_threshold, self.gar.nb_byz_workers
+            )
+            block = jnp.where(qmask[:, None], jnp.nan, block)
         dist2 = None
         if self.gar.needs_distances:
             partial = _partial_pairwise_sq_distances(block)
@@ -221,8 +267,9 @@ class RobustEngine:
             agg, participation = self.gar.aggregate_block_and_participation(
                 block, dist2, axis_name=axis, key=gar_key
             )
-            return agg, participation, block
-        return self.gar._call_aggregate(block, dist2, axis_name=axis, key=gar_key), None, block
+            return agg, participation, block, raw_block
+        agg = self.gar._call_aggregate(block, dist2, axis_name=axis, key=gar_key)
+        return agg, None, block, raw_block
 
     # ------------------------------------------------------------------ #
 
@@ -271,19 +318,13 @@ class RobustEngine:
                 new_momentum_steps = state.momentum_steps + 1
                 gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
             gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
-            raw_gvecs = gvecs  # post-attack/lossy, PRE-quarantine (reputation input)
-            if self.quarantine_threshold:
-                k = self.workers_per_device
-                didx = jax.lax.axis_index(worker_axis)
-                local_rep = jax.lax.dynamic_slice(state.reputation, (didx * k,), (k,))
-                gvecs = jnp.where(
-                    (local_rep < self.quarantine_threshold)[:, None], jnp.nan, gvecs
-                )
             d = gvecs.shape[-1]
             block = self._reshard_to_blocks(gvecs, d)
             if self.exchange_dtype is not None:
                 block = block.astype(jnp.float32)  # GAR math always in f32
-            agg_block, participation, seen_block = self._aggregate_block(block, key)
+            agg_block, participation, seen_block, raw_block = self._aggregate_block(
+                block, key, reputation=state.reputation
+            )
             if self.exchange_dtype is not None:
                 agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
             if W > 1:
@@ -293,22 +334,21 @@ class RobustEngine:
             agg = agg.astype(jnp.float32)
             new_reputation = state.reputation
             if self.reputation_decay is not None:
-                # Rank signal on the RAW submissions: 1 if among the n-f
-                # closest to the applied aggregate (NaN-infilled lossy rows
-                # read +inf -> signal 0 -> lossy workers decay too).
+                # Rank signal on the RAW submissions (post-ALL-attacks,
+                # pre-quarantine, in block space): 1 if among the n-f closest
+                # to the applied aggregate AND finite — NaN-infilled lossy
+                # rows read +inf -> signal 0 (the finiteness gate stops +inf
+                # index-ties from boosting low-index dead workers).
                 from ..gars.common import nonfinite_to_inf, smallest_k_mask
 
-                ldist = jnp.sum((raw_gvecs - agg[None, :]) ** 2, axis=1)
-                wdist_raw = (
-                    jax.lax.all_gather(ldist, worker_axis).reshape(-1) if W > 1 else ldist
-                )
-                # Finiteness gate: +inf ties break by index inside the rank
-                # mask, which would otherwise boost the LOWEST-INDEX dead
-                # workers whenever fewer than n-f rows are finite.
+                rdiff = raw_block - agg_block.astype(jnp.float32)[None, :]
+                rdist = jnp.sum(rdiff * rdiff, axis=1)
+                if W > 1:
+                    rdist = jax.lax.psum(rdist, worker_axis)
                 signal = smallest_k_mask(
-                    nonfinite_to_inf(wdist_raw),
+                    nonfinite_to_inf(rdist),
                     self.nb_workers - self.gar.nb_byz_workers,
-                ).astype(jnp.float32) * jnp.isfinite(wdist_raw).astype(jnp.float32)
+                ).astype(jnp.float32) * jnp.isfinite(rdist).astype(jnp.float32)
                 beta = self.reputation_decay
                 new_reputation = beta * state.reputation + (1.0 - beta) * signal
             agg_tree = flatmap.inflate(agg)
@@ -340,7 +380,10 @@ class RobustEngine:
                     metrics["worker_reputation"] = new_reputation
                     if self.quarantine_threshold:
                         metrics["nb_quarantined"] = jnp.sum(
-                            (state.reputation < self.quarantine_threshold).astype(jnp.int32)
+                            quarantine_mask(
+                                state.reputation, self.quarantine_threshold,
+                                self.gar.nb_byz_workers,
+                            ).astype(jnp.int32)
                         )
             return new_state, metrics
 

@@ -77,7 +77,8 @@ class ShardedRobustEngine:
     """Robust Byzantine-DP over logical workers that each span a submesh."""
 
     def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
-                 exchange_dtype=None, worker_momentum=None, worker_metrics=False):
+                 exchange_dtype=None, worker_momentum=None, worker_metrics=False,
+                 reputation_decay=None, quarantine_threshold=0.0):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
@@ -105,6 +106,15 @@ class ShardedRobustEngine:
         # worker_metrics: whole-model squared distance to the aggregate and
         # the mean per-bucket participation (see parallel/engine.py).
         self.worker_metrics = bool(worker_metrics)
+        # Reputation EMA + quarantine, the flat engine's semantics
+        # (parallel/engine.py): rank signal on the post-attack raw rows'
+        # whole-model distance to the aggregate; up to f below-threshold
+        # workers' rows masked NaN per bucket.
+        from .engine import validate_reputation_args
+
+        self.reputation_decay, self.quarantine_threshold = validate_reputation_args(
+            gar, reputation_decay, quarantine_threshold
+        )
         if granularity not in ("layer", "leaf", "global"):
             raise UserException("granularity must be layer, leaf or global (got %r)" % (granularity,))
         if granularity == "global" and (gar.uses_axis or gar.uses_key) and not gar.needs_distances:
@@ -155,12 +165,14 @@ class ShardedRobustEngine:
                 out_shardings=m_shardings,
             )()
 
-        momentum = momentum_steps = carry = None
+        momentum = momentum_steps = carry = reputation = None
         if self.worker_momentum is not None:
             momentum = per_worker_zeros()
             momentum_steps = jax.device_put(jnp.zeros((), jnp.int32), rep)
         if self.carries_gradients:
             carry = per_worker_zeros()
+        if self.reputation_decay is not None:
+            reputation = jax.device_put(jnp.ones((self.nb_workers,), jnp.float32), rep)
         return TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
@@ -169,6 +181,7 @@ class ShardedRobustEngine:
             carry=carry,
             momentum=momentum,
             momentum_steps=momentum_steps,
+            reputation=reputation,
         )
 
     def shard_batch(self, batch):
@@ -304,6 +317,21 @@ class ShardedRobustEngine:
                 rows = self._apply_omniscient(rows, jax.random.fold_in(key, 10_000 + i))
                 all_rows.append(rows)
 
+            # Quarantine BEFORE any distance computation (incl. the global
+            # path below): masked rows must read +inf-distant to selection
+            # rules, never finite-distant-but-NaN-valued.  raw rows are kept
+            # for the reputation signal.
+            raw_all_rows = all_rows
+            if self.quarantine_threshold:
+                from .engine import quarantine_mask
+
+                qmask = quarantine_mask(
+                    state.reputation, self.quarantine_threshold, gar.nb_byz_workers
+                )
+                all_rows = [
+                    jnp.where(qmask[None, :, None], jnp.nan, rows) for rows in all_rows
+                ]
+
             global_dist2 = None
             if self.granularity == "global" and gar.needs_distances:
                 acc = jnp.zeros((self.nb_workers, self.nb_workers), jnp.float32)
@@ -326,7 +354,8 @@ class ShardedRobustEngine:
             wdist = jnp.zeros((self.nb_workers,), jnp.float32)
             part_sum = jnp.zeros((self.nb_workers,), jnp.float32)
             part_count = 0.0  # global distinct-bucket count (static)
-            for rows, g, s in zip(all_rows, g_leaves, s_leaves):
+            rep_dist = jnp.zeros((self.nb_workers,), jnp.float32)
+            for rows, raw_rows, g, s in zip(all_rows, raw_all_rows, g_leaves, s_leaves):
                 participation = None
                 if gar.needs_distances:
                     if global_dist2 is not None:
@@ -365,6 +394,9 @@ class ShardedRobustEngine:
                         )(rows)
                 else:
                     agg = jax.vmap(lambda r: gar.aggregate_block(r, None))(rows)
+                if self.reputation_decay is not None:
+                    rdiff = raw_rows.astype(jnp.float32) - agg.astype(jnp.float32)[:, None, :]
+                    rep_dist = rep_dist + jnp.sum(rdiff * rdiff, axis=(0, 2)) * self._replication_scale(s)
                 if self.worker_metrics:
                     diff = rows.astype(jnp.float32) - agg.astype(jnp.float32)[:, None, :]
                     wdist = wdist + jnp.sum(diff * diff, axis=(0, 2)) * self._replication_scale(s)
@@ -393,9 +425,22 @@ class ShardedRobustEngine:
                 sq = sq + jnp.sum(jnp.square(agg.astype(jnp.float32))) * self._replication_scale(s)
             grad_norm = jnp.sqrt(jax.lax.psum(sq, _IN_GROUP_AXES))
 
+            new_reputation = state.reputation
+            if self.reputation_decay is not None:
+                from ..gars.common import nonfinite_to_inf, smallest_k_mask
+
+                rdist = jax.lax.psum(rep_dist, _IN_GROUP_AXES)
+                signal = smallest_k_mask(
+                    nonfinite_to_inf(rdist),
+                    self.nb_workers - gar.nb_byz_workers,
+                ).astype(jnp.float32) * jnp.isfinite(rdist).astype(jnp.float32)
+                beta = self.reputation_decay
+                new_reputation = beta * state.reputation + (1.0 - beta) * signal
+
             new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state,
                                       carry=new_carry, momentum=new_momentum,
-                                      momentum_steps=new_momentum_steps)
+                                      momentum_steps=new_momentum_steps,
+                                      reputation=new_reputation)
             metrics = {
                 # loss is a local partial: sum the worker group, then workers
                 "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),
@@ -407,6 +452,17 @@ class ShardedRobustEngine:
                     metrics["worker_participation"] = (
                         jax.lax.psum(part_sum, _IN_GROUP_AXES) / part_count
                     )
+                if self.reputation_decay is not None:
+                    metrics["worker_reputation"] = new_reputation
+                    if self.quarantine_threshold:
+                        from .engine import quarantine_mask as _qmask
+
+                        metrics["nb_quarantined"] = jnp.sum(
+                            _qmask(
+                                state.reputation, self.quarantine_threshold,
+                                gar.nb_byz_workers,
+                            ).astype(jnp.int32)
+                        )
             return new_state, metrics
 
         sharded = jax.shard_map(

@@ -471,7 +471,7 @@ def test_quarantined_worker_really_excluded():
     batch = next(ex.make_train_iterator(n, seed=5))
 
     eng = RobustEngine(
-        make_mesh(nb_workers=4), gars.instantiate("average-nan", n, 0), n,
+        make_mesh(nb_workers=4), gars.instantiate("average-nan", n, 1), n,
         reputation_decay=0.9, quarantine_threshold=0.5,
     )
     tx = optax.sgd(lr)
@@ -491,3 +491,64 @@ def test_quarantined_worker_really_excluded():
     want = jax.tree_util.tree_map(lambda p, g: np.asarray(p) - lr * g, params0, mean)
     for a, b in zip(jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_reputation_sees_omniscient_forgeries():
+    """Omniscient attacks forge rows in block space AFTER the worker-space
+    reshard; the reputation signal measures the post-attack raw block, so an
+    empire coalition's forged submissions (not their honest gradients) drive
+    their reputation down."""
+    import optax
+
+    atk = attacks.instantiate("empire", 8, 2, ["epsilon:4.0"])
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    engine = RobustEngine(
+        make_mesh(nb_workers=4), gars.instantiate("median", 8, 2), 8,
+        nb_real_byz=2, attack=atk, worker_metrics=True, reputation_decay=0.5,
+    )
+    tx = optax.sgd(0.05)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    step = engine.build_step(exp.loss, tx)
+    it = exp.make_train_iterator(8, seed=0)
+    for _ in range(6):
+        state, metrics = step(state, engine.shard_batch(next(it)))
+    rep = np.asarray(jax.device_get(metrics["worker_reputation"]))
+    assert rep[:2].max() < 0.1, rep   # the forgers, as submitted
+    assert rep[2:].min() > 0.9, rep
+
+
+def test_quarantine_capped_at_declared_budget():
+    """No matter how many reputations sit below threshold, at most f rows
+    are masked per step (the rule's NaN budget) — krum stays finite even
+    when 4 of 8 workers are below threshold, and nb_quarantined reports the
+    CAPPED count."""
+    import optax
+
+    n, f = 8, 2
+    ex = models.instantiate("mnist", ["batch-size:8"])
+    eng = RobustEngine(
+        make_mesh(nb_workers=4), gars.instantiate("krum", n, f), n,
+        worker_metrics=True, reputation_decay=0.9, quarantine_threshold=0.5,
+    )
+    tx = optax.sgd(0.05)
+    state = eng.init_state(ex.init(jax.random.PRNGKey(0)), tx)
+    # 4 workers below threshold: an unbounded mask would leave krum with
+    # only 4 finite rows < n-f-2+1 distances and NaN the aggregate
+    state = eng.put_state(
+        state.replace(reputation=np.asarray([0.1, 0.2, 0.3, 0.4, 1, 1, 1, 1], np.float32))
+    )
+    step = eng.build_step(ex.loss, tx)
+    state, metrics = step(state, eng.shard_batch(next(ex.make_train_iterator(n, seed=1))))
+    assert int(jax.device_get(metrics["nb_quarantined"])) == f
+    assert np.isfinite(float(metrics["total_loss"]))
+    assert np.all(np.isfinite(flat_params(state)))
+
+
+def test_quarantine_requires_declared_byzantine():
+    import pytest
+
+    from aggregathor_tpu.utils import UserException
+
+    with pytest.raises(UserException):  # f=0: the mask budget is empty
+        RobustEngine(make_mesh(nb_workers=4), gars.instantiate("average-nan", 4, 0), 4,
+                     reputation_decay=0.5, quarantine_threshold=0.5)

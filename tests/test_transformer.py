@@ -298,3 +298,30 @@ def test_sharded_engine_worker_metrics(rng):
         np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-4)
         np.testing.assert_allclose(part[0], 0.0, atol=1e-7)  # the attacker
         assert wdist[0] > wdist[1:].max()
+
+
+def test_sharded_engine_reputation_quarantine(rng):
+    """Reputation + quarantine on the sharded engine: a deviation-100
+    Gaussian attacker's reputation decays to ~0 and it quarantines, honest
+    workers stay trusted, and training stays finite — on a dp×pp mesh with
+    per-layer krum."""
+    from aggregathor_tpu.parallel.attacks import instantiate as make_attack
+
+    w, pp, tp = 4, 2, 1
+    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+    eng = ShardedRobustEngine(
+        mesh, gars.instantiate("krum", w, 1), nb_real_byz=1,
+        attack=make_attack("gaussian", w, 1, ["deviation:100"]),
+        granularity="layer", worker_metrics=True,
+        reputation_decay=0.5, quarantine_threshold=0.4,
+    )
+    tx = optax.sgd(0.05)
+    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+    step = eng.build_step(tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2), tx, state)
+    for _ in range(6):
+        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+        assert np.isfinite(float(metrics["total_loss"]))
+    rep = np.asarray(jax.device_get(metrics["worker_reputation"]))
+    assert rep[0] < 0.1, rep
+    assert rep[1:].min() > 0.9, rep
+    assert int(jax.device_get(metrics["nb_quarantined"])) == 1
