@@ -65,6 +65,13 @@ def build_parser():
              "history-aware robustness (Karimireddy et al. 2021)",
     )
     parser.add_argument(
+        "--granularity", default="vector", choices=["vector", "leaf"],
+        help="apply the rule to the whole flattened gradient (vector — the "
+             "reference's semantics, graph.py:144-168) or per parameter "
+             "leaf (leaf — per-layer selection; each layer picks its own "
+             "honest set)",
+    )
+    parser.add_argument(
         "--reputation-decay", type=float, default=None, metavar="BETA",
         help="track a per-worker reputation EMA (1 = trusted) of a rank "
              "signal: was the worker's raw gradient among the n-f closest "
@@ -283,6 +290,7 @@ def main(argv=None):
             worker_metrics=args.worker_metrics,
             reputation_decay=args.reputation_decay,
             quarantine_threshold=args.quarantine_threshold,
+            granularity=args.granularity,
         )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)

@@ -111,7 +111,8 @@ class RobustEngine:
 
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
-                 worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0):
+                 worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
+                 granularity="vector"):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -140,6 +141,15 @@ class RobustEngine:
         self.reputation_decay, self.quarantine_threshold = validate_reputation_args(
             gar, reputation_decay, quarantine_threshold
         )
+        # granularity:leaf applies the rule PER PARAMETER LEAF (per-layer
+        # selection — the sharded engine's semantics on a plain worker mesh,
+        # including n vmapped workers on one chip).  Memory shifts from the
+        # dimension-sharded O(d) blocks to one (n, d_leaf) gather at a time,
+        # and distance work is replicated per device instead of sharded —
+        # the price of letting every layer pick its own honest set.
+        if granularity not in ("vector", "leaf"):
+            raise UserException("granularity must be vector or leaf (got %r)" % (granularity,))
+        self.granularity = granularity
         # History-aware robustness (Karimireddy et al. 2021): with
         # worker_momentum = beta in (0, 1), every worker sends its momentum
         # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
@@ -226,6 +236,30 @@ class RobustEngine:
             gathered = gathered.reshape(W, k, blk)
         return gathered.reshape(self.nb_workers, blk)
 
+    def _prepare_rows(self, rows, attack_key, reputation):
+        """The ORDER-SENSITIVE shared front of both aggregation paths:
+        omniscient attack -> requantize forged rows -> quarantine mask.
+
+        Returns ``(rows, raw_rows)``: what the rule consumes and the
+        post-attack PRE-quarantine rows the reputation signal measures.
+        The quarantine mask applies AFTER the omniscient attack so the
+        reputation signal sees what attackers actually submitted (masking
+        earlier would measure the attacker's honest gradient and never
+        suspect it); forged rows are squeezed through the exchange dtype
+        because they crossed the same wire as honest ones."""
+        if self.attack is not None and self.attack.omniscient:
+            byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
+            rows = self.attack.apply_matrix(rows, byz_mask, attack_key)
+            if self.exchange_dtype is not None:
+                rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+        raw_rows = rows
+        if self.quarantine_threshold:
+            qmask = quarantine_mask(
+                reputation, self.quarantine_threshold, self.gar.nb_byz_workers
+            )
+            rows = jnp.where(qmask[:, None], jnp.nan, rows)
+        return rows, raw_rows
+
     def _aggregate_block(self, block, key, reputation=None):
         """Omniscient attack, quarantine gate, distances (psum), blockwise GAR.
 
@@ -233,24 +267,8 @@ class RobustEngine:
         worker participation (or None; computed only under
         ``worker_metrics``), the post-quarantine ``block`` the rule actually
         consumed, and the post-attack PRE-quarantine ``raw_block`` the
-        reputation signal measures.  The quarantine mask applies AFTER the
-        omniscient attack so the reputation signal sees what attackers
-        actually submitted (an omniscient forgery happens in block space —
-        masking earlier would measure the attacker's honest gradient and
-        never suspect it)."""
-        if self.attack is not None and self.attack.omniscient:
-            byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
-            block = self.attack.apply_matrix(block, byz_mask, key)
-            if self.exchange_dtype is not None:
-                # The forged rows crossed the same wire as honest ones: they
-                # cannot carry sub-exchange-precision structure.
-                block = block.astype(self.exchange_dtype).astype(jnp.float32)
-        raw_block = block
-        if self.quarantine_threshold:
-            qmask = quarantine_mask(
-                reputation, self.quarantine_threshold, self.gar.nb_byz_workers
-            )
-            block = jnp.where(qmask[:, None], jnp.nan, block)
+        reputation signal measures."""
+        block, raw_block = self._prepare_rows(block, key, reputation)
         dist2 = None
         if self.gar.needs_distances:
             partial = _partial_pairwise_sq_distances(block)
@@ -270,6 +288,68 @@ class RobustEngine:
             return agg, participation, block, raw_block
         agg = self.gar._call_aggregate(block, dist2, axis_name=axis, key=gar_key)
         return agg, None, block, raw_block
+
+    def _aggregate_per_leaf(self, gvecs, flatmap, key, reputation):
+        """granularity:leaf — gather and reduce each leaf's (n, d_leaf) rows
+        independently (per-layer selection).
+
+        Returns ``(agg, participation, wdist, rep_dist)``: the concatenated
+        (d,) aggregate (identical on every device), the mean per-leaf
+        participation (or None), and the full per-worker squared distances
+        to the aggregate over the post-quarantine and raw rows respectively
+        (None unless the corresponding feature is on).  No psums needed:
+        every device sees complete rows."""
+        from ..gars import GAR_KEY_TAG
+        from ..gars.common import pairwise_sq_distances
+
+        W = self.nb_devices
+        base_key = jax.random.fold_in(key, GAR_KEY_TAG)
+        agg_parts = []
+        participation_sum = jnp.zeros((self.nb_workers,), jnp.float32)
+        participation_count = 0
+        wdist = jnp.zeros((self.nb_workers,), jnp.float32) if self.worker_metrics else None
+        rep_dist = (
+            jnp.zeros((self.nb_workers,), jnp.float32)
+            if self.reputation_decay is not None else None
+        )
+        for i, (_, offset, size, _, _) in enumerate(flatmap.slices):
+            local = gvecs[:, offset:offset + size]  # static slice
+            if self.exchange_dtype is not None:
+                local = local.astype(self.exchange_dtype)  # wire precision
+            if W > 1:
+                rows = jax.lax.all_gather(local, worker_axis).reshape(self.nb_workers, size)
+            else:
+                rows = local
+            rows = rows.astype(jnp.float32)
+            rows, raw_rows = self._prepare_rows(
+                rows, jax.random.fold_in(key, 20_000 + i), reputation
+            )
+            dist2 = (
+                jnp.maximum(pairwise_sq_distances(rows), 0.0)
+                if self.gar.needs_distances else None
+            )
+            leaf_key = jax.random.fold_in(base_key, i)
+            if self.worker_metrics:
+                agg_leaf, part = self.gar.aggregate_block_and_participation(
+                    rows, dist2, axis_name=None, key=leaf_key
+                )
+                if part is not None:
+                    participation_sum = participation_sum + part
+                    participation_count += 1
+            else:
+                agg_leaf = self.gar._call_aggregate(rows, dist2, axis_name=None, key=leaf_key)
+            if wdist is not None:
+                diff = rows - agg_leaf[None, :]
+                wdist = wdist + jnp.sum(diff * diff, axis=1)
+            if rep_dist is not None:
+                rdiff = raw_rows - agg_leaf.astype(jnp.float32)[None, :]
+                rep_dist = rep_dist + jnp.sum(rdiff * rdiff, axis=1)
+            agg_parts.append(agg_leaf.astype(jnp.float32))
+        agg = jnp.concatenate(agg_parts) if agg_parts else jnp.zeros((0,), jnp.float32)
+        participation = (
+            participation_sum / participation_count if participation_count else None
+        )
+        return agg, participation, wdist, rep_dist
 
     # ------------------------------------------------------------------ #
 
@@ -319,36 +399,50 @@ class RobustEngine:
                 gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
             gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
             d = gvecs.shape[-1]
-            block = self._reshard_to_blocks(gvecs, d)
-            if self.exchange_dtype is not None:
-                block = block.astype(jnp.float32)  # GAR math always in f32
-            agg_block, participation, seen_block, raw_block = self._aggregate_block(
-                block, key, reputation=state.reputation
-            )
-            if self.exchange_dtype is not None:
-                agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
-            if W > 1:
-                agg = jax.lax.all_gather(agg_block, worker_axis, axis=0).reshape(-1)[:d]
+            if self.granularity == "leaf":
+                agg, participation, wdist, rep_dist = self._aggregate_per_leaf(
+                    gvecs, flatmap, key, state.reputation
+                )
             else:
-                agg = agg_block[:d]
-            agg = agg.astype(jnp.float32)
+                block = self._reshard_to_blocks(gvecs, d)
+                if self.exchange_dtype is not None:
+                    block = block.astype(jnp.float32)  # GAR math always in f32
+                agg_block, participation, seen_block, raw_block = self._aggregate_block(
+                    block, key, reputation=state.reputation
+                )
+                if self.exchange_dtype is not None:
+                    agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
+                if W > 1:
+                    agg = jax.lax.all_gather(agg_block, worker_axis, axis=0).reshape(-1)[:d]
+                else:
+                    agg = agg_block[:d]
+                agg = agg.astype(jnp.float32)
+                wdist = rep_dist = None
+                if self.worker_metrics:
+                    # distances over what the aggregator actually saw
+                    # (post-attack, post-lossy, post-quarantine)
+                    diff = seen_block - agg_block[None, :]
+                    wdist = jnp.sum(diff * diff, axis=1)
+                    if W > 1:
+                        wdist = jax.lax.psum(wdist, worker_axis)
+                if self.reputation_decay is not None:
+                    rdiff = raw_block - agg_block.astype(jnp.float32)[None, :]
+                    rep_dist = jnp.sum(rdiff * rdiff, axis=1)
+                    if W > 1:
+                        rep_dist = jax.lax.psum(rep_dist, worker_axis)
             new_reputation = state.reputation
             if self.reputation_decay is not None:
                 # Rank signal on the RAW submissions (post-ALL-attacks,
-                # pre-quarantine, in block space): 1 if among the n-f closest
-                # to the applied aggregate AND finite — NaN-infilled lossy
-                # rows read +inf -> signal 0 (the finiteness gate stops +inf
-                # index-ties from boosting low-index dead workers).
+                # pre-quarantine): 1 if among the n-f closest to the applied
+                # aggregate AND finite — NaN-infilled lossy rows read +inf
+                # -> signal 0 (the finiteness gate stops +inf index-ties
+                # from boosting low-index dead workers).
                 from ..gars.common import nonfinite_to_inf, smallest_k_mask
 
-                rdiff = raw_block - agg_block.astype(jnp.float32)[None, :]
-                rdist = jnp.sum(rdiff * rdiff, axis=1)
-                if W > 1:
-                    rdist = jax.lax.psum(rdist, worker_axis)
                 signal = smallest_k_mask(
-                    nonfinite_to_inf(rdist),
+                    nonfinite_to_inf(rep_dist),
                     self.nb_workers - self.gar.nb_byz_workers,
-                ).astype(jnp.float32) * jnp.isfinite(rdist).astype(jnp.float32)
+                ).astype(jnp.float32) * jnp.isfinite(rep_dist).astype(jnp.float32)
                 beta = self.reputation_decay
                 new_reputation = beta * state.reputation + (1.0 - beta) * signal
             agg_tree = flatmap.inflate(agg)
@@ -365,14 +459,9 @@ class RobustEngine:
                 "grad_norm": jnp.linalg.norm(agg),
             }
             if self.worker_metrics:
-                # Suspicion diagnostics over what the aggregator actually saw
-                # (post-attack, post-lossy): squared distance of each worker's
+                # Suspicion diagnostics: squared distance of each worker's
                 # gradient to the aggregate (universal), plus the rule's own
                 # per-worker participation weight when it selects by worker.
-                diff = seen_block - agg_block[None, :]
-                wdist = jnp.sum(diff * diff, axis=1)
-                if W > 1:
-                    wdist = jax.lax.psum(wdist, worker_axis)
                 metrics["worker_sq_dist"] = wdist
                 if participation is not None:
                     metrics["worker_participation"] = participation

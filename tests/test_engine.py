@@ -552,3 +552,75 @@ def test_quarantine_requires_declared_byzantine():
     with pytest.raises(UserException):  # f=0: the mask budget is empty
         RobustEngine(make_mesh(nb_workers=4), gars.instantiate("average-nan", 4, 0), 4,
                      reputation_decay=0.5, quarantine_threshold=0.5)
+
+
+def test_leaf_granularity_average_matches_vector():
+    """Averaging is layer-separable: granularity:leaf and :vector produce
+    identical parameters (the per-leaf path is exercised end to end with no
+    semantic change for a separable rule)."""
+    import optax
+
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    batchs = [next(exp.make_train_iterator(8, seed=2)) for _ in range(3)]
+    outs = {}
+    for gran in ("vector", "leaf"):
+        eng = RobustEngine(make_mesh(nb_workers=4), gars.instantiate("average", 8, 0), 8,
+                           granularity=gran)
+        tx = optax.sgd(0.05)
+        state = eng.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+        step = eng.build_step(exp.loss, tx)
+        for b in batchs:
+            state, _ = step(state, eng.shard_batch(b))
+        outs[gran] = flat_params(state)
+    np.testing.assert_allclose(outs["leaf"], outs["vector"], rtol=1e-5, atol=1e-6)
+
+
+def test_leaf_granularity_krum_device_invariance_and_attack():
+    """Per-leaf krum: device-count invariant (per-leaf all_gathers see the
+    same rows on any layout) and converges under a signflip coalition; the
+    suspicion metrics come back with the right shapes."""
+    import optax
+
+    atk = attacks.instantiate("signflip", 8, 2, ["scale:10.0"])
+    outs = {}
+    for nb_devices in (8, 1):
+        exp = models.instantiate("mnist", ["batch-size:16"])
+        eng = RobustEngine(make_mesh(nb_workers=nb_devices), gars.instantiate("krum", 8, 2), 8,
+                           nb_real_byz=2, attack=atk, granularity="leaf", worker_metrics=True)
+        tx = optax.sgd(0.05)
+        state = eng.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+        step = eng.build_step(exp.loss, tx)
+        it = exp.make_train_iterator(8, seed=3)
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, eng.shard_batch(next(it)))
+            losses.append(float(metrics["total_loss"]))
+        assert losses[-1] < losses[0]
+        assert np.asarray(metrics["worker_sq_dist"]).shape == (8,)
+        assert np.asarray(metrics["worker_participation"]).shape == (8,)
+        outs[nb_devices] = flat_params(state)
+    np.testing.assert_allclose(outs[8], outs[1], rtol=1e-5, atol=1e-6)
+
+
+def test_leaf_granularity_quarantine():
+    """Quarantine composes with per-leaf selection: the deviation-100
+    attacker quarantines and training stays finite."""
+    import optax
+
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    eng = RobustEngine(
+        make_mesh(nb_workers=4), gars.instantiate("krum", 8, 2), 8,
+        nb_real_byz=2, attack=attacks.instantiate("gaussian", 8, 2, ["deviation:100"]),
+        granularity="leaf", worker_metrics=True,
+        reputation_decay=0.5, quarantine_threshold=0.4,
+    )
+    tx = optax.sgd(0.05)
+    state = eng.init_state(exp.init(jax.random.PRNGKey(0)), tx)
+    step = eng.build_step(exp.loss, tx)
+    it = exp.make_train_iterator(8, seed=0)
+    for _ in range(6):
+        state, metrics = step(state, eng.shard_batch(next(it)))
+    rep = np.asarray(jax.device_get(metrics["worker_reputation"]))
+    assert rep[:2].max() < 0.1 and rep[2:].min() > 0.9, rep
+    assert int(jax.device_get(metrics["nb_quarantined"])) == 2
+    assert np.all(np.isfinite(flat_params(state)))
