@@ -223,3 +223,25 @@ def test_worker_metrics_summaries(tmp_path):
         assert len(ev["worker_participation"]) == 4
         # the deviation-100 attacker, serialized as a usable integer index
         assert ev["suspect_worker"] == 0 and isinstance(ev["suspect_worker"], int)
+
+
+def test_granularity_leaf_cli(tmp_path):
+    """--granularity leaf trains end to end and reports per-worker metrics."""
+    sum_dir = str(tmp_path / "sum")
+    assert 0 == run([
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "krum", "--granularity", "leaf",
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--nb-real-byz-workers", "2", "--attack", "gaussian", "--attack-args", "deviation:100",
+        "--worker-metrics", "--max-step", "6",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--summary-dir", sum_dir, "--summary-delta", "3",
+    ])
+    [name] = os.listdir(sum_dir)
+    events = [json.loads(l) for l in open(os.path.join(sum_dir, name))]
+    assert events, "no summary events written"
+    for ev in events:
+        assert len(ev["worker_sq_dist"]) == 8
+        assert len(ev["worker_participation"]) == 8
+        assert ev["suspect_worker"] in (0, 1)  # one of the two forgers
+        assert isinstance(ev["suspect_worker"], int)
