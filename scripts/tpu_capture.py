@@ -1,0 +1,228 @@
+"""TPU up-window watcher: capture every pending BENCHMARKS.md cell.
+
+The one TPU chip in this environment wedges for multi-hour windows
+(BENCHMARKS.md "TPU caveat": backend init or the first host fetch hangs
+indefinitely and uninterruptibly).  Sitting in front of the chip hoping a
+benchmark run overlaps an up-window wasted two rounds; this watcher inverts
+the strategy:
+
+  loop:
+    probe the chip (512x512 matmul + HOST FETCH under a hard timeout —
+    only a host fetch actually syncs the tunneled backend);
+    if alive: run the capture stages SERIALLY (the chip is single-tenant),
+      each under its own watchdog, appending every JSON result line to
+      ``benchmarks/tpu_capture.jsonl``;
+    else: sleep and re-probe.
+
+Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
+
+  bench           — headline config-2 steps/s (bench.py, own watchdog)
+  pallas_check    — Pallas kernels compiled on silicon, parity + ms
+                    (scripts/pallas_tpu_check.py)
+  gar_kernels     — per-rule kernel ms vs d, jnp:tpu + pallas tiers
+  train_configs   — configs 2, 2b, 2c through the real CLI on TPU
+  train_configs34 — configs 3 (ResNet-50+Bulyan) and 4 (Inception-v3+median
+                    under attack), n=32 f=8, through the real CLI on TPU
+  leaf_resnet     — per-layer granularity on a slim ResNet (the bucketed
+                    leaf path) through the real CLI
+
+A stage that succeeds is recorded in ``scripts/tpu_capture_state.json`` and
+not re-run, so a short up-window makes incremental progress and the next
+window resumes where the last one wedged.  A stage timeout means the chip
+wedged mid-pass: the child process group is killed (bounded grace — a
+D-state child is abandoned, see bench.py), the watcher goes back to probing.
+
+Usage::
+
+    python scripts/tpu_capture.py [--once] [--stages bench,gar_kernels]
+                                  [--sleep 600] [--fresh]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE_PATH = os.path.join(REPO, "scripts", "tpu_capture_state.json")
+LOG_PATH = os.path.join(REPO, "benchmarks", "tpu_capture.jsonl")
+
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((512, 512), jnp.float32);"
+    "print('PROBE_OK', float((x @ x)[0, 0]), jax.devices()[0].platform)"
+)
+
+
+def _stages(py):
+    b = lambda *a: [py] + list(a)
+    return [
+        # (name, argv, timeout_s)
+        ("bench", b("bench.py"), 1200),
+        ("pallas_check",
+         b("scripts/pallas_tpu_check.py", "--n", "32", "--f", "8",
+           "--dims", "65536,1048576,8388608"), 2400),
+        ("gar_kernels",
+         b("benchmarks/gar_kernels.py", "--n", "32", "--f", "8",
+           "--dims", "65536,1048576,8388608", "--reps", "10"), 3600),
+        ("train_configs",
+         b("benchmarks/train_configs.py", "--configs", "2,2b,2c",
+           "--steps", "40", "--platform", "tpu", "--timeout", "1200"), 4200),
+        ("train_configs34",
+         b("benchmarks/train_configs.py", "--configs", "3,4",
+           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 4200),
+        ("leaf_resnet",
+         b("benchmarks/train_configs.py", "--configs", "6",
+           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 2400),
+    ]
+
+
+def _load_state():
+    try:
+        with open(STATE_PATH) as fd:
+            return json.load(fd)
+    except (OSError, ValueError):
+        return {"done": []}
+
+
+def _save_state(state):
+    tmp = STATE_PATH + ".tmp"
+    with open(tmp, "w") as fd:
+        json.dump(state, fd, indent=1)
+    os.replace(tmp, STATE_PATH)
+
+
+def _log(record):
+    record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(LOG_PATH, "a") as fd:
+        fd.write(json.dumps(record) + "\n")
+    print("capture: %s" % json.dumps(record)[:400], flush=True)
+
+
+def _run_guarded(argv, timeout, env=None):
+    """Run one child in its own session; killpg + bounded grace on timeout.
+
+    Same rationale as bench.py's watchdog: ``subprocess.run(timeout=...)``
+    waits UNBOUNDED after kill(), which never returns for a child stuck in
+    an uninterruptible sleep inside the wedged accelerator driver.
+    """
+    proc = subprocess.Popen(
+        argv, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True, env=env,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        return proc.returncode, stdout, stderr
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        stdout = ""
+        try:
+            # Keep whatever the child flushed before wedging — partial rows
+            # from a short up-window are exactly the incremental progress
+            # this watcher exists to bank.
+            stdout, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            pass  # D-state child: abandon it
+        return None, stdout or "", "timeout after %ds" % timeout
+
+
+def probe(timeout=100):
+    rc, out, err = _run_guarded([sys.executable, "-c", PROBE_CODE], timeout)
+    if rc != 0 or "PROBE_OK" not in out:
+        return False
+    # The platform string matters: with the accelerator plugin absent (or an
+    # ambient JAX_PLATFORMS=cpu) the matmul happily succeeds on CPU and the
+    # watcher would burn every stage on the wrong backend and retire them.
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            return line.strip().split()[-1] == "tpu"
+    return False
+
+
+def _tpu_datum(row):
+    """True iff this result row is a real TPU-captured number.
+
+    A stage may exit 0 yet carry only CPU-fallback or error rows (bench.py's
+    fallback contract; train_configs' per-config timeout rows) — those must
+    NOT retire the stage, or the scarce next up-window skips it forever.
+    """
+    if row.get("error"):
+        return False
+    platform = row.get("platform") or (row.get("detail") or {}).get("platform") or ""
+    if platform:
+        return platform == "tpu"
+    tier = row.get("tier", "")
+    if tier:  # gar_kernels rows carry a tier, not a platform
+        return tier == "pallas" or tier.endswith(":tpu")
+    if row.get("metric") == "pallas_tpu_check":  # script itself exits 2 off-TPU
+        return row.get("parity") == "ok"
+    return False
+
+
+def run_stage(name, argv, timeout):
+    t0 = time.time()
+    rc, out, err = _run_guarded(argv, timeout)
+    lines = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                lines.append(json.loads(line))
+            except ValueError:
+                pass
+    _log({
+        "stage": name, "rc": rc, "elapsed_s": round(time.time() - t0, 1),
+        "results": lines, "stderr_tail": err.strip()[-600:] if rc not in (0,) else "",
+    })
+    return rc == 0 and any(_tpu_datum(r) for r in lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true", help="one probe+pass, no loop")
+    ap.add_argument("--stages", default=None, help="comma subset of stages")
+    ap.add_argument("--sleep", type=int, default=600, help="seconds between probes")
+    ap.add_argument("--fresh", action="store_true", help="forget completed stages")
+    args = ap.parse_args()
+
+    stages = _stages(sys.executable)
+    if args.stages:
+        keep = set(args.stages.split(","))
+        stages = [s for s in stages if s[0] in keep]
+    state = _load_state()
+    if args.fresh:
+        state = {"done": []}
+        _save_state(state)
+
+    while True:
+        todo = [s for s in stages if s[0] not in state["done"]]
+        if not todo:
+            _log({"event": "all-stages-complete"})
+            return
+        if probe():
+            _log({"event": "chip-up", "todo": [s[0] for s in todo]})
+            for name, argv, timeout in todo:
+                if run_stage(name, argv, timeout):
+                    state["done"].append(name)
+                    _save_state(state)
+                else:
+                    # A failed/timed-out stage usually means the chip wedged
+                    # mid-pass — re-probe before burning another window.
+                    if not probe():
+                        _log({"event": "chip-wedged-mid-pass", "after": name})
+                        break
+        else:
+            _log({"event": "chip-down"})
+        if args.once:
+            return
+        time.sleep(args.sleep)
+
+
+if __name__ == "__main__":
+    main()
