@@ -106,16 +106,33 @@ class GAR:
         dist2 = pairwise_sq_distances(grads) if self.needs_distances else None
         return self._call_aggregate(grads, dist2, axis_name=None, key=key)
 
-    def _call_aggregate(self, block, dist2, axis_name=None, key=None):
+    def _drop_memos(self):
+        """Drop ``memo_by_identity`` entries created during this pass: they
+        hold (tracer-arg, tracer-result) tuples that must not outlive the
+        outer call (gars/common.py memo docstring)."""
+        for name in [a for a in vars(self) if a.startswith("_memo_")]:
+            delattr(self, name)
+
+    def _call_aggregate(self, block, dist2, axis_name=None, key=None, keep_memo=False):
         """Invoke ``aggregate_block`` with exactly the keywords this rule
         declares (``uses_axis``/``uses_key``) — the single dispatch point the
-        engines use, so plain rules keep their two-argument signature."""
+        engines use, so plain rules keep their two-argument signature.
+
+        Memo entries are dropped on exit (they hold tracers, see
+        ``_drop_memos``) unless ``keep_memo`` — the one caller that needs
+        the memo to survive is ``aggregate_block_and_participation``, whose
+        participation read reuses the selection graph and which drops the
+        memo itself afterwards."""
         kwargs = {}
         if self.uses_axis:
             kwargs["axis_name"] = axis_name
         if self.uses_key:
             kwargs["key"] = key
-        return self.aggregate_block(block, dist2, **kwargs)
+        try:
+            return self.aggregate_block(block, dist2, **kwargs)
+        finally:
+            if not keep_memo:
+                self._drop_memos()
 
     def aggregate_block(self, block, dist2=None):
         """Blockwise tier: reduce an (n, d_block) column block to (d_block,).
@@ -141,8 +158,13 @@ class GAR:
         weights their own iteration already computes — in one pass, with no
         state stashed on the instance between calls (a stashed jnp value
         would be a tracer leaking across trace boundaries)."""
-        agg = self._call_aggregate(block, dist2, axis_name=axis_name, key=key)
-        return agg, self.worker_participation(dist2)
+        try:
+            agg = self._call_aggregate(
+                block, dist2, axis_name=axis_name, key=key, keep_memo=True
+            )
+            return agg, self.worker_participation(dist2)
+        finally:
+            self._drop_memos()
 
 
 # Self-registering rule modules (reference: aggregators/__init__.py:76-85)

@@ -125,7 +125,13 @@ def memo_by_identity(method):
     the selection graph (O(n² log n) rank sort + the Bulyan t-round loop) is
     traced twice and dedup relies on XLA CSE.  Identity keying is
     trace-safe: a retrace passes a fresh tracer, misses, and overwrites the
-    stale entry (which is never used again)."""
+    stale entry (which is never used again).
+
+    The entry holds a (tracer-arg, tracer-result) tuple, so the OUTER call
+    must drop it once the pass is done (``_GAR._drop_memos``, called from
+    ``aggregate``/``aggregate_block_and_participation``) — a stale entry
+    keeps the traced selection graph alive for the instance's lifetime and
+    trips ``jax.check_tracer_leaks``."""
     import functools
 
     attr = "_memo_" + method.__name__

@@ -39,14 +39,20 @@ class PallasAverageNaNGAR(AverageNaNGAR):
 
 class PallasKrumGAR(KrumGAR):
     def aggregate(self, grads, key=None):
-        dist2 = pk.pairwise_sq_distances(grads)
-        return self.aggregate_block(grads, dist2)
+        try:
+            dist2 = pk.pairwise_sq_distances(grads)
+            return self.aggregate_block(grads, dist2)
+        finally:
+            self._drop_memos()
 
 
 class PallasBulyanGAR(BulyanGAR):
     def aggregate(self, grads, key=None):
-        dist2 = pk.pairwise_sq_distances(grads)
-        return self.aggregate_block(grads, dist2)
+        try:
+            dist2 = pk.pairwise_sq_distances(grads)
+            return self.aggregate_block(grads, dist2)
+        finally:
+            self._drop_memos()
 
     def aggregate_block(self, block, dist2=None):
         assert dist2 is not None, "bulyan requires the pairwise distance matrix"

@@ -117,10 +117,16 @@ class Checkpoints:
             return self._path(step)
         return self._write(host_state, step)
 
-    def wait(self):
+    def wait(self, shutdown=False):
         """Join ALL pending background writes, then re-raise the first
         failure — a later write is never left unjoined (or its failure
-        silently dropped) because an earlier one raised."""
+        silently dropped) because an earlier one raised.
+
+        ``shutdown=True`` additionally retires the worker thread: a
+        long-lived parent that constructs ``Checkpoints(background=True)``
+        repeatedly (test harnesses, notebooks) would otherwise accumulate
+        one idle thread per instance until GC.  Final-cleanup callers
+        (cli/runner.py) pass it; mid-run cadence flushes don't."""
         pending, self._pending = self._pending, []
         first_error = None
         for future in pending:
@@ -129,6 +135,9 @@ class Checkpoints:
             except Exception as exc:
                 if first_error is None:
                     first_error = exc
+        if shutdown and self._pool is not None:
+            pool, self._pool = self._pool, None
+            pool.shutdown(wait=True)
         if first_error is not None:
             raise first_error
 

@@ -40,12 +40,20 @@ class SummaryWriter:
         def coerce(value):
             import numpy as np
 
+            def finite(x):
+                # json.dumps would emit bare NaN/Infinity tokens (non-strict
+                # JSON, rejected by jq and most non-Python readers); masked
+                # workers' NaN distance sums reach here, so they serialize
+                # as null instead.
+                x = float(x)
+                return x if np.isfinite(x) else None
+
             if isinstance(value, (int, np.integer)) and not isinstance(value, bool):
                 return int(value)  # e.g. suspect_worker stays an index
             try:
-                return float(value)
+                return finite(value)
             except TypeError:
-                return [float(v) for v in value]
+                return [finite(v) for v in value]
 
         event = {"wall": time.time(), "step": int(step)}
         event.update({name: coerce(value) for name, value in values.items()})

@@ -424,3 +424,25 @@ def test_dnc_more_dead_than_budget_yields_zero(rng):
     gar = gars.instantiate("dnc", 12, 3, ["remove:5"])
     np.testing.assert_array_equal(np.asarray(gar.aggregate(grads)), 0.0)
     np.testing.assert_array_equal(oracle.dnc(grads, 3, remove=5), 0.0)
+
+
+@pytest.mark.parametrize("rule", ["krum", "bulyan"])
+def test_no_memo_survives_aggregation(rule, rng):
+    """memo_by_identity entries must not outlive the aggregation call — a
+    stale (tracer, tracer) tuple keeps the traced selection graph alive and
+    trips jax.check_tracer_leaks (ADVICE r2 finding 2)."""
+    import jax
+
+    n, f = params_for(rule)
+    gar = gars.instantiate(rule, n, f)
+    grads = make_grads(rng, n=n)
+    from aggregathor_tpu.gars.common import pairwise_sq_distances
+
+    dist2 = pairwise_sq_distances(jax.numpy.asarray(grads))
+    with jax.check_tracer_leaks():
+        jax.jit(gar.aggregate)(grads).block_until_ready()
+        agg, part = jax.jit(gar.aggregate_block_and_participation)(grads, dist2)
+        # the engines' direct dispatch point — the default
+        # (worker_metrics=False) step path bypasses both entries above
+        jax.jit(lambda g, d: gar._call_aggregate(g, d))(grads, dist2).block_until_ready()
+    assert not [a for a in vars(gar) if a.startswith("_memo_")]
