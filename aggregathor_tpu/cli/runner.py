@@ -65,7 +65,19 @@ def build_parser():
              "history-aware robustness (Karimireddy et al. 2021)",
     )
     parser.add_argument(
-        "--granularity", default="vector", choices=["vector", "leaf"],
+        "--mesh", default=None, metavar="W,PP,TP",
+        help="route training through the fully-sharded engine on a logical "
+             "(worker x pipeline x tensor) mesh: per-layer robust aggregation "
+             "on sharded gradients, the (n, d) matrix never materialized "
+             "(needs an experiment that publishes sharded hooks, e.g. "
+             "transformer). W must equal --nb-workers.",
+    )
+    parser.add_argument(
+        "--microbatches", type=int, default=2,
+        help="pipeline microbatches per step (sharded engine only)",
+    )
+    parser.add_argument(
+        "--granularity", default="vector", choices=["vector", "leaf", "layer", "global"],
         help="apply the rule to the whole flattened gradient (vector — the "
              "reference's semantics, graph.py:144-168) or per parameter "
              "leaf (leaf — per-layer selection; each layer picks its own "
@@ -103,6 +115,15 @@ def build_parser():
              "<= 0 waits indefinitely",
     )
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed")
+    parser.add_argument(
+        "--session-secret", default=None, metavar="SECRET",
+        help="shared secret authenticating the multi-host boundary: every "
+             "process HMAC-tags a digest of its post-init parameters and "
+             "verifies every peer's tag at bring-up; any process launched "
+             "without the secret (or with a tampered payload) aborts the "
+             "cluster (reference: signed worker->PS pushes + TLS channels, "
+             "mpi_rendezvous_mgr.patch:585-627, grpc_channel.patch:70-85)",
+    )
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
     parser.add_argument("--evaluation-delta", type=int, default=None, help="eval every this many steps")
@@ -151,6 +172,16 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    mesh_axes = None
+    if args.mesh:
+        try:
+            mesh_axes = tuple(int(x) for x in args.mesh.split(","))
+            if len(mesh_axes) != 3 or any(a < 1 for a in mesh_axes):
+                raise ValueError
+        except ValueError:
+            from ..utils import UserException
+
+            raise UserException("--mesh wants W,PP,TP positive integers (got %r)" % args.mesh)
     device_preference = None
     if not args.platform and (args.use_tpu or args.use_gpu or args.reuse_tpu or args.reuse_gpu):
         # preference order like the reference's allocator (runner.py:282-287):
@@ -168,12 +199,16 @@ def main(argv=None):
     import jax.numpy as jnp
     import numpy as np
 
+    # How many devices this run needs: the flat engine's worker axis, or the
+    # full W*PP*TP product of a --mesh request.
+    requested_devices = mesh_axes[0] * mesh_axes[1] * mesh_axes[2] if mesh_axes else args.nb_devices
+
     def want_cpu_devices():
         # The virtual-CPU device count must be configured BEFORE any backend
         # initializes (a post-init update raises); honor an ambient
         # XLA_FLAGS force if one exists.
         return (
-            args.nb_devices and args.nb_devices > 1
+            requested_devices and requested_devices > 1
             and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
         )
 
@@ -184,7 +219,7 @@ def main(argv=None):
         # the same dance).
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu" and want_cpu_devices():
-            jax.config.update("jax_num_cpu_devices", args.nb_devices)
+            jax.config.update("jax_num_cpu_devices", requested_devices)
     elif device_preference is not None:
         # "use X if available" (reference allocator semantics): try the
         # preference list; when this installation cannot even name the
@@ -192,7 +227,7 @@ def main(argv=None):
         # device exists in the cluster.  The probe initializes a backend, so
         # the CPU device count is set first (the fallback may land there).
         if want_cpu_devices():
-            jax.config.update("jax_num_cpu_devices", args.nb_devices)
+            jax.config.update("jax_num_cpu_devices", requested_devices)
         # JAX's platform list is strict (one uninitializable backend fails the
         # whole list), so retry progressively shorter suffixes: a GPU host
         # without libtpu still lands on its GPU, not on CPU.
@@ -208,7 +243,7 @@ def main(argv=None):
     else:
         effective_platform = os.environ.get("JAX_PLATFORMS", "")
         if effective_platform == "cpu" and want_cpu_devices():
-            jax.config.update("jax_num_cpu_devices", args.nb_devices)
+            jax.config.update("jax_num_cpu_devices", requested_devices)
 
     from .. import config, gars, models
     from ..core import build_optimizer, build_schedule
@@ -269,51 +304,119 @@ def main(argv=None):
             if probe_error:
                 raise probe_error[0]
         devices = jax.devices()
-        nb_devices = args.nb_devices
-        if nb_devices is None:
-            nb_devices = max(d for d in range(1, len(devices) + 1) if n % d == 0)
-        mesh = make_mesh(nb_workers=nb_devices, devices=devices[:nb_devices])
-        info(
-            "Mesh: %d x %s device(s), %d worker(s)/device"
-            % (nb_devices, devices[0].platform, n // nb_devices)
-        )
+        if mesh_axes is not None:
+            w_axis, pp_axis, tp_axis = mesh_axes
+            if w_axis != n:
+                raise UserException(
+                    "--mesh worker axis W=%d must equal --nb-workers %d (one "
+                    "logical Byzantine worker per (pipe x model) submesh)"
+                    % (w_axis, n)
+                )
+            mesh = make_mesh(
+                nb_workers=w_axis, model_parallelism=tp_axis,
+                pipeline_parallelism=pp_axis, devices=devices[:requested_devices],
+            )
+            info(
+                "Sharded mesh: %d worker(s) x %d pipeline stage(s) x %d-way tensor "
+                "parallelism on %d %s device(s)"
+                % (w_axis, pp_axis, tp_axis, requested_devices, devices[0].platform)
+            )
+        else:
+            nb_devices = args.nb_devices
+            if nb_devices is None:
+                nb_devices = max(d for d in range(1, len(devices) + 1) if n % d == 0)
+            mesh = make_mesh(nb_workers=nb_devices, devices=devices[:nb_devices])
+            info(
+                "Mesh: %d x %s device(s), %d worker(s)/device"
+                % (nb_devices, devices[0].platform, n // nb_devices)
+            )
 
     with Context("graph"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
         gar = gars.instantiate(args.aggregator, n, f, args.aggregator_args)
         attack = attacks.instantiate(args.attack, n, r, args.attack_args) if args.attack else None
         lossy = LossyLink(args.udp, args.udp_args) if args.udp > 0 else None
-        engine = RobustEngine(
-            mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
-            exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
-            batch_transform=experiment.device_transform(),
-            worker_metrics=args.worker_metrics,
-            reputation_decay=args.reputation_decay,
-            quarantine_threshold=args.quarantine_threshold,
-            granularity=args.granularity,
-        )
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
         tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
 
-        # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
-        base_loss, l1, l2 = experiment.loss, args.l1_regularize, args.l2_regularize
+        if mesh_axes is not None:
+            # ---- fully-sharded engine (per-layer GAR on sharded grads) ----
+            from ..parallel.sharded_engine import ShardedRobustEngine
 
-        def loss_fn(params, batch):
-            loss = base_loss(params, batch)
-            leaves = jax.tree_util.tree_leaves(params)
-            if l1:
-                loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
-            if l2:
-                loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
-            return loss
+            if not getattr(experiment, "supports_sharded", False):
+                raise UserException(
+                    "Experiment %r does not publish sharded hooks (sharded_init/"
+                    "sharded_specs/sharded_loss); --mesh currently works with: %s"
+                    % (args.experiment, ", ".join(
+                        name for name in models.itemize()
+                        if getattr(models.get(name), "supports_sharded", False)) or "none")
+                )
+            if args.l1_regularize or args.l2_regularize:
+                raise UserException(
+                    "--l1/--l2-regularize are not supported with --mesh: the "
+                    "sharded loss is a LOCAL PARTIAL under shard_map and a "
+                    "parameter-norm term would be double-counted per shard"
+                )
+            if args.unroll > 1:
+                warning("--unroll > 1 is not supported with --mesh; running per-step")
+            # ``vector`` (the flat default) means whole-vector selection,
+            # which the sharded engine spells ``global`` (one global (n, n)
+            # distance matrix accumulated across shards).
+            gran = "global" if args.granularity == "vector" else args.granularity
+            engine = ShardedRobustEngine(
+                mesh, gar, nb_real_byz=r, attack=attack, lossy_link=lossy,
+                granularity=gran, exchange_dtype=args.exchange_dtype,
+                worker_momentum=args.worker_momentum,
+                worker_metrics=args.worker_metrics,
+                reputation_decay=args.reputation_decay,
+                quarantine_threshold=args.quarantine_threshold,
+            )
+            loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
+            state = engine.init_state(
+                experiment.sharded_init(mesh_axes[1]), experiment.sharded_specs(),
+                tx, seed=args.seed,
+            )
+            step_fn = engine.build_step(loss_fn, tx, state)
+            unroll = 1
+            multi_fn = None
+            eval_fn = None  # metric sums need a dense replica; eval reports loss
+            eval_loss_fn = engine.build_eval(loss_fn, state)
+        else:
+            if args.granularity in ("layer", "global"):
+                raise UserException(
+                    "--granularity %s needs the sharded engine: pass --mesh W,PP,TP"
+                    % args.granularity
+                )
+            engine = RobustEngine(
+                mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
+                exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
+                batch_transform=experiment.device_transform(),
+                worker_metrics=args.worker_metrics,
+                reputation_decay=args.reputation_decay,
+                quarantine_threshold=args.quarantine_threshold,
+                granularity=args.granularity,
+            )
 
-        params = experiment.init(jax.random.PRNGKey(args.seed))
-        state = engine.init_state(params, tx, seed=args.seed)
-        step_fn = engine.build_step(loss_fn, tx)
-        unroll = max(1, args.unroll)
-        multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
-        eval_fn = engine.build_eval_sums(experiment.metrics)
+            # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
+            base_loss, l1, l2 = experiment.loss, args.l1_regularize, args.l2_regularize
+
+            def loss_fn(params, batch):
+                loss = base_loss(params, batch)
+                leaves = jax.tree_util.tree_leaves(params)
+                if l1:
+                    loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
+                if l2:
+                    loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
+                return loss
+
+            params = experiment.init(jax.random.PRNGKey(args.seed))
+            state = engine.init_state(params, tx, seed=args.seed)
+            step_fn = engine.build_step(loss_fn, tx)
+            unroll = max(1, args.unroll)
+            multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
+            eval_fn = engine.build_eval_sums(experiment.metrics)
+            eval_loss_fn = None
 
     # Cadences with config.py defaults (reference: config.py:54-61)
     def pick(value, default):
@@ -390,6 +493,27 @@ def main(argv=None):
                 restored, offstep = checkpoints.restore(template, step=target_step)
                 state = engine.put_state(restored.replace(carry=carry, momentum=momentum))
 
+    # Multi-host boundary authentication (reference parity: every worker->PS
+    # push is signed, mpi_rendezvous_mgr.patch:585-627; here the surface is
+    # process bring-up — see parallel/auth.py docstring). After restore, so
+    # the digest covers the parameters training will actually start from.
+    if args.session_secret:
+        from ..parallel.auth import authenticate_processes
+
+        with Context("auth"):
+            authenticate_processes(
+                args.session_secret.encode(), state.params, step=offstep,
+                verify_equal=mesh_axes is None,
+            )
+            info("Host handshake OK: %d process(es) authenticated" % nb_processes)
+    elif nb_processes > 1:
+        warning(
+            "Multi-process run without --session-secret: the host boundary is "
+            "UNAUTHENTICATED (the reference signs every worker->PS tensor, "
+            "mpi_rendezvous_mgr.patch:585-627); pass the same --session-secret "
+            "on every host to enable the bring-up handshake"
+        )
+
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
     def next_chunk():
@@ -449,14 +573,23 @@ def main(argv=None):
     }
 
     def run_eval(step):
-        sums = None
-        for batch in experiment.make_eval_iterator(n):
-            folded = jax.device_get(eval_fn(state, engine.shard_batch(batch)))
-            if sums is None:
-                sums = folded
-            else:
-                sums = jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
-        metrics = {name: float(total) / max(float(count), 1.0) for name, (total, count) in sums.items()}
+        if eval_fn is None:
+            # Sharded engine: metric sums would need a dense replica of the
+            # pipelined model; the held-out LOSS is the portable metric.
+            values = [
+                float(jax.device_get(eval_loss_fn(state, engine.shard_batch(batch))))
+                for batch in experiment.make_eval_iterator(n)
+            ]
+            metrics = {"loss": sum(values) / max(len(values), 1)}
+        else:
+            sums = None
+            for batch in experiment.make_eval_iterator(n):
+                folded = jax.device_get(eval_fn(state, engine.shard_batch(batch)))
+                if sums is None:
+                    sums = folded
+                else:
+                    sums = jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
+            metrics = {name: float(total) / max(float(count), 1.0) for name, (total, count) in sums.items()}
         info("Evaluation at step %d: %s" % (step, "  ".join("%s=%.4f" % kv for kv in sorted(metrics.items()))))
         eval_file.append(step, metrics)
         return metrics
@@ -488,7 +621,12 @@ def main(argv=None):
             if "worker_sq_dist" in metrics:
                 wd = np.asarray(jax.device_get(metrics["worker_sq_dist"]))
                 scalars["worker_sq_dist"] = wd
-                scalars["suspect_worker"] = int(np.argmax(wd))
+                # Masked rows (lossy NaN infill, quarantine) carry non-finite
+                # distance sums; np.argmax would return the FIRST such index,
+                # flagging a masked worker instead of the most distant live
+                # one. Masked workers are already surfaced via
+                # nb_quarantined/participation — suspicion ranks the live set.
+                scalars["suspect_worker"] = int(np.argmax(np.where(np.isfinite(wd), wd, -np.inf)))
             if "worker_participation" in metrics:
                 scalars["worker_participation"] = np.asarray(
                     jax.device_get(metrics["worker_participation"])
@@ -596,10 +734,10 @@ def main(argv=None):
                 # to disk.  If an exception is already propagating, the
                 # flush failure must not mask it — log it instead.
                 if sys.exc_info()[0] is None:
-                    checkpoints.wait()
+                    checkpoints.wait(shutdown=True)
                 else:
                     try:
-                        checkpoints.wait()
+                        checkpoints.wait(shutdown=True)
                     except Exception as exc:
                         warning("Checkpoint write failed during abort: %s" % exc)
     return 0

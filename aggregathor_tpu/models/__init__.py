@@ -33,6 +33,11 @@ def itemize():
     return experiments.itemize()
 
 
+def get(name):
+    """The experiment class registered under ``name`` (not instantiated)."""
+    return experiments.get(name)
+
+
 def instantiate(name, args=None):
     """Build the experiment registered under ``name`` from key:value args."""
     return experiments.get(name)(args or [])
@@ -40,6 +45,13 @@ def instantiate(name, args=None):
 
 class Experiment:
     """Base experiment (see module docstring for the contract)."""
+
+    #: True if the experiment publishes the sharded-engine hooks the CLI's
+    #: ``--mesh`` path needs: ``sharded_init(n_stages) -> (key -> params)``,
+    #: ``sharded_specs() -> PartitionSpec pytree``, and
+    #: ``sharded_loss(n_stages, microbatches) -> shard_map local-partial
+    #: loss``.  See models/transformer.py for the reference implementation.
+    supports_sharded = False
 
     def __init__(self, args):
         self.args = args

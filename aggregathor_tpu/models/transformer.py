@@ -514,8 +514,20 @@ class TransformerExperiment(Experiment):
         self.batch_size = int(kv["batch-size"])
         self.corpus = synthetic_corpus(self.cfg.vocab_size, int(kv["corpus"]))
 
+    supports_sharded = True
+
     def init(self, rng):
         return init_params(self.cfg, rng, n_stages=1)
+
+    # --- sharded-engine hooks (cli/runner.py --mesh W,PP,TP) ---
+    def sharded_init(self, n_stages):
+        return lambda key: init_params(self.cfg, key, n_stages=n_stages)
+
+    def sharded_specs(self):
+        return param_specs(self.cfg)
+
+    def sharded_loss(self, n_stages, microbatches):
+        return make_pipeline_loss(self.cfg, n_stages=n_stages, microbatches=microbatches)
 
     def loss(self, params, batch):
         return loss_dense(params, batch, self.cfg)

@@ -82,6 +82,7 @@ class ShardedRobustEngine:
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
+        self._state_shardings = None  # captured by init_state, for put_state
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
@@ -173,7 +174,7 @@ class ShardedRobustEngine:
             carry = per_worker_zeros()
         if self.reputation_decay is not None:
             reputation = jax.device_put(jnp.ones((self.nb_workers,), jnp.float32), rep)
-        return TrainState(
+        state = TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
             opt_state=opt_state,
@@ -183,10 +184,23 @@ class ShardedRobustEngine:
             momentum_steps=momentum_steps,
             reputation=reputation,
         )
+        # Remember the layout for put_state (checkpoint restore re-sharding).
+        self._state_shardings = jax.tree.map(lambda a: a.sharding, state)
+        return state
 
     def shard_batch(self, batch):
         """Device_put a worker-major batch pytree (leading dim = nb_workers)."""
         return jax.device_put(batch, NamedSharding(self.mesh, P(worker_axis)))
+
+    def put_state(self, state):
+        """Re-shard a (possibly host-resident) state onto this mesh with the
+        layout ``init_state`` established — the checkpoint-restore path
+        (cli/runner.py) round-trips state through the host and needs the
+        sharded placement back.  Leaves that are already live device arrays
+        with the right sharding pass through unchanged."""
+        if self._state_shardings is None:
+            raise RuntimeError("put_state needs init_state to have run first")
+        return jax.tree.map(jax.device_put, state, self._state_shardings)
 
     # ------------------------------------------------------------------ #
 
