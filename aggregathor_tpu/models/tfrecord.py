@@ -308,3 +308,114 @@ def has_cifar10_tfrecords(directory):
     return all(
         os.path.isfile(os.path.join(directory, name)) for name in CIFAR10_SHARDS.values()
     )
+
+
+# ------------------------------------------------------- ImageNet layout --
+#
+# The reference trains slims models on TFRecord ImageNet built by slim's
+# build_imagenet_data.py (experiments/slims.py:98-111): sharded files named
+# ``train-00000-of-01024`` / ``validation-00000-of-00128`` (no extension),
+# each example carrying a JPEG under ``image/encoded`` and a 1-based label
+# (0 = background, hence the reference's ``--labels-offset`` knob) under
+# ``image/class/label``.  Decode is PIL (TF-free), like the PNG codec above.
+
+import re as _re
+
+_IMAGENET_SHARD = {"train": _re.compile(r"^train-\d{5}-of-\d{5}$"),
+                   "validation": _re.compile(r"^validation-\d{5}-of-\d{5}$")}
+
+
+def jpeg_decode(data, image_size=None):
+    """JPEG bytes -> (h, w, 3) uint8; optionally resized to a square."""
+    import io
+
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as img:
+        img = img.convert("RGB")
+        if image_size is not None and img.size != (image_size, image_size):
+            img = img.resize((image_size, image_size), Image.BILINEAR)
+        return np.asarray(img, dtype=np.uint8)
+
+
+def jpeg_encode(array, quality=90):
+    """(h, w, 3) uint8 -> JPEG bytes (fixture writer)."""
+    import io
+
+    from PIL import Image
+
+    out = io.BytesIO()
+    Image.fromarray(np.asarray(array, dtype=np.uint8)).save(out, format="JPEG", quality=quality)
+    return out.getvalue()
+
+
+def imagenet_shards(directory, split):
+    """Sorted shard paths of one split under the slim naming convention."""
+    pattern = _IMAGENET_SHARD[split]
+    try:
+        names = sorted(n for n in os.listdir(directory) if pattern.match(n))
+    except OSError:
+        return []
+    return [os.path.join(directory, n) for n in names]
+
+
+def has_imagenet_tfrecords(directory):
+    return bool(imagenet_shards(directory, "train")) and bool(
+        imagenet_shards(directory, "validation")
+    )
+
+
+def read_imagenet_split(directory, split, image_size, limit=None):
+    """Stream slim ImageNet shards -> (uint8 (n, s, s, 3), int32 labels).
+
+    ``limit`` caps the example count (full ImageNet does not fit host RAM as
+    a dense array; the capped subset is REAL data — decoded, resized — and
+    the loader states the cap).  Shards are consumed in name order so the
+    subset is deterministic."""
+    images, labels = [], []
+    for path in imagenet_shards(directory, split):
+        for payload in iter_tfrecords(path):
+            example = parse_example(payload)
+            fmt = example.get("image/format", [b"JPEG"])[0]
+            encoded = example["image/encoded"][0]
+            if fmt in (b"png", b"PNG"):
+                image = png_decode(encoded)
+                if image.shape[:2] != (image_size, image_size):
+                    image = jpeg_decode(png_encode(image), image_size)  # resize path
+            else:
+                image = jpeg_decode(encoded, image_size)
+            images.append(image)
+            labels.append(int(example["image/class/label"][0]))
+            if limit is not None and len(images) >= limit:
+                return np.stack(images), np.asarray(labels, dtype=np.int32)
+    if not images:
+        raise UserException(
+            "No %s examples under %r (expected slim-layout shards like "
+            "train-00000-of-01024)" % (split, directory)
+        )
+    return np.stack(images), np.asarray(labels, dtype=np.int32)
+
+
+def write_imagenet_split(directory, split, images, labels, nb_shards=2):
+    """Write slim-layout ImageNet shards (fixtures, tests)."""
+    os.makedirs(directory, exist_ok=True)
+    chunks = np.array_split(np.arange(len(images)), nb_shards)
+    paths = []
+    for shard_index, chunk in enumerate(chunks):
+        path = os.path.join(
+            directory, "%s-%05d-of-%05d" % (split, shard_index, nb_shards)
+        )
+
+        def payloads(chunk=chunk):
+            for i in chunk:
+                yield build_example({
+                    "image/encoded": jpeg_encode(images[i]),
+                    "image/format": b"JPEG",
+                    "image/class/label": int(labels[i]),
+                    "image/height": int(images[i].shape[0]),
+                    "image/width": int(images[i].shape[1]),
+                })
+
+        write_tfrecords(path, payloads())
+        paths.append(path)
+    return paths

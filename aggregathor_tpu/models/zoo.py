@@ -20,7 +20,7 @@ import optax
 from ..utils import parse_keyval
 from . import Experiment, register
 from .classic import AlexNetV2, CifarNet, LeNet, OverFeat
-from .datasets import WorkerBatchIterator, eval_batches, load_cifar10, load_imagenet_standin
+from .datasets import WorkerBatchIterator, eval_batches, load_cifar10, load_imagenet
 from .inception import InceptionResNetV2, InceptionV1, InceptionV2, InceptionV3, InceptionV4
 from .mobilenet import (
     MOBILENET_MULTIPLIERS,
@@ -102,7 +102,7 @@ AUX_CAPABLE = {"inception_v1", "inception_v3", "inception_v4", "inception_resnet
 
 DATASETS = {
     "cifar10": lambda kv: load_cifar10(),
-    "imagenet": lambda kv: load_imagenet_standin(image_size=kv["image-size"]),
+    "imagenet": lambda kv: load_imagenet(image_size=kv["image-size"]),
 }
 
 

@@ -1,0 +1,128 @@
+"""Experiment-scale robustness table: accuracy under attack, per rule.
+
+The reference's entire reason to exist is that robust GARs keep training
+under Byzantine gradients while plain averaging does not (SysML'19;
+experiments.sh:19-53 is its harness).  The unit suite proves this at toy
+scale; this harness produces the experiment-scale evidence: cnnet CIFAR-10,
+n=8 workers, f=2 declared / 2 real attackers, {average, krum, median} x
+{none, little, empire}, final evaluation accuracy after a fixed step budget
+— driven through the REAL CLI as subprocesses, like train_configs.py.
+
+Expected shape of the result: under ``little``/``empire`` the robust rules
+keep learning while ``average`` is dragged (or NaN-aborts, which the runner
+surfaces as a divergence error — recorded here as ``diverged``).
+
+Usage::
+
+    python benchmarks/robustness.py [--steps 300] [--batch 32] [--platform cpu]
+                                    [--rules average,krum,median]
+                                    [--attacks none,little,empire]
+
+Prints one JSON line per cell and a final markdown table (paste into
+docs/robustness.md).
+"""
+
+import argparse
+import itertools
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cell(rule, attack, steps, batch, platform, timeout, experiment):
+    eval_dir = tempfile.mkdtemp(prefix="aggregathor_rob_")
+    eval_file = os.path.join(eval_dir, "eval.tsv")
+    cmd = [
+        sys.executable, "-m", "aggregathor_tpu.cli.runner",
+        "--experiment", experiment, "--experiment-args", "batch-size:%d" % batch,
+        "--aggregator", rule,
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--max-step", str(steps),
+        "--learning-rate-args", "initial-rate:0.05",
+        "--evaluation-file", eval_file,
+        "--evaluation-delta", str(max(steps // 4, 1)), "--evaluation-period", "-1",
+    ]
+    if attack != "none":
+        cmd += ["--attack", attack, "--nb-real-byz-workers", "2"]
+    env = dict(os.environ)
+    if platform:
+        cmd += ["--platform", platform]
+        env["JAX_PLATFORMS"] = platform
+    if platform == "cpu":
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired:
+        shutil.rmtree(eval_dir, ignore_errors=True)
+        return {"rule": rule, "attack": attack, "accuracy": None, "error": "timeout"}
+    accuracy, last_step = None, None
+    try:
+        for line in open(eval_file):
+            fields = line.strip().split("\t")
+            last_step = int(fields[1])
+            for kv in fields[2:]:
+                name, _, value = kv.partition(":")
+                if name == "accuracy":
+                    accuracy = float(value)
+    except OSError:
+        pass
+    shutil.rmtree(eval_dir, ignore_errors=True)
+    diverged = proc.returncode != 0 and "diverg" in (proc.stdout + proc.stderr).lower()
+    row = {
+        "metric": "robustness_accuracy",
+        "experiment": experiment,
+        "rule": rule, "attack": attack,
+        "n": 8, "f": 2, "real_byz": 0 if attack == "none" else 2,
+        "steps": steps, "batch": batch,
+        "accuracy": accuracy, "eval_step": last_step,
+        "diverged": bool(diverged),
+    }
+    if proc.returncode != 0 and not diverged:
+        row["error"] = (proc.stderr or proc.stdout).strip()[-300:]
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--rules", default="average,krum,median")
+    ap.add_argument("--attacks", default="none,little,empire")
+    ap.add_argument("--experiment", default="cnnet")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--timeout", type=int, default=3600, help="per-cell seconds")
+    args = ap.parse_args()
+
+    rules = args.rules.split(",")
+    attacks = args.attacks.split(",")
+    rows = []
+    for rule, attack in itertools.product(rules, attacks):
+        row = run_cell(rule, attack, args.steps, args.batch, args.platform,
+                       args.timeout, args.experiment)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print("\n| rule | " + " | ".join(attacks) + " |")
+    print("|------|" + "---|" * len(attacks))
+    for rule in rules:
+        cells = []
+        for attack in attacks:
+            row = next(r for r in rows if r["rule"] == rule and r["attack"] == attack)
+            if row["diverged"]:
+                cells.append("diverged (NaN abort)")
+            elif row["accuracy"] is None:
+                cells.append("error")
+            else:
+                cells.append("%.3f" % row["accuracy"])
+        print("| %s | %s |" % (rule, " | ".join(cells)))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,3 +156,73 @@ def test_mnist_accuracy_target_on_real_data():
         pytest.skip("no real mnist.npz on disk (synthetic stand-in active)")
     accuracy = _train_and_eval_mnist(300)
     assert accuracy >= 0.9, "MNIST accuracy %.3f below target after 300 robust steps" % accuracy
+
+
+def test_imagenet_tfrecord_roundtrip(tmp_path):
+    """Slim-layout ImageNet shards (JPEG, sharded names, 1-based labels)
+    round-trip through the TF-free codec: write a fixture, read it back
+    resized, and load it through the real dataset path (VERDICT r2
+    next-step 8 — no silent synthetic data behind a real-dataset name)."""
+    import numpy as np
+
+    from aggregathor_tpu.models import tfrecord
+
+    rng = np.random.default_rng(3)
+    # smooth gradients survive JPEG well enough to assert pixel closeness
+    base = np.linspace(0, 200, 48 * 48 * 3).reshape(48, 48, 3)
+    images = np.stack([
+        np.clip(base + rng.integers(0, 40), 0, 255).astype(np.uint8) for _ in range(10)
+    ])
+    labels = rng.integers(1, 5, size=10).astype(np.int32)
+    data_dir = tmp_path / "imagenet"
+    paths = tfrecord.write_imagenet_split(str(data_dir), "train", images, labels, nb_shards=3)
+    assert [os.path.basename(p) for p in paths] == [
+        "train-00000-of-00003", "train-00001-of-00003", "train-00002-of-00003"]
+    tfrecord.write_imagenet_split(str(data_dir), "validation", images[:4], labels[:4])
+    assert tfrecord.has_imagenet_tfrecords(str(data_dir))
+
+    x, y = tfrecord.read_imagenet_split(str(data_dir), "train", image_size=48)
+    assert x.shape == (10, 48, 48, 3) and x.dtype == np.uint8
+    np.testing.assert_array_equal(y, labels)
+    assert float(np.mean(np.abs(x.astype(np.float32) - images))) < 8.0  # JPEG loss only
+
+    # resize + limit paths
+    x16, y16 = tfrecord.read_imagenet_split(str(data_dir), "validation", image_size=16, limit=3)
+    assert x16.shape == (3, 16, 16, 3)
+
+
+def test_load_imagenet_real_path(tmp_path, monkeypatch):
+    """load_imagenet picks up on-disk shards (synthetic=False), caps the
+    subset, caches an npz, and the cache short-circuits the next load."""
+    import numpy as np
+
+    from aggregathor_tpu.models import datasets, tfrecord
+
+    rng = np.random.default_rng(4)
+    images = rng.integers(0, 255, size=(12, 24, 24, 3)).astype(np.uint8)
+    labels = rng.integers(1, 4, size=12).astype(np.int32)
+    data_dir = tmp_path / "imagenet"
+    tfrecord.write_imagenet_split(str(data_dir), "train", images, labels)
+    tfrecord.write_imagenet_split(str(data_dir), "validation", images[:6], labels[:6])
+    monkeypatch.setenv("AGGREGATHOR_DATA", str(tmp_path))
+
+    ds = datasets.load_imagenet(image_size=24, limit_train=8, limit_test=4)
+    assert not ds.synthetic
+    assert ds.x_train.shape == (8, 24, 24, 3)  # capped subset
+    assert ds.x_test.shape == (4, 24, 24, 3)
+    assert ds.x_train.dtype == np.float32 and float(ds.x_train.max()) <= 1.0
+    assert ds.nb_classes == int(labels[:8].max()) + 1
+    # cache key carries the caps (a tiny smoke cache must not satisfy a
+    # larger request)
+    assert os.path.isfile(str(data_dir / "imagenet24-t8-v4.npz"))
+
+    # the cache must actually short-circuit the decode: remove the shards —
+    # a second load can only succeed through the npz
+    for name in os.listdir(str(data_dir)):
+        if not name.endswith(".npz"):
+            os.unlink(str(data_dir / name))
+    cached = datasets.load_imagenet(image_size=24, limit_train=8, limit_test=4)
+    assert not cached.synthetic
+    np.testing.assert_allclose(cached.x_train, ds.x_train, atol=1e-6)
+    # a DIFFERENT cap misses the cache and (shards gone) falls back loudly
+    assert datasets.load_imagenet(image_size=24, limit_train=6, limit_test=4).synthetic
