@@ -35,7 +35,10 @@ NORTH_STAR_STEPS_PER_S = 2000.0
 RESULT_TOKEN = "GRAFT_BENCH_RESULT "
 
 
-def run_bench(force_cpu=False):
+def run_bench(force_cpu=False, emit=lambda result: None):
+    """Measure config 2; ``emit(result)`` is called with the result dict as
+    soon as it is complete (and again, updated, after the optional bf16
+    secondary) so a later hang cannot cost the run its headline."""
     import jax
 
     platform = os.environ.get("JAX_PLATFORMS", "").strip().lower()
@@ -70,47 +73,7 @@ def run_bench(force_cpu=False):
     # One real chip hosts all n logical workers (vmapped); a pod spreads them.
     nb_devices = max(d for d in range(1, len(devices) + 1) if nb_workers % d == 0)
     mesh = make_mesh(nb_workers=nb_devices, devices=devices[:nb_devices])
-
-    # augment:device — the cifarnet crop/flip runs INSIDE the jitted step
-    # (models/preprocessing.py device tier), so the host input path is only
-    # the gather + host->device transfer, like a production TPU pipeline.
-    experiment = models.instantiate("cnnet", ["batch-size:%d" % batch_size, "augment:device"])
-    gar = gars.instantiate("krum", nb_workers, nb_byz)
-    engine = RobustEngine(mesh, gar, nb_workers, batch_transform=experiment.device_transform())
-
-    tx = optax.sgd(1e-2)
-    params = experiment.init(jax.random.PRNGKey(0))
-    state = engine.init_state(params, tx)
-    it = experiment.make_train_iterator(nb_workers, seed=0)
-
-    if unroll == 1:
-        # Per-step dispatch (CPU fallback; also the reference's own loop
-        # shape, runner.py:562-576).
-        fresh_fn = resident_fn = engine.build_step(experiment.loss, tx)
-        make_fresh = lambda: engine.shard_batch(next(it))
-    else:
-        # Scanned K-step trainers; the fresh form consumes K distinct batches
-        # per dispatch so its timed loop pays the full input path (vectorized
-        # K-batch gather + transfer, overlapped with device compute by the
-        # background prefetcher — the reference's queue runners played this
-        # role, experiments/cnnet.py:115-146); the resident form reuses one
-        # device-resident batch: the pure-compute upper bound.
-        from aggregathor_tpu.models.datasets import DevicePrefetcher
-
-        fresh_fn = engine.build_multi_step(experiment.loss, tx)
-        resident_fn = engine.build_multi_step(experiment.loss, tx, repeat_steps=unroll)
-    # Draw the resident batch BEFORE the prefetcher exists: its daemon thread
-    # shares this iterator and numpy Generators are not thread-safe.
-    resident_batch = engine.shard_batch(next(it))
-    prefetcher = None
-    if unroll > 1:
-
-        def chunks_iter():
-            while True:
-                yield it.next_many(unroll)
-
-        prefetcher = DevicePrefetcher(chunks_iter(), engine.shard_batches, depth=2)
-        make_fresh = lambda: next(prefetcher)
+    started = time.perf_counter()
 
     def sync(m):
         # A REAL device sync: fetch the loss to host.  Under the tunneled
@@ -133,15 +96,95 @@ def run_bench(force_cpu=False):
         sync(m)
         return chunks * unroll / (time.perf_counter() - t0), st, m
 
-    # First dispatch = compile + run, excluded like the reference's report.
-    state, first_fresh = warm(fresh_fn, state, make_fresh())
-    fresh_steps_per_s, state, metrics = timed(lambda st: fresh_fn(st, make_fresh()), state)
-    final_loss = float(np.asarray(metrics["total_loss"]).reshape(-1)[-1])
-    if prefetcher is not None:
-        prefetcher.close()  # keep the resident timing free of producer work
+    def measure(extra_args):
+        """One full fresh+resident measurement of config 2 (+extra args)."""
+        # augment:device — the cifarnet crop/flip runs INSIDE the jitted
+        # step (models/preprocessing.py device tier), so the host input path
+        # is only the gather + host->device transfer, like a production TPU
+        # pipeline.
+        experiment = models.instantiate(
+            "cnnet", ["batch-size:%d" % batch_size, "augment:device"] + extra_args
+        )
+        gar = gars.instantiate("krum", nb_workers, nb_byz)
+        engine = RobustEngine(mesh, gar, nb_workers, batch_transform=experiment.device_transform())
 
-    state, _ = warm(resident_fn, state, resident_batch)
-    resident_steps_per_s, state, _ = timed(lambda st: resident_fn(st, resident_batch), state)
+        tx = optax.sgd(1e-2)
+        params = experiment.init(jax.random.PRNGKey(0))
+        state = engine.init_state(params, tx)
+        it = experiment.make_train_iterator(nb_workers, seed=0)
+
+        if unroll == 1:
+            # Per-step dispatch (CPU fallback; also the reference's own loop
+            # shape, runner.py:562-576).
+            fresh_fn = resident_fn = engine.build_step(experiment.loss, tx)
+            make_fresh = lambda: engine.shard_batch(next(it))
+        else:
+            # Scanned K-step trainers; the fresh form consumes K distinct
+            # batches per dispatch so its timed loop pays the full input path
+            # (vectorized K-batch gather + transfer, overlapped with device
+            # compute by the background prefetcher — the reference's queue
+            # runners played this role, experiments/cnnet.py:115-146); the
+            # resident form reuses one device-resident batch: the
+            # pure-compute upper bound.
+            from aggregathor_tpu.models.datasets import DevicePrefetcher
+
+            fresh_fn = engine.build_multi_step(experiment.loss, tx)
+            resident_fn = engine.build_multi_step(experiment.loss, tx, repeat_steps=unroll)
+        # Draw the resident batch BEFORE the prefetcher exists: its daemon
+        # thread shares this iterator and numpy Generators are not
+        # thread-safe.
+        resident_batch = engine.shard_batch(next(it))
+        prefetcher = None
+        if unroll > 1:
+
+            def chunks_iter():
+                while True:
+                    yield it.next_many(unroll)
+
+            prefetcher = DevicePrefetcher(chunks_iter(), engine.shard_batches, depth=2)
+            make_fresh = lambda: next(prefetcher)
+
+        # Per-STEP FLOPs from XLA's cost model, on the SINGLE-step program:
+        # the scanned trainer's while-body is counted once by HloCostAnalysis
+        # regardless of trip count, so analyzing the K-step program would
+        # understate per-step FLOPs ~Kx.  Lowering only traces (no donation,
+        # no extra device compile unless the lowered-stage analysis is
+        # unavailable and we must fall back to compiling the 1-step program).
+        flops_per_step = None
+        try:
+            single = engine.build_step(experiment.loss, tx).lower(state, resident_batch)
+            try:
+                cost = single.cost_analysis()
+            except Exception:
+                cost = single.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            flops_per_step = float(cost["flops"])
+        except Exception:
+            pass  # cost model unavailable: MFU omitted, throughput stands
+
+        # First dispatch = compile + run, excluded like the reference's report.
+        state, first_fresh = warm(fresh_fn, state, make_fresh())
+        fresh_steps_per_s, state, metrics = timed(lambda st: fresh_fn(st, make_fresh()), state)
+        final_loss = float(np.asarray(metrics["total_loss"]).reshape(-1)[-1])
+        if prefetcher is not None:
+            prefetcher.close()  # keep the resident timing free of producer work
+
+        state, _ = warm(resident_fn, state, resident_batch)
+        resident_steps_per_s, state, _ = timed(lambda st: resident_fn(st, resident_batch), state)
+        return {
+            "fresh": fresh_steps_per_s,
+            "resident": resident_steps_per_s,
+            "first": first_fresh,
+            "final_loss": final_loss,
+            "flops_per_step": flops_per_step,
+            "augment": experiment.augment,
+        }
+
+    f32 = measure([])
+    fresh_steps_per_s = f32["fresh"]
+    resident_steps_per_s = f32["resident"]
+    first_fresh, final_loss = f32["first"], f32["final_loss"]
 
     name = "cnnet_cifar10_multikrum_n8_f2_steps_per_s"
     if force_cpu:
@@ -157,7 +200,7 @@ def run_bench(force_cpu=False):
             "nb_workers": nb_workers,
             "nb_byz": nb_byz,
             "batch_size_per_worker": batch_size,
-            "augment": experiment.augment,
+            "augment": f32["augment"],
             "steps_per_s_fresh_batch": round(fresh_steps_per_s, 3),
             "steps_per_s_resident_batch": round(resident_steps_per_s, 3),
             "first_step_s": round(first_fresh, 3),
@@ -166,6 +209,20 @@ def run_bench(force_cpu=False):
             "final_loss": final_loss,
         },
     }
+    if f32["flops_per_step"]:
+        result["detail"]["flops_per_step"] = f32["flops_per_step"]
+        if devices[0].platform == "tpu":
+            # The f32 program does not run at the chip's bf16 peak, so the
+            # field name says exactly which bar it is measured against
+            # (197 bf16 TFLOP/s on v5e, BENCHMARKS.md §1); the apples-to-
+            # apples MFU lands on the bfloat16 row below.
+            peak = 1.97e14
+            result["detail"]["mfu_pct_of_bf16_peak_fresh"] = round(
+                100.0 * f32["flops_per_step"] * fresh_steps_per_s / peak, 2
+            )
+            result["detail"]["mfu_pct_of_bf16_peak_resident"] = round(
+                100.0 * f32["flops_per_step"] * resident_steps_per_s / peak, 2
+            )
     if force_cpu:
         # The fallback runs a REDUCED workload (so it finishes inside the
         # watchdog on one CPU core); a reader of the JSON alone must not
@@ -175,12 +232,45 @@ def run_bench(force_cpu=False):
             "(batch=128 unroll=20); vs_baseline is stated against a different "
             "program and is not comparable" % (batch_size, unroll)
         )
+    emit(result)
+
+    # Secondary: bfloat16 compute (MXU-rate matmuls, f32 params) — the
+    # TPU-lean variant (train_configs config 2b measures it through the CLI
+    # too).  The f32 HEADLINE IS ALREADY EMITTED: a chip wedge inside this
+    # extra measurement can no longer cost the run its result (the parent
+    # keeps the last result line it saw, including from a killed child).
+    # Budget-guarded so the watchdog usually doesn't fire at all here.
+    if not force_cpu and time.perf_counter() - started < 240.0:
+        try:
+            bf16 = measure(["dtype:bfloat16"])
+        except Exception:
+            bf16 = None
+        if bf16 is not None:
+            row = {
+                "steps_per_s_fresh_batch": round(bf16["fresh"], 3),
+                "steps_per_s_resident_batch": round(bf16["resident"], 3),
+                "first_step_s": round(bf16["first"], 3),
+                "final_loss": bf16["final_loss"],
+                "flops_per_step": bf16["flops_per_step"],
+            }
+            if bf16["flops_per_step"] and devices[0].platform == "tpu":
+                # bf16 math against the bf16 peak: the real MFU figure.
+                row["mfu_pct_fresh"] = round(
+                    100.0 * bf16["flops_per_step"] * bf16["fresh"] / 1.97e14, 2
+                )
+                row["mfu_pct_resident"] = round(
+                    100.0 * bf16["flops_per_step"] * bf16["resident"] / 1.97e14, 2
+                )
+            result["detail"]["bfloat16"] = row
+            emit(result)
     return result
 
 
 def _child(force_cpu):
-    result = run_bench(force_cpu=force_cpu)
-    print(RESULT_TOKEN + json.dumps(result), flush=True)
+    run_bench(
+        force_cpu=force_cpu,
+        emit=lambda result: print(RESULT_TOKEN + json.dumps(result), flush=True),
+    )
 
 
 def _probe():
@@ -215,28 +305,35 @@ def _attempt(args, timeout):
         text=True,
         start_new_session=True,
     )
+    timed_out = False
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        timed_out = True
         print("bench: child %s timed out after %ds" % (args, timeout), file=sys.stderr)
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+        stdout, stderr = "", ""
         try:
-            proc.communicate(timeout=15)  # bounded: abandon a D-state child
+            # Bank whatever the child flushed before the kill: the headline
+            # line is emitted as soon as the f32 measurement completes, so a
+            # wedge inside the bf16 secondary doesn't cost us the result.
+            stdout, stderr = proc.communicate(timeout=15)
         except subprocess.TimeoutExpired:
             print("bench: child unkillable (D-state?), abandoning it", file=sys.stderr)
-        return None
-    for line in stdout.splitlines():
+    result = None
+    for line in (stdout or "").splitlines():
         if line.startswith(RESULT_TOKEN):
-            return json.loads(line[len(RESULT_TOKEN):])
-    print(
-        "bench: child %s failed rc=%d: %s"
-        % (args, proc.returncode, stderr.strip()[-800:]),
-        file=sys.stderr,
-    )
-    return None
+            result = json.loads(line[len(RESULT_TOKEN):])  # keep the LAST line
+    if result is None and not timed_out:
+        print(
+            "bench: child %s failed rc=%d: %s"
+            % (args, proc.returncode, (stderr or "").strip()[-800:]),
+            file=sys.stderr,
+        )
+    return result
 
 
 def main(cpu_only=False):
@@ -244,12 +341,12 @@ def main(cpu_only=False):
     if not cpu_only:
         # Fast preflight: a wedged chip hangs on the first host fetch, so a
         # 90 s probe child decides in ~10 s (healthy) or 90 s (wedged)
-        # whether the full 480 s measurement attempt is worth starting.
+        # whether the full 600 s measurement attempt is worth starting.
         probe = _attempt(["--child-probe"], timeout=90)
         if probe is None:
             print("bench: accelerator preflight failed, falling back to CPU", file=sys.stderr)
         else:
-            result = _attempt(["--child"], timeout=480)
+            result = _attempt(["--child"], timeout=600)
             if result is None:
                 print("bench: accelerator attempt unusable, falling back to CPU", file=sys.stderr)
     if result is None:
