@@ -450,10 +450,20 @@ def main(argv=None):
         pick(args.summary_delta, config.default_summary_delta),
         pick(args.summary_period, config.default_summary_period),
     )
+    ckpt_auth = None
+    if args.session_secret and args.checkpoint_dir:
+        # The session secret also tags snapshots: a swapped/corrupted
+        # checkpoint fails verification at restore instead of silently
+        # seeding training (reference parity: the same key material signs
+        # gradients and would sign any persisted state).
+        from ..parallel.auth import GradientAuthenticator
+
+        ckpt_auth = GradientAuthenticator(args.session_secret.encode(), 1)
     checkpoints = Checkpoints(
         args.checkpoint_dir,
         pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
         args.checkpoint_keep,
+        authenticator=ckpt_auth,
         # Serialization + disk I/O run on a writer thread (the host fetch
         # stays synchronous — the step donates the state buffers); wait()
         # joins at every later fire and at exit, so a failing write surfaces

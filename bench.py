@@ -151,17 +151,18 @@ def run_bench(force_cpu=False, emit=lambda result: None):
         # no extra device compile unless the lowered-stage analysis is
         # unavailable and we must fall back to compiling the 1-step program).
         flops_per_step = None
-        try:
-            single = engine.build_step(experiment.loss, tx).lower(state, resident_batch)
+        if not force_cpu:  # feeds the MFU fields, which only TPU rows report
             try:
-                cost = single.cost_analysis()
+                single = engine.build_step(experiment.loss, tx).lower(state, resident_batch)
+                try:
+                    cost = single.cost_analysis()
+                except Exception:
+                    cost = single.compile().cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0]
+                flops_per_step = float(cost["flops"])
             except Exception:
-                cost = single.compile().cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0]
-            flops_per_step = float(cost["flops"])
-        except Exception:
-            pass  # cost model unavailable: MFU omitted, throughput stands
+                pass  # cost model unavailable: MFU omitted, throughput stands
 
         # First dispatch = compile + run, excluded like the reference's report.
         state, first_fresh = warm(fresh_fn, state, make_fresh())
@@ -326,7 +327,10 @@ def _attempt(args, timeout):
     result = None
     for line in (stdout or "").splitlines():
         if line.startswith(RESULT_TOKEN):
-            result = json.loads(line[len(RESULT_TOKEN):])  # keep the LAST line
+            try:
+                result = json.loads(line[len(RESULT_TOKEN):])  # keep the LAST valid line
+            except ValueError:
+                pass  # a SIGKILL mid-write truncates the final line; keep the prior one
     if result is None and not timed_out:
         print(
             "bench: child %s failed rc=%d: %s"

@@ -64,13 +64,27 @@ CONFIGS = {
                  "--experiment-args", "batch-size:128"],
     },
     "3": {
-        "name": "resnet50_bulyan_n32_f8",
-        "note": "BASELINE config 3; per-worker batch 4 at 128x128 to fit one "
-                "chip. Data: real slim-layout TFRecord shards when on disk "
-                "(PIL decode, capped subset — models/datasets.load_imagenet), "
-                "else ImageNet-shaped synthetic stand-in (THROUGHPUT ONLY, no "
-                "accuracy claim) — the JSON row records which",
+        "name": "resnet50_bulyan_n32_f7",
+        "note": "BASELINE config 3 prescribes Bulyan at (n=32, f=8), which "
+                "violates Bulyan's own feasibility bound n >= 4f+3 = 35 "
+                "(reference op_bulyan/cpu.cpp:57-58: b = n-4f-2 would be "
+                "negative — the reference aborts identically); measured at "
+                "the nearest feasible f=7. Per-worker batch 4 at 128x128 to "
+                "fit one chip. Data: real slim-layout TFRecord shards when "
+                "on disk (PIL decode, capped subset — "
+                "models/datasets.load_imagenet), else ImageNet-shaped "
+                "synthetic stand-in (THROUGHPUT ONLY, no accuracy claim) — "
+                "the JSON row records which",
         "args": ["--experiment", "slim-resnet_v1_50-imagenet", "--aggregator", "bulyan",
+                 "--nb-workers", "32", "--nb-decl-byz-workers", "7",
+                 "--experiment-args", "batch-size:4", "image-size:128", "dtype:bfloat16"],
+    },
+    "3k": {
+        "name": "resnet50_krum_n32_f8",
+        "note": "BASELINE.json's metric line also names Krum at (n=32, f=8), "
+                "which IS feasible (krum needs n >= f+3): the companion row "
+                "at the prescribed f. Same data policy as config 3",
+        "args": ["--experiment", "slim-resnet_v1_50-imagenet", "--aggregator", "krum",
                  "--nb-workers", "32", "--nb-decl-byz-workers", "8",
                  "--experiment-args", "batch-size:4", "image-size:128", "dtype:bfloat16"],
     },

@@ -21,8 +21,10 @@ Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
                     (scripts/pallas_tpu_check.py)
   gar_kernels     — per-rule kernel ms vs d, jnp:tpu + pallas tiers
   train_configs   — configs 2, 2b, 2c through the real CLI on TPU
-  train_configs34 — configs 3 (ResNet-50+Bulyan) and 4 (Inception-v3+median
-                    under attack), n=32 f=8, through the real CLI on TPU
+  train_configs34 — configs 3 (ResNet-50+Bulyan n=32 f=7 — BASELINE's f=8
+                    violates Bulyan's n >= 4f+3 bound), 3k (ResNet-50+Krum
+                    at the prescribed n=32 f=8) and 4 (Inception-v3+median
+                    under attack, n=32 f=8), through the real CLI on TPU
   leaf_resnet     — per-layer granularity on a slim ResNet (the bucketed
                     leaf path) through the real CLI
 
@@ -72,8 +74,8 @@ def _stages(py):
          b("benchmarks/train_configs.py", "--configs", "2,2b,2c",
            "--steps", "40", "--platform", "tpu", "--timeout", "1200"), 4200),
         ("train_configs34",
-         b("benchmarks/train_configs.py", "--configs", "3,4",
-           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 4200),
+         b("benchmarks/train_configs.py", "--configs", "3,3k,4",
+           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 6000),
         ("leaf_resnet",
          b("benchmarks/train_configs.py", "--configs", "6",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 2400),

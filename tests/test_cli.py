@@ -333,3 +333,24 @@ def test_deploy_session_secret_mismatch_rejected():
     outs = [p.communicate(timeout=300)[0] for p in procs]
     assert all(p.returncode != 0 for p in procs), outs
     assert any("authentication FAILED" in out for out in outs), outs
+
+
+def test_runner_session_secret_tags_checkpoints(tmp_path):
+    """--session-secret also HMAC-tags snapshots: resume verifies, and a
+    tampered checkpoint aborts loudly instead of silently seeding training."""
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "average", "--nb-workers", "4",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--checkpoint-dir", ckpt, "--session-secret", "launch-secret",
+    ]
+    assert 0 == run(base + ["--max-step", "3"])
+    assert any(n.endswith(".tag") for n in os.listdir(ckpt))
+    assert 0 == run(base + ["--max-step", "5"])  # verified resume
+    [newest] = [n for n in os.listdir(ckpt) if n.endswith("-5.ckpt")]
+    with open(os.path.join(ckpt, newest), "r+b") as fd:
+        fd.seek(100)
+        fd.write(b"\xff\xff\xff")
+    with pytest.raises(UserException, match="HMAC"):
+        run(base + ["--max-step", "7"])
