@@ -89,7 +89,16 @@ class Checkpoints:
                 with open(tag_path, "rb") as fd:
                     tag = fd.read()
             except OSError:
-                raise UserException("Checkpoint %r has no authentication tag" % (self._path(step),))
+                # Fail-closed (an attacker with file access could simply
+                # delete the tag otherwise), but tell the operator the
+                # migration path for snapshots saved before tagging was on.
+                raise UserException(
+                    "Checkpoint %r has no authentication tag. If it predates "
+                    "tagging (saved without --session-secret), restore once "
+                    "WITHOUT the secret and resume with it — new snapshots "
+                    "are tagged; otherwise treat the snapshot as untrusted"
+                    % (self._path(step),)
+                )
             if not self.authenticator.verify(0, step, data, tag):
                 raise UserException(
                     "Checkpoint %r failed HMAC verification (corrupted or forged)"

@@ -61,7 +61,11 @@ def run_cell(rule, attack, steps, batch, platform, timeout, experiment):
         )
     except subprocess.TimeoutExpired:
         shutil.rmtree(eval_dir, ignore_errors=True)
-        return {"rule": rule, "attack": attack, "accuracy": None, "error": "timeout"}
+        # Full row schema (the table printer and the watcher's stage
+        # accounting read these keys on every row)
+        return {"metric": "robustness_accuracy", "experiment": experiment,
+                "platform": platform or "ambient", "rule": rule, "attack": attack,
+                "accuracy": None, "diverged": False, "error": "timeout"}
     accuracy, last_step = None, None
     try:
         for line in open(eval_file):
@@ -78,6 +82,7 @@ def run_cell(rule, attack, steps, batch, platform, timeout, experiment):
     row = {
         "metric": "robustness_accuracy",
         "experiment": experiment,
+        "platform": platform or "ambient",
         "rule": rule, "attack": attack,
         "n": 8, "f": 2, "real_byz": 0 if attack == "none" else 2,
         "steps": steps, "batch": batch,
@@ -115,10 +120,10 @@ def main():
         cells = []
         for attack in attacks:
             row = next(r for r in rows if r["rule"] == rule and r["attack"] == attack)
-            if row["diverged"]:
+            if row.get("diverged"):
                 cells.append("diverged (NaN abort)")
-            elif row["accuracy"] is None:
-                cells.append("error")
+            elif row.get("accuracy") is None:
+                cells.append(row.get("error", "error"))
             else:
                 cells.append("%.3f" % row["accuracy"])
         print("| %s | %s |" % (rule, " | ".join(cells)))

@@ -79,6 +79,9 @@ def _stages(py):
         ("leaf_resnet",
          b("benchmarks/train_configs.py", "--configs", "6",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 2400),
+        ("robustness",
+         b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
+           "--batch", "32", "--platform", "tpu", "--timeout", "600"), 5400),
     ]
 
 
