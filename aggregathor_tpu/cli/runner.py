@@ -582,24 +582,55 @@ def main(argv=None):
         signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
     }
 
+    def fold_metric_sums(sums, folded):
+        """Accumulate one batch's (total, count) metric sums."""
+        if sums is None:
+            return folded
+        return jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
+
+    def normalize_metric_sums(sums):
+        return {name: float(total) / max(float(count), 1.0) for name, (total, count) in sums.items()}
+
+    dense_metrics_fn = None
+    if eval_fn is None and nb_processes == 1 and hasattr(experiment, "sharded_to_dense_params"):
+        # Jitted once; the dense replica's params live on device between
+        # eval batches instead of re-uploading per batch.
+        dense_metrics_fn = jax.jit(experiment.metrics)
+
     def run_eval(step):
         if eval_fn is None:
-            # Sharded engine: metric sums would need a dense replica of the
-            # pipelined model; the held-out LOSS is the portable metric.
-            values = [
-                float(jax.device_get(eval_loss_fn(state, engine.shard_batch(batch))))
-                for batch in experiment.make_eval_iterator(n)
-            ]
+            # Sharded engine: the sharded loss is always reported; when the
+            # experiment can collapse its stage-stacked params to the dense
+            # layout (and this is a single process that can see every
+            # shard), a dense replica also reports the real metric dict
+            # (accuracy/nll — the reference's evaluation contract).
+            values, sums = [], None
+            dense_params = None
+            if dense_metrics_fn is not None:
+                dense_params = jax.device_put(
+                    experiment.sharded_to_dense_params(jax.device_get(state.params))
+                )
+            for batch in experiment.make_eval_iterator(n):
+                values.append(
+                    float(jax.device_get(eval_loss_fn(state, engine.shard_batch(batch))))
+                )
+                if dense_params is not None:
+                    flat = jax.tree_util.tree_map(
+                        lambda x: x.reshape((-1,) + x.shape[2:]), batch
+                    )  # fold the worker dim: the dense replica sees one big batch
+                    sums = fold_metric_sums(
+                        sums, jax.device_get(dense_metrics_fn(dense_params, flat))
+                    )
             metrics = {"loss": sum(values) / max(len(values), 1)}
+            if sums is not None:
+                metrics.update(normalize_metric_sums(sums))
         else:
             sums = None
             for batch in experiment.make_eval_iterator(n):
-                folded = jax.device_get(eval_fn(state, engine.shard_batch(batch)))
-                if sums is None:
-                    sums = folded
-                else:
-                    sums = jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
-            metrics = {name: float(total) / max(float(count), 1.0) for name, (total, count) in sums.items()}
+                sums = fold_metric_sums(
+                    sums, jax.device_get(eval_fn(state, engine.shard_batch(batch)))
+                )
+            metrics = normalize_metric_sums(sums)
         info("Evaluation at step %d: %s" % (step, "  ".join("%s=%.4f" % kv for kv in sorted(metrics.items()))))
         eval_file.append(step, metrics)
         return metrics

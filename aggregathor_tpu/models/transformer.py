@@ -70,6 +70,13 @@ class TransformerConfig:
 # --------------------------------------------------------------------------- #
 
 
+#: Leaves with NO leading (n_stages, layers/stage) stage dims — every other
+#: leaf is stage-stacked.  Shared by the dense forward, the pipeline loss,
+#: and the stage-collapse in ``sharded_to_dense_params`` so a new
+#: non-stacked leaf only needs declaring once.
+NON_STACKED_LEAVES = ("embed", "unembed", "final_norm")
+
+
 def init_params(cfg, key, n_stages=1):
     """Build the global parameter pytree; leaves lead with the stage dim."""
     if cfg.n_layers % n_stages != 0:
@@ -336,7 +343,7 @@ def forward_dense(params, tokens, cfg):
     uses under the data-parallel RobustEngine.
     """
     stage_params = {
-        k: v[0] for k, v in params.items() if k not in ("embed", "unembed", "final_norm")
+        k: v[0] for k, v in params.items() if k not in NON_STACKED_LEAVES
     }
     x = params["embed"][tokens]
     positions = jnp.arange(tokens.shape[1])
@@ -398,7 +405,7 @@ def make_pipeline_loss(cfg, n_stages, microbatches, aux_weight=1e-2):
         tgt_mb = jax.lax.dynamic_slice_in_dim(tgt_mb, midx * sb, sb, axis=2)
 
         stage_params = {
-            k: v[0] for k, v in params.items() if k not in ("embed", "unembed", "final_norm")
+            k: v[0] for k, v in params.items() if k not in NON_STACKED_LEAVES
         }
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         n_ticks = microbatches + p_size - 1
@@ -528,6 +535,20 @@ class TransformerExperiment(Experiment):
 
     def sharded_loss(self, n_stages, microbatches):
         return make_pipeline_loss(self.cfg, n_stages=n_stages, microbatches=microbatches)
+
+    def sharded_to_dense_params(self, params):
+        """Collapse the stage dim of a (host-resident) stage-stacked pytree:
+        (S, L/S, ...) -> (1, L, ...), the ``n_stages=1`` layout every dense
+        entry point (forward_dense, metrics) consumes.  Lets the sharded CLI
+        path report real eval metrics (accuracy/nll) on a dense replica
+        instead of loss only."""
+        out = {}
+        for name, leaf in params.items():
+            if name in NON_STACKED_LEAVES:
+                out[name] = leaf
+            else:
+                out[name] = leaf.reshape((1, leaf.shape[0] * leaf.shape[1]) + leaf.shape[2:])
+        return out
 
     def loss(self, params, batch):
         return loss_dense(params, batch, self.cfg)

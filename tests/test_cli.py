@@ -275,6 +275,9 @@ def test_runner_sharded_mesh_end_to_end(tmp_path):
     lines = [l.split("\t") for l in open(eval_file).read().strip().splitlines()]
     assert int(lines[-1][1]) == 5  # final fire at stop
     assert any("loss:" in field for field in lines[-1])
+    # dense-replica metrics on the sharded path (stage collapse)
+    assert any("accuracy:" in field for field in lines[-1])
+    assert any("nll:" in field for field in lines[-1])
     assert any(name.endswith("-5.ckpt") for name in os.listdir(ckpt_dir))
     sum_files = os.listdir(sum_dir)
     events = [json.loads(l) for l in open(os.path.join(sum_dir, sum_files[0]))]
@@ -354,3 +357,29 @@ def test_runner_session_secret_tags_checkpoints(tmp_path):
         fd.write(b"\xff\xff\xff")
     with pytest.raises(UserException, match="HMAC"):
         run(base + ["--max-step", "7"])
+
+
+def test_runner_sharded_mesh_full_composition(tmp_path):
+    """Every engine extension composes through the --mesh CLI path in one
+    run: worker momentum, bf16 wire exchange, lossy link (NaN infill),
+    reputation + quarantine, suspicion metrics."""
+    sum_dir = str(tmp_path / "sum")
+    assert 0 == run([
+        "--experiment", "transformer",
+        "--experiment-args", "d-model:16", "heads:2", "layers:2", "seq:16",
+        "batch-size:2", "vocab:32", "corpus:4096",
+        "--aggregator", "average-nan",
+        "--nb-workers", "2", "--nb-decl-byz-workers", "1", "--mesh", "2,2,2",
+        "--worker-momentum", "0.9", "--exchange-dtype", "bfloat16",
+        "--UDP", "1", "--UDP-args", "min-coords:0",
+        "--worker-metrics", "--reputation-decay", "0.9",
+        "--quarantine-threshold", "0.2",
+        "--max-step", "4",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--summary-dir", sum_dir, "--summary-delta", "2",
+    ])
+    [name] = os.listdir(sum_dir)
+    events = [json.loads(l) for l in open(os.path.join(sum_dir, name))]
+    assert all("total_loss" in ev for ev in events)
+    assert any("worker_reputation" in ev for ev in events)
+    assert any("nb_quarantined" in ev for ev in events)
