@@ -84,6 +84,14 @@ def build_parser():
              "honest set)",
     )
     parser.add_argument(
+        "--leaf-bucketing", default="auto", choices=["auto", "on", "off"],
+        help="granularity:leaf implementation: bucket same-shaped leaves "
+             "into one vmapped rule call per distinct size (the TPU-shaped "
+             "program) or loop per leaf (faster on XLA:CPU — measured, "
+             "BENCHMARKS.md row 6b). auto picks by backend; results are "
+             "bit-identical either way",
+    )
+    parser.add_argument(
         "--reputation-decay", type=float, default=None, metavar="BETA",
         help="track a per-worker reputation EMA (1 = trusted) of a rank "
              "signal: was the worker's raw gradient among the n-f closest "
@@ -360,6 +368,11 @@ def main(argv=None):
                 )
             if args.unroll > 1:
                 warning("--unroll > 1 is not supported with --mesh; running per-step")
+            if args.leaf_bucketing != "auto":
+                warning(
+                    "--leaf-bucketing applies to the flat engine's leaf path "
+                    "only; the sharded engine always aggregates per bucket"
+                )
             # ``vector`` (the flat default) means whole-vector selection,
             # which the sharded engine spells ``global`` (one global (n, n)
             # distance matrix accumulated across shards).
@@ -396,6 +409,7 @@ def main(argv=None):
                 reputation_decay=args.reputation_decay,
                 quarantine_threshold=args.quarantine_threshold,
                 granularity=args.granularity,
+                leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
             )
 
             # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)

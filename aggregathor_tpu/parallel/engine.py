@@ -117,7 +117,7 @@ class RobustEngine:
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
-                 granularity="vector"):
+                 granularity="vector", leaf_bucketing="auto"):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -155,6 +155,21 @@ class RobustEngine:
         if granularity not in ("vector", "leaf"):
             raise UserException("granularity must be vector or leaf (got %r)" % (granularity,))
         self.granularity = granularity
+        # Two bit-identical leaf implementations, dispatched by backend
+        # (measured, BENCHMARKS.md row 6b): stacking same-shaped leaves into
+        # one vmapped rule call per distinct size is the TPU-shaped program
+        # (O(#shapes) collectives/kernels instead of O(#leaves)), but on
+        # XLA:CPU the batched sorts/selects lower WORSE than the plain loop
+        # (ResNet-50: 157 vs 93 s/step on the 1-core host).  "auto" picks
+        # bucketed on TPU, unrolled elsewhere; True/False force it.
+        if leaf_bucketing != "auto":
+            if not isinstance(leaf_bucketing, bool):
+                # 1/0 would pass a tuple-membership check (bool-int equality)
+                # yet miss an `is True` dispatch — normalize strictly instead
+                raise UserException(
+                    "leaf_bucketing must be 'auto' or a bool (got %r)" % (leaf_bucketing,)
+                )
+        self.leaf_bucketing = leaf_bucketing
         # History-aware robustness (Karimireddy et al. 2021): with
         # worker_momentum = beta in (0, 1), every worker sends its momentum
         # m_i <- beta*m_i + (1-beta)*g_i instead of the raw gradient, so the
@@ -295,6 +310,16 @@ class RobustEngine:
         return agg, None, block, raw_block
 
     def _aggregate_per_leaf(self, gvecs, flatmap, key, reputation):
+        """granularity:leaf dispatch — bucketed on TPU, unrolled elsewhere
+        (bit-identical results; see ``leaf_bucketing`` in __init__)."""
+        bucketed = (
+            self.leaf_bucketing is True
+            or (self.leaf_bucketing == "auto" and jax.default_backend() == "tpu")
+        )
+        impl = self._aggregate_per_leaf_bucketed if bucketed else self._aggregate_per_leaf_unrolled
+        return impl(gvecs, flatmap, key, reputation)
+
+    def _aggregate_per_leaf_bucketed(self, gvecs, flatmap, key, reputation):
         """granularity:leaf — gather and reduce each leaf's (n, d_leaf) rows
         independently (per-layer selection), BUCKETED by leaf size.
 
@@ -396,10 +421,11 @@ class RobustEngine:
         return agg, participation, wdist, rep_dist
 
     def _aggregate_per_leaf_unrolled(self, gvecs, flatmap, key, reputation):
-        """Reference tier for the bucketed leaf path above: the plain
-        per-leaf Python loop (one all_gather + one rule call per leaf).
-        Semantically the definition of granularity:leaf; kept for the
-        equivalence test, not reachable from the CLI."""
+        """The plain per-leaf loop (one all_gather + one rule call per
+        leaf).  Semantically the definition of granularity:leaf — and the
+        DEFAULT path off-TPU (``leaf_bucketing="auto"``; measured faster
+        than the batched form on XLA:CPU, BENCHMARKS.md row 6b), CLI-
+        reachable via ``--leaf-bucketing off`` anywhere."""
         from ..gars import GAR_KEY_TAG
         from ..gars.common import pairwise_sq_distances
 

@@ -98,6 +98,18 @@ CONFIGS = {
                  "--granularity", "leaf",
                  "--experiment-args", "batch-size:8", "dtype:bfloat16"],
     },
+    "6u": {
+        "name": "resnet50_cifar10_leaf_krum_n8_f2_unrolled",
+        "note": "config 6 with --leaf-bucketing off: the per-leaf loop "
+                "(bit-identical results) — the bucketed-vs-unrolled A/B on "
+                "whatever backend runs it (BENCHMARKS.md row 6b has the CPU "
+                "side; on CPU the loop wins, the bucketed form is the "
+                "TPU-shaped program)",
+        "args": ["--experiment", "slim-resnet_v1_50-cifar10", "--aggregator", "krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+                 "--granularity", "leaf", "--leaf-bucketing", "off",
+                 "--experiment-args", "batch-size:8", "dtype:bfloat16"],
+    },
     "4": {
         "name": "inception_v3_median_little_n32_f8",
         "note": "BASELINE config 4: coordinate-median under a real 'little' "

@@ -77,8 +77,8 @@ def _stages(py):
          b("benchmarks/train_configs.py", "--configs", "3,3k,4",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 6000),
         ("leaf_resnet",
-         b("benchmarks/train_configs.py", "--configs", "6",
-           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 2400),
+         b("benchmarks/train_configs.py", "--configs", "6,6u",
+           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 4200),
         ("robustness",
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
            "--batch", "32", "--platform", "tpu", "--timeout", "600"), 5400),

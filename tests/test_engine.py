@@ -642,9 +642,8 @@ def test_leaf_bucketed_matches_unrolled():
             make_mesh(nb_workers=4), gars.instantiate("krum", 8, 2), 8,
             nb_real_byz=2, attack=atk, granularity="leaf", worker_metrics=True,
             reputation_decay=0.5, quarantine_threshold=0.4,
+            leaf_bucketing=(impl == "bucketed"),  # force both paths on CPU
         )
-        if impl == "unrolled":
-            eng._aggregate_per_leaf = eng._aggregate_per_leaf_unrolled
         tx = optax.sgd(0.05)
         state = eng.init_state(exp.init(jax.random.PRNGKey(7)), tx, seed=5)
         step = eng.build_step(exp.loss, tx)
