@@ -401,6 +401,11 @@ def main(argv=None):
                     "--granularity %s needs the sharded engine: pass --mesh W,PP,TP"
                     % args.granularity
                 )
+            if args.leaf_bucketing != "auto" and args.granularity != "leaf":
+                warning(
+                    "--leaf-bucketing only affects --granularity leaf; ignored "
+                    "for granularity %r" % args.granularity
+                )
             engine = RobustEngine(
                 mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
                 exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,

@@ -312,9 +312,10 @@ class RobustEngine:
     def _aggregate_per_leaf(self, gvecs, flatmap, key, reputation):
         """granularity:leaf dispatch — bucketed on TPU, unrolled elsewhere
         (bit-identical results; see ``leaf_bucketing`` in __init__)."""
+        on_tpu = self.mesh.devices.flat[0].platform == "tpu"  # where THIS mesh runs
         bucketed = (
             self.leaf_bucketing is True
-            or (self.leaf_bucketing == "auto" and jax.default_backend() == "tpu")
+            or (self.leaf_bucketing == "auto" and on_tpu)
         )
         impl = self._aggregate_per_leaf_bucketed if bucketed else self._aggregate_per_leaf_unrolled
         return impl(gvecs, flatmap, key, reputation)

@@ -81,7 +81,8 @@ def _stages(py):
            "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 4200),
         ("robustness",
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
-           "--batch", "32", "--platform", "tpu", "--timeout", "600"), 5400),
+           "--batch", "32", "--rules", "average,krum,median,dnc",
+           "--platform", "tpu", "--timeout", "600"), 8400),
     ]
 
 

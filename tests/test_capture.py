@@ -1,0 +1,58 @@
+"""Unit tests for the TPU up-window watcher's decision logic.
+
+The watcher (scripts/tpu_capture.py) guards a scarce resource — chip
+up-windows arrive hours apart — so the pure decision functions must be
+right BEFORE a window burns: which result rows count as TPU data (stage
+retirement), which probe outputs count as chip-up, and that a timed-out
+child's partial stdout is banked.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import tpu_capture  # noqa: E402
+
+
+def test_tpu_datum_classification():
+    cases = [
+        # bench.py rows
+        ({"metric": "x_cpu_fallback", "detail": {"platform": "cpu"}}, False),
+        ({"metric": "x", "detail": {"platform": "tpu"}}, True),
+        # train_configs / robustness rows
+        ({"platform": "tpu", "value": 1.0}, True),
+        ({"platform": "cpu", "value": 1.0}, False),
+        ({"platform": "ambient", "value": None, "error": "timed out"}, False),
+        ({"platform": "tpu", "value": None, "error": "timed out"}, False),
+        # gar_kernels rows (tier, no platform)
+        ({"tier": "jnp:tpu", "value": 3.2}, True),
+        ({"tier": "jnp:cpu", "value": 3.2}, False),
+        ({"tier": "pallas", "value": 3.2}, True),
+        ({"tier": "native", "value": 3.2}, False),
+        # pallas_tpu_check rows (script exits 2 off-TPU)
+        ({"metric": "pallas_tpu_check", "parity": "ok"}, True),
+        ({"metric": "pallas_tpu_check", "parity": "FAIL"}, False),
+        ({"metric": "pallas_tpu_check", "parity": "ERROR", "error": "VMEM"}, False),
+        # unknown shapes never retire a stage
+        ({"something": "else"}, False),
+    ]
+    for row, want in cases:
+        assert tpu_capture._tpu_datum(row) == want, row
+
+
+def test_run_guarded_timeout_banks_partial_stdout(tmp_path):
+    """A child killed by the watchdog still yields its flushed lines — the
+    incremental progress a short up-window banked."""
+    code = "import time, sys; print('{\"platform\": \"tpu\", \"value\": 1}', flush=True); time.sleep(60)"
+    rc, out, err = tpu_capture._run_guarded([sys.executable, "-c", code], timeout=3)
+    assert rc is None
+    assert '"platform": "tpu"' in out
+    assert "timeout" in err
+
+
+def test_run_guarded_success():
+    rc, out, err = tpu_capture._run_guarded(
+        [sys.executable, "-c", "print('hello')"], timeout=30
+    )
+    assert rc == 0 and "hello" in out
