@@ -26,6 +26,7 @@ import argparse
 import itertools
 import json
 import os
+import shlex
 import shutil
 import subprocess
 import sys
@@ -34,7 +35,7 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cell(rule, attack, steps, batch, platform, timeout, experiment):
+def run_cell(rule, attack, steps, batch, platform, timeout, experiment, extra_args=()):
     eval_dir = tempfile.mkdtemp(prefix="aggregathor_rob_")
     eval_file = os.path.join(eval_dir, "eval.tsv")
     cmd = [
@@ -49,6 +50,7 @@ def run_cell(rule, attack, steps, batch, platform, timeout, experiment):
     ]
     if attack != "none":
         cmd += ["--attack", attack, "--nb-real-byz-workers", "2"]
+    cmd += list(extra_args)
     env = dict(os.environ)
     if platform:
         cmd += ["--platform", platform]
@@ -103,14 +105,19 @@ def main():
     ap.add_argument("--experiment", default="cnnet")
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--timeout", type=int, default=3600, help="per-cell seconds")
+    ap.add_argument("--runner-args", default="",
+                    help="extra flags appended to every runner invocation, as "
+                         "ONE quoted string (argparse cannot nest leading "
+                         "dashes): --runner-args '--worker-momentum 0.9'")
     args = ap.parse_args()
+    args.runner_args = shlex.split(args.runner_args)
 
     rules = args.rules.split(",")
     attacks = args.attacks.split(",")
     rows = []
     for rule, attack in itertools.product(rules, attacks):
         row = run_cell(rule, attack, args.steps, args.batch, args.platform,
-                       args.timeout, args.experiment)
+                       args.timeout, args.experiment, extra_args=args.runner_args)
         rows.append(row)
         print(json.dumps(row), flush=True)
 
