@@ -50,11 +50,13 @@ def run_cell(rule, attack, steps, batch, platform, timeout, experiment, extra_ar
     ]
     if attack != "none":
         cmd += ["--attack", attack, "--nb-real-byz-workers", "2"]
-    cmd += list(extra_args)
     env = dict(os.environ)
     if platform:
         cmd += ["--platform", platform]
         env["JAX_PLATFORMS"] = platform
+    # LAST, so user-supplied flags win an argparse last-wins conflict with
+    # anything the harness appended (e.g. --platform)
+    cmd += list(extra_args)
     if platform == "cpu":
         env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     try:
