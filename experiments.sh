@@ -51,6 +51,37 @@ function run_abort {
 
 trap run_abort TERM INT
 
+# Like `run`, but through the fully-sharded engine:
+# run_sharded <experiment> <gar> <W> <PP> <TP> <f> <batch> <steps>
+# (per-layer robust aggregation on a worker x pipeline x tensor mesh)
+function run_sharded {
+	local NAME=E=${1}-R=${2}-MESH=${3}x${4}x${5}-F=${6}-B=${7}
+	python3 -m aggregathor_tpu.cli.runner \
+		--experiment "${1}" \
+		--aggregator "${2}" \
+		--nb-workers "${3}" \
+		--mesh "${3},${4},${5}" \
+		--granularity layer \
+		--nb-decl-byz-workers "${6}" \
+		--experiment-args "batch-size:${7}" \
+		--max-step "${8}" \
+		--stdout-to "${RESULTS_DIR}/${NAME}.stdout" \
+		--stderr-to "${RESULTS_DIR}/${NAME}.stderr" \
+		--evaluation-file "${RESULTS_DIR}/${NAME}.eval" \
+		--evaluation-period -1 --evaluation-delta 1000 \
+		--checkpoint-period 600 --checkpoint-delta -1 \
+		--checkpoint-dir "${RESULTS_DIR}/${NAME}.ckpt" \
+		--summary-period -1 --summary-delta 1000 \
+		${PLATFORM_ARGS} &
+	RUNNING_PID=$!
+	wait ${RUNNING_PID}
+}
+
 # Begin experiments (reference default: run mnist average 2 0 50 100000)
 run mnist average 2 0 50 10000
+# Extras this framework adds over the reference (uncomment to run):
+#   per-layer Krum on the dp x pp x tp transformer (BASELINE config 5):
+# run_sharded transformer krum 4 2 1 1 16 1000
+#   accuracy-under-attack sweep (docs/robustness.md):
+# python3 benchmarks/robustness.py --experiment mnist --steps 500 --batch 32
 # End experiments
