@@ -98,6 +98,17 @@ CONFIGS = {
                  "--granularity", "leaf",
                  "--experiment-args", "batch-size:8", "dtype:bfloat16"],
     },
+    "2t": {
+        "name": "cnnet_krum_n8_f2_traced",
+        "note": "config 2b sizing with a jax.profiler trace captured to "
+                "benchmarks/trace_r03 — an up-window leaves an analyzable "
+                "artifact behind for MFU cost attribution even without a "
+                "live chip afterwards",
+        "args": ["--experiment", "cnnet", "--aggregator", "krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+                 "--experiment-args", "batch-size:128", "dtype:bfloat16", "augment:device",
+                 "--trace", "--trace-dir", "benchmarks/trace_r03"],
+    },
     "6u": {
         "name": "resnet50_cifar10_leaf_krum_n8_f2_unrolled",
         "note": "config 6 with --leaf-bucketing off: the per-leaf loop "

@@ -79,6 +79,9 @@ def _stages(py):
         ("leaf_resnet",
          b("benchmarks/train_configs.py", "--configs", "6,6u",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 4200),
+        ("trace",
+         b("benchmarks/train_configs.py", "--configs", "2t",
+           "--steps", "40", "--platform", "tpu", "--timeout", "1500"), 1800),
         ("robustness",
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
            "--batch", "32", "--rules", "average,krum,median,dnc",
