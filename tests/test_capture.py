@@ -44,8 +44,11 @@ def test_tpu_datum_classification():
 def test_run_guarded_timeout_banks_partial_stdout(tmp_path):
     """A child killed by the watchdog still yields its flushed lines — the
     incremental progress a short up-window banked."""
-    code = "import time, sys; print('{\"platform\": \"tpu\", \"value\": 1}', flush=True); time.sleep(60)"
-    rc, out, err = tpu_capture._run_guarded([sys.executable, "-c", code], timeout=3)
+    # Interpreter startup alone can exceed a short watchdog on the loaded
+    # 1-core host — the timeout must be comfortably past startup while the
+    # sleep keeps the child alive until the kill.
+    code = "import time, sys; print('{\"platform\": \"tpu\", \"value\": 1}', flush=True); time.sleep(300)"
+    rc, out, err = tpu_capture._run_guarded([sys.executable, "-c", code], timeout=25)
     assert rc is None
     assert '"platform": "tpu"' in out
     assert "timeout" in err
