@@ -88,8 +88,9 @@ def build_parser():
         help="granularity:leaf implementation: bucket same-shaped leaves "
              "into one vmapped rule call per distinct size (the TPU-shaped "
              "program) or loop per leaf (faster on XLA:CPU — measured, "
-             "BENCHMARKS.md row 6b). auto picks by backend; results are "
-             "bit-identical either way",
+             "BENCHMARKS.md row 6b). auto picks by backend; the two paths "
+             "make identical selections (same per-leaf PRNG keys) and agree "
+             "numerically to float tolerance",
     )
     parser.add_argument(
         "--reputation-decay", type=float, default=None, metavar="BETA",
@@ -477,7 +478,9 @@ def main(argv=None):
         # gradients and would sign any persisted state).
         from ..parallel.auth import GradientAuthenticator
 
-        ckpt_auth = GradientAuthenticator(args.session_secret.encode(), 1)
+        # context=b"ckpt" keeps checkpoint-tag keys disjoint from the
+        # bring-up handshake's (same secret, separate key family)
+        ckpt_auth = GradientAuthenticator(args.session_secret.encode(), 1, context=b"ckpt")
     checkpoints = Checkpoints(
         args.checkpoint_dir,
         pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
@@ -686,7 +689,13 @@ def main(argv=None):
                 # flagging a masked worker instead of the most distant live
                 # one. Masked workers are already surfaced via
                 # nb_quarantined/participation — suspicion ranks the live set.
-                scalars["suspect_worker"] = int(np.argmax(np.where(np.isfinite(wd), wd, -np.inf)))
+                # With NO finite entry (every row masked) there is no live set
+                # to rank — argmax over all -inf would arbitrarily flag worker
+                # 0, so the field is omitted instead.
+                if np.any(np.isfinite(wd)):
+                    scalars["suspect_worker"] = int(
+                        np.argmax(np.where(np.isfinite(wd), wd, -np.inf))
+                    )
             if "worker_participation" in metrics:
                 scalars["worker_participation"] = np.asarray(
                     jax.device_get(metrics["worker_participation"])

@@ -86,7 +86,27 @@ def _synthetic_classification(name, shape, nb_classes, nb_train, nb_test, seed, 
     return ArrayDataset(x_train, y_train, x_test, y_test, nb_classes, synthetic=True)
 
 
-def _load_npz(path, shape, scale):
+def _head_size(requested, y_train, y_test, name):
+    """Class count for the model head: covers BOTH the requested class count
+    and every label actually observed (train AND test).  Sizing from the
+    train subset's max alone would let take_along_axis clamp out-of-range
+    labels into silently wrong nll/accuracy (ADVICE r3); one shared helper so
+    the decode path and the npz-cache path can never disagree about the head."""
+    # train-only caches / limit_test=0 yield empty splits: np.max over a
+    # zero-size array has no identity, so only non-empty splits vote
+    seen = max(
+        [int(np.max(y)) + 1 for y in (y_train, y_test) if np.size(y)] or [1]
+    )
+    if requested and seen < requested:
+        warning(
+            "%s labels only cover %d of the requested %d classes; keeping the "
+            "%d-way head (subset accuracy is not full-dataset accuracy)"
+            % (name, seen, requested, requested)
+        )
+    return max(int(requested or 0), seen)
+
+
+def _load_npz(path, shape, scale, nb_classes=None):
     import zipfile
 
     try:
@@ -100,10 +120,12 @@ def _load_npz(path, shape, scale):
         x = x.astype(np.float32) / scale
         return x.reshape((x.shape[0],) + shape)
     info("Loaded dataset from %s" % path)
+    y_train = data["y_train"].astype(np.int32).ravel()
+    y_test = data["y_test"].astype(np.int32).ravel()
     return ArrayDataset(
-        prep(data["x_train"]), data["y_train"].astype(np.int32).ravel(),
-        prep(data["x_test"]), data["y_test"].astype(np.int32).ravel(),
-        nb_classes=int(data["y_train"].max()) + 1, synthetic=False,
+        prep(data["x_train"]), y_train, prep(data["x_test"]), y_test,
+        nb_classes=_head_size(nb_classes, y_train, y_test, os.path.basename(path)),
+        synthetic=False,
     )
 
 
@@ -111,7 +133,7 @@ def load_mnist():
     """28x28x1 digits in [0, 1]; real file or synthetic stand-in."""
     path = _find_npz("mnist.npz")
     if path:
-        return _load_npz(path, (28, 28, 1), 255.0)
+        return _load_npz(path, (28, 28, 1), 255.0, nb_classes=10)
     return _synthetic_classification("mnist", (28, 28, 1), 10, nb_train=8192, nb_test=2048, seed=7)
 
 
@@ -133,7 +155,7 @@ def load_cifar10():
     TFRecord shards — experiments/cnnet.py:115-146) or synthetic stand-in."""
     path = _find_npz("cifar10.npz")
     if path:
-        return _load_npz(path, (32, 32, 3), 255.0)
+        return _load_npz(path, (32, 32, 3), 255.0, nb_classes=10)
     tfr_dir = _find_cifar10_tfrecords()
     if tfr_dir:
         from .tfrecord import read_cifar10_split
@@ -153,7 +175,10 @@ def load_cifar10():
         return ArrayDataset(
             x_train.astype(np.float32) / 255.0, y_train,
             x_test.astype(np.float32) / 255.0, y_test,
-            nb_classes=int(y_train.max()) + 1, synthetic=False,
+            # CIFAR-10 is 10 classes by definition; _head_size guards against
+            # a truncated shard set whose subset misses the top labels
+            nb_classes=_head_size(10, y_train, y_test, "CIFAR-10"),
+            synthetic=False,
         )
     return _synthetic_classification("cifar10", (32, 32, 3), 10, nb_train=8192, nb_test=2048, seed=11)
 
@@ -201,7 +226,7 @@ def load_imagenet(image_size=224, nb_classes=1000, limit_train=4096, limit_test=
     cache_name = "imagenet%d-t%d-v%d.npz" % (image_size, limit_train, limit_test)
     path = _find_npz(cache_name, subdirs=("imagenet",))
     if path:
-        return _load_npz(path, (image_size, image_size, 3), 255.0)
+        return _load_npz(path, (image_size, image_size, 3), 255.0, nb_classes=nb_classes)
     tfr_dir = _find_imagenet_tfrecords()
     if tfr_dir:
         from .tfrecord import read_imagenet_split
@@ -220,13 +245,16 @@ def load_imagenet(image_size=224, nb_classes=1000, limit_train=4096, limit_test=
             info("Cached npz at %s" % cache)
         except OSError:
             pass  # read-only data dir: pay the decode each run
+        # slim ImageNet labels are 1-based with 0 = background (1001 classes
+        # for the full set; the reference's --labels-offset knob exists for
+        # models that drop background).  The capped subset may not contain
+        # the top label ids — _head_size covers both the requested count and
+        # every observed label (train AND validation).
         return ArrayDataset(
             x_train.astype(np.float32) / 255.0, y_train,
             x_test.astype(np.float32) / 255.0, y_test,
-            # slim ImageNet labels are 1-based with 0 = background, so the
-            # class count is max+1 (1001 for the full set; the reference's
-            # --labels-offset knob exists for models that drop background)
-            nb_classes=int(y_train.max()) + 1, synthetic=False,
+            nb_classes=_head_size(nb_classes, y_train, y_test, "ImageNet subset"),
+            synthetic=False,
         )
     return load_imagenet_standin(image_size, nb_classes)
 

@@ -31,16 +31,23 @@ import re
 import flax.serialization
 import jax
 
-from ..utils import UserException, info
+from ..utils import UserException, info, warning
 
 
 class Checkpoints:
     def __init__(self, directory, base_name="model", max_to_keep=5, authenticator=None,
-                 background=False):
+                 background=False, allow_legacy_tags=True):
         self.directory = directory
         self.base_name = base_name
         self.max_to_keep = int(max_to_keep)
         self.authenticator = authenticator
+        # One-time migration for snapshots tagged before key derivation
+        # gained domain separation: when True, a tag minted under the OLD
+        # scheme (same secret) is accepted at restore and the snapshot is
+        # immediately re-tagged under the current scheme. Operators whose
+        # snapshots are all current-scheme can set False to close the
+        # downgrade path entirely.
+        self.allow_legacy_tags = bool(allow_legacy_tags)
         self._pattern = re.compile(re.escape(base_name) + r"-(\d+)\.ckpt$")
         self._pool = None
         self._pending = []
@@ -100,10 +107,36 @@ class Checkpoints:
                     % (self._path(step),)
                 )
             if not self.authenticator.verify(0, step, data, tag):
-                raise UserException(
-                    "Checkpoint %r failed HMAC verification (corrupted or forged)"
-                    % (self._path(step),)
-                )
+                # In-band migration for snapshots tagged before the key
+                # derivation gained domain separation: accept the OLD scheme
+                # under the SAME secret (still proves knowledge of the
+                # secret), warn, and RE-TAG IMMEDIATELY so the downgrade
+                # window closes for this snapshot right now — without this an
+                # operator would loop between this error and the missing-tag
+                # one with no way to re-trust an old snapshot.
+                legacy_ok = getattr(self.authenticator, "verify_legacy", None)
+                if (
+                    self.allow_legacy_tags
+                    and legacy_ok is not None
+                    and legacy_ok(0, step, data, tag)
+                ):
+                    fresh = self.authenticator.sign(0, step, data)
+                    tag_tmp = tag_path + ".tmp"
+                    with open(tag_tmp, "wb") as fd:
+                        fd.write(fresh)
+                    os.replace(tag_tmp, tag_path)
+                    warning(
+                        "Checkpoint %r was tagged under the legacy key scheme "
+                        "(pre-context-separation); accepted under the same "
+                        "session secret and re-tagged under the current scheme"
+                        % (self._path(step),)
+                    )
+                else:
+                    raise UserException(
+                        "Checkpoint %r failed HMAC verification: corrupted, "
+                        "forged, or a --session-secret mismatch; treat the "
+                        "snapshot as untrusted" % (self._path(step),)
+                    )
         state = flax.serialization.from_bytes(template_state, data)
         info("Restored checkpoint at step %d from %r" % (step, self.directory))
         return state, step

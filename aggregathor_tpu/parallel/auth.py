@@ -40,9 +40,30 @@ def _native_ok():
         return False
 
 
-def derive_worker_key(session_secret, worker_index):
-    """Per-worker key = SHA-256(secret || worker_index), like the reference
-    derives per-worker identities from deploy-time provisioning."""
+def derive_worker_key(session_secret, worker_index, context=b"gradient"):
+    """Per-worker key = SHA-256(secret || context || worker_index), like the
+    reference derives per-worker identities from deploy-time provisioning.
+
+    ``context`` domain-separates uses of the one session secret: without it
+    the checkpoint-tag key (worker 0) would equal process 0's bring-up
+    handshake key, and a 32-byte checkpoint body at a matching step could
+    cross-verify between the two protocols."""
+    material = (
+        bytes(session_secret)
+        + struct.pack("<q", len(context)) + bytes(context)
+        + struct.pack("<q", int(worker_index))
+    )
+    if _native_ok():
+        return native.sha256(material)
+    return hashlib.sha256(material).digest()
+
+
+def derive_worker_key_legacy(session_secret, worker_index):
+    """The pre-context-separation derivation (secret || index, no context).
+
+    Kept ONLY so snapshots tagged before the domain-separation fix can be
+    verified once at restore and re-tagged under the current scheme on the
+    next save — never used for signing new material."""
     material = bytes(session_secret) + struct.pack("<q", int(worker_index))
     if _native_ok():
         return native.sha256(material)
@@ -58,11 +79,21 @@ def _message(worker_index, step, payload):
 
 
 class GradientAuthenticator:
-    """Signs / verifies per-worker byte payloads with per-worker HMAC keys."""
+    """Signs / verifies per-worker byte payloads with per-worker HMAC keys.
 
-    def __init__(self, session_secret, nb_workers):
+    ``context`` names the protocol this instance serves (``b"gradient"``,
+    ``b"ckpt"``, ``b"handshake"``, ...); instances with different contexts
+    derive disjoint key families from the same session secret, so a tag
+    minted under one protocol can never verify under another."""
+
+    def __init__(self, session_secret, nb_workers, context=b"gradient"):
         self.nb_workers = int(nb_workers)
-        self.keys = [derive_worker_key(session_secret, w) for w in range(self.nb_workers)]
+        self.keys = [
+            derive_worker_key(session_secret, w, context=context)
+            for w in range(self.nb_workers)
+        ]
+        # kept only for verify_legacy's one-time migration path
+        self._secret = bytes(session_secret)
 
     def sign(self, worker_index, step, payload):
         """32-byte tag for ``payload`` (bytes) from ``worker_index`` at ``step``."""
@@ -83,6 +114,21 @@ class GradientAuthenticator:
         if _native_ok():
             return native.hmac_verify(self.keys[worker_index], msg, tag)
         expect = _py_hmac.new(self.keys[worker_index], msg, hashlib.sha256).digest()
+        return _py_hmac.compare_digest(expect, bytes(tag))
+
+    def verify_legacy(self, worker_index, step, payload, tag):
+        """Verify under the pre-context-separation key derivation.
+
+        Migration path only: lets a restore accept a snapshot tagged by the
+        old scheme exactly once (the caller should warn, and the next save
+        re-tags under the current keys). Never used to MINT tags."""
+        if not 0 <= int(worker_index) < self.nb_workers:
+            return False
+        key = derive_worker_key_legacy(self._secret, worker_index)
+        msg = _message(worker_index, step, payload)
+        if _native_ok():
+            return native.hmac_verify(key, msg, tag)
+        expect = _py_hmac.new(key, msg, hashlib.sha256).digest()
         return _py_hmac.compare_digest(expect, bytes(tag))
 
 
@@ -127,7 +173,7 @@ def authenticate_processes(session_secret, params, step=0, verify_equal=True):
     from ..utils import UserException
 
     nb, pid = jax.process_count(), jax.process_index()
-    auth = GradientAuthenticator(session_secret, nb)
+    auth = GradientAuthenticator(session_secret, nb, context=b"handshake")
     digest = state_digest(params)
     tag = auth.sign(pid, step, digest)
     mine = np.frombuffer(digest + tag, np.uint8)

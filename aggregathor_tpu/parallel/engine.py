@@ -155,7 +155,9 @@ class RobustEngine:
         if granularity not in ("vector", "leaf"):
             raise UserException("granularity must be vector or leaf (got %r)" % (granularity,))
         self.granularity = granularity
-        # Two bit-identical leaf implementations, dispatched by backend
+        # Two numerically-equivalent leaf implementations (identical
+        # selections and PRNG keys; values agree to float tolerance —
+        # vmapped reductions need not lower bit-exactly), dispatched by backend
         # (measured, BENCHMARKS.md row 6b): stacking same-shaped leaves into
         # one vmapped rule call per distinct size is the TPU-shaped program
         # (O(#shapes) collectives/kernels instead of O(#leaves)), but on
@@ -311,7 +313,7 @@ class RobustEngine:
 
     def _aggregate_per_leaf(self, gvecs, flatmap, key, reputation):
         """granularity:leaf dispatch — bucketed on TPU, unrolled elsewhere
-        (bit-identical results; see ``leaf_bucketing`` in __init__)."""
+        (numerically equivalent; see ``leaf_bucketing`` in __init__)."""
         on_tpu = self.mesh.devices.flat[0].platform == "tpu"  # where THIS mesh runs
         bucketed = (
             self.leaf_bucketing is True
@@ -331,8 +333,10 @@ class RobustEngine:
         O(#leaves) (the compile-time/step-latency blowup VERDICT r2 flagged;
         same stacking trick as the sharded engine's layer axis,
         sharded_engine.py).  Per-leaf PRNG keys reproduce the unrolled
-        path's exactly (fold_in by ORIGINAL leaf index), so the result is
-        bit-identical to ``_aggregate_per_leaf_unrolled`` — asserted by
+        path's exactly (fold_in by ORIGINAL leaf index), so the two paths
+        make the same selections and agree with
+        ``_aggregate_per_leaf_unrolled`` to float tolerance (vmapped
+        reductions are not guaranteed to lower bit-exactly) — asserted by
         tests/test_engine.py.
 
         Returns ``(agg, participation, wdist, rep_dist)``: the concatenated

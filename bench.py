@@ -154,13 +154,22 @@ def run_bench(force_cpu=False, emit=lambda result: None):
         if not force_cpu:  # feeds the MFU fields, which only TPU rows report
             try:
                 single = engine.build_step(experiment.loss, tx).lower(state, resident_batch)
+                per_device = False
                 try:
                     cost = single.cost_analysis()
                 except Exception:
+                    # The compiled executable's analysis is post-SPMD-
+                    # partitioning, i.e. PER-DEVICE flops (hence the
+                    # list-of-per-device-dicts unwrap below) — scale back to
+                    # whole-program scope so both sources mean the same thing
+                    # against the mesh-scaled peak.
                     cost = single.compile().cost_analysis()
+                    per_device = True
                 if isinstance(cost, (list, tuple)):
                     cost = cost[0]
                 flops_per_step = float(cost["flops"])
+                if per_device:
+                    flops_per_step *= nb_devices
             except Exception:
                 pass  # cost model unavailable: MFU omitted, throughput stands
 
@@ -217,7 +226,11 @@ def run_bench(force_cpu=False, emit=lambda result: None):
             # field name says exactly which bar it is measured against
             # (197 bf16 TFLOP/s on v5e, BENCHMARKS.md §1); the apples-to-
             # apples MFU lands on the bfloat16 row below.
-            peak = 1.97e14
+            # flops_per_step counts the WHOLE SPMD program, so the peak
+            # must scale with the mesh: nb_devices chips have nb_devices x
+            # the FLOP/s budget (on this box nb_devices is 1, but the row
+            # stays honest if a pod ever runs it).
+            peak = 1.97e14 * nb_devices
             result["detail"]["mfu_pct_of_bf16_peak_fresh"] = round(
                 100.0 * f32["flops_per_step"] * fresh_steps_per_s / peak, 2
             )
@@ -256,11 +269,12 @@ def run_bench(force_cpu=False, emit=lambda result: None):
             }
             if bf16["flops_per_step"] and devices[0].platform == "tpu":
                 # bf16 math against the bf16 peak: the real MFU figure.
+                peak = 1.97e14 * nb_devices  # whole-program FLOPs vs whole-mesh peak
                 row["mfu_pct_fresh"] = round(
-                    100.0 * bf16["flops_per_step"] * bf16["fresh"] / 1.97e14, 2
+                    100.0 * bf16["flops_per_step"] * bf16["fresh"] / peak, 2
                 )
                 row["mfu_pct_resident"] = round(
-                    100.0 * bf16["flops_per_step"] * bf16["resident"] / 1.97e14, 2
+                    100.0 * bf16["flops_per_step"] * bf16["resident"] / peak, 2
                 )
             result["detail"]["bfloat16"] = row
             emit(result)

@@ -112,7 +112,7 @@ CONFIGS = {
     "6u": {
         "name": "resnet50_cifar10_leaf_krum_n8_f2_unrolled",
         "note": "config 6 with --leaf-bucketing off: the per-leaf loop "
-                "(bit-identical results) — the bucketed-vs-unrolled A/B on "
+                "(numerically equivalent results) — the bucketed-vs-unrolled A/B on "
                 "whatever backend runs it (BENCHMARKS.md row 6b has the CPU "
                 "side; on CPU the loop wins, the bucketed form is the "
                 "TPU-shaped program)",

@@ -67,6 +67,24 @@ def test_authenticator_binds_worker_and_step(backend):
     assert derive_worker_key(b"s", 0) == derive_worker_key(b"s", 0)
 
 
+def test_context_domain_separation(backend):
+    """One session secret, disjoint key families per protocol: a checkpoint
+    tag must never cross-verify as a bring-up handshake tag (ADVICE r3)."""
+    assert derive_worker_key(b"s", 0, context=b"ckpt") != derive_worker_key(
+        b"s", 0, context=b"handshake"
+    )
+    # length-prefixed context: (b"ab", idx) must not collide with (b"a", ...)
+    assert derive_worker_key(b"s", 0, context=b"ab") != derive_worker_key(
+        b"s", 0, context=b"a"
+    )
+    ckpt = GradientAuthenticator(b"secret", 1, context=b"ckpt")
+    handshake = GradientAuthenticator(b"secret", 1, context=b"handshake")
+    payload = bytes(32)  # a 32-byte body, the shape both protocols sign
+    tag = ckpt.sign(0, 5, payload)
+    assert ckpt.verify(0, 5, payload, tag)
+    assert not handshake.verify(0, 5, payload, tag)
+
+
 def test_backends_interoperate(monkeypatch):
     """Tags produced by one backend verify under the other (same algorithm)."""
     if not native.available():
@@ -109,3 +127,65 @@ def test_checkpoint_authentication(tmp_path):
     # Unauthenticated manager still reads it (opt-in feature)
     plain = Checkpoints(str(tmp_path))
     plain.restore(S(step=jnp.int32(0), value=jnp.zeros(4)))
+
+
+def test_checkpoint_legacy_tag_migration(tmp_path, backend):
+    """A snapshot tagged under the pre-context-separation scheme restores
+    under the SAME secret (with a warning) and the next save re-tags it under
+    the current scheme — the in-band migration path."""
+    import hashlib as _hl
+    import hmac as _hm
+    import struct as _st
+
+    import flax.struct
+    import jax.numpy as jnp
+
+    from aggregathor_tpu.obs import Checkpoints
+    from aggregathor_tpu.utils import UserException
+
+    @flax.struct.dataclass
+    class S:
+        step: object
+        value: object
+
+    secret = b"secret"
+    auth = GradientAuthenticator(secret, 1, context=b"ckpt")
+    ckpt = Checkpoints(str(tmp_path), authenticator=auth)
+    state = S(step=jnp.int32(5), value=jnp.arange(4.0))
+    path = ckpt.save(state)
+
+    # Rewrite the tag as the OLD derivation would have minted it:
+    # key = SHA-256(secret || index), msg = (index, step) || payload
+    with open(path, "rb") as fd:
+        body = fd.read()
+    legacy_key = _hl.sha256(secret + _st.pack("<q", 0)).digest()
+    legacy_tag = _hm.new(
+        legacy_key, _st.pack("<qq", 0, 5) + body, _hl.sha256
+    ).digest()
+    assert legacy_tag != auth.sign(0, 5, body)  # schemes genuinely differ
+    with open(path + ".tag", "wb") as fd:
+        fd.write(legacy_tag)
+
+    restored, step = ckpt.restore(S(step=jnp.int32(0), value=jnp.zeros(4)))
+    assert step == 5 and np.allclose(np.asarray(restored.value), np.arange(4.0))
+    # the downgrade window closed IMMEDIATELY: the snapshot was re-tagged
+    # under the current scheme during that restore
+    with open(path + ".tag", "rb") as fd:
+        assert auth.verify(0, 5, body, fd.read())
+
+    # a DIFFERENT secret's legacy tag must still be rejected
+    wrong = _hm.new(
+        _hl.sha256(b"other" + _st.pack("<q", 0)).digest(),
+        _st.pack("<qq", 0, 5) + body, _hl.sha256,
+    ).digest()
+    with open(path + ".tag", "wb") as fd:
+        fd.write(wrong)
+    with pytest.raises(UserException):
+        ckpt.restore(S(step=jnp.int32(0), value=jnp.zeros(4)))
+
+    # operators can close the downgrade path entirely
+    with open(path + ".tag", "wb") as fd:
+        fd.write(legacy_tag)
+    strict = Checkpoints(str(tmp_path), authenticator=auth, allow_legacy_tags=False)
+    with pytest.raises(UserException):
+        strict.restore(S(step=jnp.int32(0), value=jnp.zeros(4)))

@@ -206,12 +206,17 @@ def test_load_imagenet_real_path(tmp_path, monkeypatch):
     tfrecord.write_imagenet_split(str(data_dir), "validation", images[:6], labels[:6])
     monkeypatch.setenv("AGGREGATHOR_DATA", str(tmp_path))
 
-    ds = datasets.load_imagenet(image_size=24, limit_train=8, limit_test=4)
+    ds = datasets.load_imagenet(image_size=24, nb_classes=4, limit_train=8, limit_test=4)
     assert not ds.synthetic
     assert ds.x_train.shape == (8, 24, 24, 3)  # capped subset
     assert ds.x_test.shape == (4, 24, 24, 3)
     assert ds.x_train.dtype == np.float32 and float(ds.x_train.max()) <= 1.0
-    assert ds.nb_classes == int(labels[:8].max()) + 1
+    # head covers BOTH the requested class count and every observed label
+    # (ADVICE r3: a head sized from the capped subset alone could be smaller
+    # than the label space, silently clamping validation labels)
+    assert ds.nb_classes == max(
+        4, int(labels[:8].max()) + 1, int(labels[:6][:4].max()) + 1
+    )
     # cache key carries the caps (a tiny smoke cache must not satisfy a
     # larger request)
     assert os.path.isfile(str(data_dir / "imagenet24-t8-v4.npz"))
@@ -221,8 +226,26 @@ def test_load_imagenet_real_path(tmp_path, monkeypatch):
     for name in os.listdir(str(data_dir)):
         if not name.endswith(".npz"):
             os.unlink(str(data_dir / name))
-    cached = datasets.load_imagenet(image_size=24, limit_train=8, limit_test=4)
+    cached = datasets.load_imagenet(image_size=24, nb_classes=4, limit_train=8, limit_test=4)
     assert not cached.synthetic
     np.testing.assert_allclose(cached.x_train, ds.x_train, atol=1e-6)
+    # the cache path must size the head exactly like the decode path did —
+    # a smaller cached head would shape-mismatch checkpoints and clamp labels
+    assert cached.nb_classes == ds.nb_classes
     # a DIFFERENT cap misses the cache and (shards gone) falls back loudly
     assert datasets.load_imagenet(image_size=24, limit_train=6, limit_test=4).synthetic
+
+
+def test_head_size_empty_split():
+    """_head_size must survive an empty split (train-only cache, limit_test=0)
+    instead of crashing on np.max over a zero-size array."""
+    import numpy as np
+
+    from aggregathor_tpu.models.datasets import _head_size
+
+    y = np.array([0, 2, 1], np.int32)
+    empty = np.array([], np.int32)
+    assert _head_size(4, y, empty, "t") == 4
+    assert _head_size(0, y, empty, "t") == 3
+    assert _head_size(None, empty, empty, "t") == 1
+    assert _head_size(7, empty, y, "t") == 7
