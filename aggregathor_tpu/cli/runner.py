@@ -361,14 +361,6 @@ def main(argv=None):
                         name for name in models.itemize()
                         if getattr(models.get(name), "supports_sharded", False)) or "none")
                 )
-            if args.l1_regularize or args.l2_regularize:
-                raise UserException(
-                    "--l1/--l2-regularize are not supported with --mesh: the "
-                    "sharded loss is a LOCAL PARTIAL under shard_map and a "
-                    "parameter-norm term would be double-counted per shard"
-                )
-            if args.unroll > 1:
-                warning("--unroll > 1 is not supported with --mesh; running per-step")
             if args.leaf_bucketing != "auto":
                 warning(
                     "--leaf-bucketing applies to the flat engine's leaf path "
@@ -385,6 +377,11 @@ def main(argv=None):
                 worker_metrics=args.worker_metrics,
                 reputation_decay=args.reputation_decay,
                 quarantine_threshold=args.quarantine_threshold,
+                # The sharded loss is a LOCAL PARTIAL under shard_map, so
+                # the engine applies l1/l2 analytically on the completed
+                # gradients instead of wrapping the loss (see sharded_engine)
+                l1_regularize=args.l1_regularize,
+                l2_regularize=args.l2_regularize,
             )
             loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
             state = engine.init_state(
@@ -392,8 +389,10 @@ def main(argv=None):
                 tx, seed=args.seed,
             )
             step_fn = engine.build_step(loss_fn, tx, state)
-            unroll = 1
-            multi_fn = None
+            unroll = max(1, args.unroll)
+            multi_fn = (
+                engine.build_multi_step(loss_fn, tx, state) if unroll > 1 else None
+            )
             eval_fn = None  # metric sums need a dense replica; eval reports loss
             eval_loss_fn = engine.build_eval(loss_fn, state)
         else:

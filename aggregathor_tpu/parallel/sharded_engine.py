@@ -78,7 +78,8 @@ class ShardedRobustEngine:
 
     def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
                  exchange_dtype=None, worker_momentum=None, worker_metrics=False,
-                 reputation_decay=None, quarantine_threshold=0.0):
+                 reputation_decay=None, quarantine_threshold=0.0,
+                 l1_regularize=None, l2_regularize=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
@@ -137,6 +138,17 @@ class ShardedRobustEngine:
             raise UserException("More real Byzantine workers than workers")
         if attack is not None and self.nb_real_byz == 0:
             raise UserException("An attack needs nb_real_byz > 0 to have anyone to run it")
+        # l1/l2 regularization (reference: graph.py:125-139).  The flat
+        # engine wraps the per-worker loss; under shard_map the loss is a
+        # LOCAL PARTIAL, so a parameter-norm term in the loss would be
+        # counted once per replicating device.  The reg term is separable
+        # from the data term, so the engine instead applies its gradient
+        # ANALYTICALLY (l1*sign(p) + 2*l2*p, elementwise on each shard) to
+        # the psum-completed gradients — exact, shard-local, no double
+        # counting — and adds the correctly replication-scaled norm to the
+        # reported loss.
+        self.l1_regularize = float(l1_regularize) if l1_regularize else None
+        self.l2_regularize = float(l2_regularize) if l2_regularize else None
 
     # ------------------------------------------------------------------ #
 
@@ -191,6 +203,10 @@ class ShardedRobustEngine:
     def shard_batch(self, batch):
         """Device_put a worker-major batch pytree (leading dim = nb_workers)."""
         return jax.device_put(batch, NamedSharding(self.mesh, P(worker_axis)))
+
+    def shard_batches(self, batches):
+        """Device_put a (K, nb_workers, ...) chunk for ``build_multi_step``."""
+        return jax.device_put(batches, NamedSharding(self.mesh, P(None, worker_axis)))
 
     def put_state(self, state):
         """Re-shard a (possibly host-resident) state onto this mesh with the
@@ -262,22 +278,9 @@ class ShardedRobustEngine:
 
     # ------------------------------------------------------------------ #
 
-    def build_step(self, loss_fn, tx, state):
-        """Build the jitted sharded robust training step.
-
-        Args:
-          loss_fn: (params_local, worker_batch) -> scalar *local partial*
-            loss, written for shard_map (collectives over pipe/model
-            allowed); the sum over the worker group's devices must equal the
-            worker's batch loss (see models/transformer.make_pipeline_loss —
-            in-loss final psums would corrupt the gradients).
-          tx:      optax GradientTransformation.
-          state:   the TrainState from ``init_state`` (used for its layout).
-        Returns:
-          step(state, batch) -> (state, metrics); ``batch`` leaves lead with
-          the worker dim.
-        """
-        state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
+    def _make_body(self, loss_fn, tx, state_specs):
+        """The single-step shard_map body, shared by ``build_step`` and
+        ``build_multi_step`` (the scan over it)."""
         param_specs = state_specs.params
         gar = self.gar
 
@@ -295,6 +298,27 @@ class ShardedRobustEngine:
                 jax.lax.psum(g, _replication_axes(s)) if _replication_axes(s) else g
                 for g, s in zip(g_leaves, s_leaves)
             ]
+            # (2a) l1/l2 regularization, analytically on the completed grads
+            # (see __init__): part of every worker's HONEST gradient, so it
+            # lands before momentum and before the Byzantine perturbation —
+            # the flat engine's in-loss placement, same math.
+            l1, l2 = self.l1_regularize, self.l2_regularize
+            if l1 or l2:
+                p_leaves = jax.tree_util.tree_leaves(state.params)
+                reg = jnp.float32(0.0)
+                for i, (p, s) in enumerate(zip(p_leaves, s_leaves)):
+                    p32 = p.astype(jnp.float32)
+                    delta = jnp.zeros_like(p32)
+                    if l1:
+                        delta = delta + l1 * jnp.sign(p32)
+                        reg = reg + l1 * jnp.sum(jnp.abs(p32)) * self._replication_scale(s)
+                    if l2:
+                        delta = delta + 2.0 * l2 * p32
+                        reg = reg + l2 * jnp.sum(p32 * p32) * self._replication_scale(s)
+                    g_leaves[i] = g_leaves[i] + delta.astype(g_leaves[i].dtype)
+                # scaled per-leaf partials psum exactly like the data loss:
+                # the in-group psum in `metrics` then counts the norm once
+                loss = loss + reg
             # (2b) honest worker momentum (pre-attack, like the flat engine):
             # send bias-corrected momenta, carry the uncorrected buffer
             new_momentum, new_momentum_steps = state.momentum, state.momentum_steps
@@ -479,10 +503,68 @@ class ShardedRobustEngine:
                         )
             return new_state, metrics
 
+        return body
+
+    def build_step(self, loss_fn, tx, state):
+        """Build the jitted sharded robust training step.
+
+        Args:
+          loss_fn: (params_local, worker_batch) -> scalar *local partial*
+            loss, written for shard_map (collectives over pipe/model
+            allowed); the sum over the worker group's devices must equal the
+            worker's batch loss (see models/transformer.make_pipeline_loss —
+            in-loss final psums would corrupt the gradients).
+          tx:      optax GradientTransformation.
+          state:   the TrainState from ``init_state`` (used for its layout).
+        Returns:
+          step(state, batch) -> (state, metrics); ``batch`` leaves lead with
+          the worker dim.
+        """
+        state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        body = self._make_body(loss_fn, tx, state_specs)
         sharded = jax.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(state_specs, P(worker_axis)),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def build_multi_step(self, loss_fn, tx, state, repeat_steps=None):
+        """K-step trainer in one dispatch: ``lax.scan`` over the step body,
+        mirroring the flat engine's ``build_multi_step`` (which removes the
+        per-step host dispatch the reference pays as a PS round-trip per
+        ``sess.run``, runner.py:562-576).
+
+        Two forms, like the flat engine:
+        - ``repeat_steps=None``: ``multi(state, batches)`` with every batch
+          leaf leading (K, nb_workers, ...) — K distinct batches.
+        - ``repeat_steps=K``: ``multi(state, batch)`` reuses one resident
+          worker-major batch for K steps (throughput benches).
+        Metrics come back per step (leading K)."""
+        state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        body = self._make_body(loss_fn, tx, state_specs)
+
+        if repeat_steps is None:
+
+            def many(state, batches):
+                return jax.lax.scan(body, state, batches)
+
+            batch_spec = P(None, worker_axis)
+        else:
+
+            def many(state, batch):
+                return jax.lax.scan(
+                    lambda s, _: body(s, batch), state, None, length=int(repeat_steps)
+                )
+
+            batch_spec = P(worker_axis)
+
+        sharded = jax.shard_map(
+            many,
+            mesh=self.mesh,
+            in_specs=(state_specs, batch_spec),
             out_specs=(state_specs, P()),
             check_vma=False,
         )

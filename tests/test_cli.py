@@ -289,19 +289,37 @@ def test_runner_sharded_mesh_end_to_end(tmp_path):
 
 
 def test_runner_sharded_mesh_rejections():
-    """--mesh surface validation: W != n, unsupported experiment, l1/l2."""
+    """--mesh surface validation: W != n, unsupported experiment."""
     base = ["--aggregator", "median", "--nb-workers", "2"]
     with pytest.raises(UserException):
         run(["--experiment", "transformer", "--mesh", "4,2,1"] + base + ["--max-step", "1"])
     with pytest.raises(UserException):
         run(["--experiment", "mnist", "--mesh", "2,2,2"] + base + ["--max-step", "1"])
-    with pytest.raises(UserException):
-        run(["--experiment", "transformer", "--mesh", "2,2,2", "--l2-regularize", "1e-4"]
-            + base + ["--max-step", "1"])
     with pytest.raises(UserException):  # flat engine cannot do layer/global
         run(["--experiment", "mnist", "--granularity", "layer"] + base + ["--max-step", "1"])
     with pytest.raises(UserException):  # malformed mesh triple
         run(["--experiment", "transformer", "--mesh", "2,2"] + base + ["--max-step", "1"])
+
+
+def test_runner_sharded_mesh_unroll_and_regularization(tmp_path):
+    """One CLI, every knob (reference runner.py:80-231): --unroll and
+    --l1/--l2-regularize now drive the sharded engine too (VERDICT r3
+    next-step 6).  max-step 5 with unroll 2 exercises BOTH the scanned-chunk
+    dispatch (2x2 steps) and the per-step tail (1 step)."""
+    eval_file = str(tmp_path / "eval.tsv")
+    assert 0 == run([
+        "--experiment", "transformer",
+        "--experiment-args", "d-model:16", "heads:2", "layers:2", "seq:16",
+        "batch-size:2", "vocab:32", "corpus:4096",
+        "--aggregator", "median",
+        "--nb-workers", "2", "--mesh", "2,2,2",
+        "--unroll", "2", "--l1-regularize", "1e-5", "--l2-regularize", "1e-4",
+        "--max-step", "5",
+        "--evaluation-delta", "4", "--evaluation-period", "-1",
+        "--evaluation-file", eval_file,
+    ])
+    lines = [l.split("\t") for l in open(eval_file).read().strip().splitlines()]
+    assert int(lines[-1][1]) == 5  # the tail step ran after the chunks
 
 
 def test_deploy_session_secret_mismatch_rejected():

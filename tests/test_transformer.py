@@ -119,6 +119,109 @@ def test_sharded_engine_average_matches_manual_sgd(rng):
         )
 
 
+def test_sharded_engine_l1_l2_regularization_exact(rng):
+    """l1/l2 on the sharded engine is applied analytically to the completed
+    gradients (no per-shard double counting): the result matches the dense
+    oracle with the reg gradient added, and the reported loss carries the
+    norm term exactly once per worker (VERDICT r3 next-step 6)."""
+    w, pp, tp = 2, 2, 2
+    l1, l2 = 1e-3, 1e-2
+    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+    gar = gars.instantiate("average", w, 0)
+    lr = 0.1
+    tx = optax.sgd(lr)
+    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2)
+    batch = _batch(rng, w)
+
+    def run_engine(**reg):
+        eng = ShardedRobustEngine(mesh, gar, granularity="global", **reg)
+        state = eng.init_state(
+            lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx
+        )
+        params0 = jax.device_get(state.params)
+        step = eng.build_step(loss_fn, tx, state)
+        state, metrics = step(state, eng.shard_batch(batch))
+        return params0, jax.device_get(state.params), jax.device_get(metrics)
+
+    params0, got, metrics = run_engine(l1_regularize=l1, l2_regularize=l2)
+    _, _, metrics_plain = run_engine()
+
+    # The loss metric includes the norm term once per worker: the reg'd and
+    # plain runs share params/batch at step one, so the difference is exactly
+    # w * (l1*sum|p| + l2*sum p^2).  Replication double counting would
+    # inflate it by the pp*tp in-group factor.
+    leaves = jax.tree_util.tree_leaves(params0)
+    norm1 = sum(float(np.sum(np.abs(p))) for p in leaves)
+    norm2 = sum(float(np.sum(np.asarray(p, np.float64) ** 2)) for p in leaves)
+    want_reg = w * (l1 * norm1 + l2 * norm2)
+    got_reg = float(metrics["total_loss"]) - float(metrics_plain["total_loss"])
+    np.testing.assert_allclose(got_reg, want_reg, rtol=1e-3)
+
+    # Oracle update: dense per-worker grads + analytic reg gradient
+    dense0 = _merge_stages(params0)
+    grads = [
+        jax.grad(lambda p, b: tfm.loss_dense(p, b, CFG))(
+            dense0, jax.tree.map(lambda x: jnp.asarray(x[i]), batch)
+        )
+        for i in range(w)
+    ]
+    mean = jax.tree.map(lambda *g: sum(np.asarray(x) for x in g) / w, *grads)
+    want = jax.tree.map(
+        lambda p, g: np.asarray(p) - lr * (g + l1 * np.sign(p) + 2.0 * l2 * np.asarray(p)),
+        dense0, mean,
+    )
+    merged = _merge_stages(got)
+    for k in ("wq", "w_down", "embed", "unembed", "final_norm"):
+        np.testing.assert_allclose(
+            np.asarray(merged[k]), np.asarray(want[k]), rtol=5e-4, atol=1e-5, err_msg=k
+        )
+
+
+def test_sharded_engine_multi_step_matches_per_step(rng):
+    """build_multi_step (K batches, one scanned dispatch) reproduces K
+    sequential build_step calls and returns per-step metrics (leading K) —
+    the flat engine's --unroll contract on the sharded engine."""
+    w, pp, tp = 2, 2, 2
+    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
+    gar = gars.instantiate("median", w, 0)
+    tx = optax.sgd(0.05)
+    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2)
+    batches = [_batch(rng, w) for _ in range(2)]
+
+    def fresh_state(eng):
+        return eng.init_state(
+            lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx
+        )
+
+    eng = ShardedRobustEngine(mesh, gar, granularity="layer")
+    state = fresh_state(eng)
+    step = eng.build_step(loss_fn, tx, state)
+    losses = []
+    for b in batches:
+        state, metrics = step(state, eng.shard_batch(b))
+        losses.append(float(metrics["total_loss"]))
+    want = jax.device_get(state.params)
+
+    state2 = fresh_state(eng)
+    multi = eng.build_multi_step(loss_fn, tx, state2)
+    chunk = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    state2, many = multi(state2, eng.shard_batches(chunk))
+    got = jax.device_get(state2.params)
+
+    assert np.asarray(many["total_loss"]).shape == (2,)
+    np.testing.assert_allclose(np.asarray(many["total_loss"]), losses, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7), want, got
+    )
+
+    # repeat_steps form: one resident batch scanned K times, loss evolves
+    state3 = fresh_state(eng)
+    multi_rep = eng.build_multi_step(loss_fn, tx, state3, repeat_steps=3)
+    state3, many_rep = multi_rep(state3, eng.shard_batch(batches[0]))
+    assert np.asarray(many_rep["total_loss"]).shape == (3,)
+    assert int(jax.device_get(state3.step)) == 3
+
+
 @pytest.mark.parametrize("granularity", ["layer", "global"])
 def test_per_layer_krum_under_attack_converges(rng, granularity):
     from aggregathor_tpu.parallel.attacks import instantiate as make_attack
