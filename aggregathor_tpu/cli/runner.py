@@ -133,6 +133,21 @@ def build_parser():
              "cluster (reference: signed worker->PS pushes + TLS channels, "
              "mpi_rendezvous_mgr.patch:585-627, grpc_channel.patch:70-85)",
     )
+    parser.add_argument(
+        "--no-legacy-checkpoint-tags", action="store_true",
+        help="refuse snapshots tagged under the pre-context-separation key "
+             "scheme instead of accepting + re-tagging them once; set this "
+             "when no pre-upgrade snapshots exist to close the downgrade "
+             "acceptance entirely",
+    )
+    parser.add_argument(
+        "--encrypt-checkpoints", action="store_true",
+        help="encrypt snapshot bytes at rest under a key derived from "
+             "--session-secret (SHAKE-256 keystream, encrypt-then-MAC with "
+             "the HMAC tag) — the framework-side counterpart of the "
+             "reference's TLS channels (grpc_channel.patch:70-85) for state "
+             "that outlives the run; requires --session-secret",
+    )
     # Cadences (reference: runner.py:184-215)
     parser.add_argument("--evaluation-file", default=None, help="TSV evaluation log path")
     parser.add_argument("--evaluation-delta", type=int, default=None, help="eval every this many steps")
@@ -470,6 +485,12 @@ def main(argv=None):
         pick(args.summary_period, config.default_summary_period),
     )
     ckpt_auth = None
+    ckpt_cipher = None
+    if args.encrypt_checkpoints and not args.session_secret:
+        raise UserException(
+            "--encrypt-checkpoints derives its key from --session-secret; "
+            "pass both"
+        )
     if args.session_secret and args.checkpoint_dir:
         # The session secret also tags snapshots: a swapped/corrupted
         # checkpoint fails verification at restore instead of silently
@@ -480,11 +501,17 @@ def main(argv=None):
         # context=b"ckpt" keeps checkpoint-tag keys disjoint from the
         # bring-up handshake's (same secret, separate key family)
         ckpt_auth = GradientAuthenticator(args.session_secret.encode(), 1, context=b"ckpt")
+        if args.encrypt_checkpoints:
+            from ..parallel.crypto import SnapshotCipher
+
+            ckpt_cipher = SnapshotCipher(args.session_secret.encode())
     checkpoints = Checkpoints(
         args.checkpoint_dir,
         pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
         args.checkpoint_keep,
         authenticator=ckpt_auth,
+        cipher=ckpt_cipher,
+        allow_legacy_tags=not args.no_legacy_checkpoint_tags,
         # Serialization + disk I/O run on a writer thread (the host fetch
         # stays synchronous — the step donates the state buffers); wait()
         # joins at every later fire and at exit, so a failing write surfaces

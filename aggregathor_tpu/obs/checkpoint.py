@@ -16,6 +16,13 @@ Optional authentication: pass ``authenticator`` (a
 in a ``.tag`` sidecar and verified on restore — the host-boundary
 counterpart of the reference's signed tensor pushes (docs/transport.md).
 
+Optional at-rest encryption: pass ``cipher`` (a
+``parallel.crypto.SnapshotCipher``) and snapshot bytes are encrypted before
+hitting disk — the framework-side counterpart of the reference's TLS
+channels (grpc_channel.patch:70-85) for the state that outlives the run.
+With both, the tag covers the CIPHERTEXT (encrypt-then-MAC): restore
+rejects tampering before deriving a single keystream byte.
+
 Optional background writes (``background=True``, orbax-style): ``save``
 fetches the state to host synchronously — the caller may donate the device
 buffers to its very next step dispatch, so the device_get cannot be
@@ -36,11 +43,12 @@ from ..utils import UserException, info, warning
 
 class Checkpoints:
     def __init__(self, directory, base_name="model", max_to_keep=5, authenticator=None,
-                 background=False, allow_legacy_tags=True):
+                 background=False, allow_legacy_tags=True, cipher=None):
         self.directory = directory
         self.base_name = base_name
         self.max_to_keep = int(max_to_keep)
         self.authenticator = authenticator
+        self.cipher = cipher
         # One-time migration for snapshots tagged before key derivation
         # gained domain separation: when True, a tag minted under the OLD
         # scheme (same secret) is accepted at restore and the snapshot is
@@ -121,15 +129,21 @@ class Checkpoints:
                     and legacy_ok(0, step, data, tag)
                 ):
                     fresh = self.authenticator.sign(0, step, data)
-                    tag_tmp = tag_path + ".tmp"
-                    with open(tag_tmp, "wb") as fd:
-                        fd.write(fresh)
-                    os.replace(tag_tmp, tag_path)
+                    try:
+                        tag_tmp = tag_path + ".tmp"
+                        with open(tag_tmp, "wb") as fd:
+                            fd.write(fresh)
+                        os.replace(tag_tmp, tag_path)
+                        retag = "re-tagged under the current scheme"
+                    except OSError:
+                        # read-only store (archive mount): the verification
+                        # already succeeded, so accept; re-tagging just
+                        # could not be persisted
+                        retag = "re-tagging skipped (directory not writable)"
                     warning(
                         "Checkpoint %r was tagged under the legacy key scheme "
                         "(pre-context-separation); accepted under the same "
-                        "session secret and re-tagged under the current scheme"
-                        % (self._path(step),)
+                        "session secret, %s" % (self._path(step), retag)
                     )
                 else:
                     raise UserException(
@@ -137,6 +151,19 @@ class Checkpoints:
                         "forged, or a --session-secret mismatch; treat the "
                         "snapshot as untrusted" % (self._path(step),)
                     )
+        if self.cipher is not None:
+            data = self.cipher.decrypt(step, data)
+        else:
+            from ..parallel.crypto import SnapshotCipher
+
+            if SnapshotCipher.is_encrypted(data):
+                # No cipher but the blob is encrypted: fail with the cause,
+                # not a baffling msgpack error from keystream-looking bytes.
+                raise UserException(
+                    "Checkpoint %r is encrypted; pass --encrypt-checkpoints "
+                    "with the matching --session-secret to restore it"
+                    % (self._path(step),)
+                )
         state = flax.serialization.from_bytes(template_state, data)
         info("Restored checkpoint at step %d from %r" % (step, self.directory))
         return state, step
@@ -185,6 +212,10 @@ class Checkpoints:
 
     def _write(self, host_state, step):
         data = flax.serialization.to_bytes(host_state)
+        if self.cipher is not None:
+            # BEFORE tagging: encrypt-then-MAC, the tag authenticates
+            # exactly the bytes on disk
+            data = self.cipher.encrypt(step, data)
         path = self._path(step)
         if self.authenticator is not None:
             # Slot 0 = the controller identity; the step binding ties each tag

@@ -1,0 +1,94 @@
+"""At-rest encryption for persisted state (off the hot path).
+
+The reference ships working TLS channels for its control plane
+(tf_patches/patches/grpc_channel.patch:70-85, ``SECURE_GRPC=1``): gradient
+and state bytes crossing its open network are encrypted in flight.  Under
+single-controller SPMD the in-flight surface is the TPU interconnect
+(not addressable by guest code — docs/transport.md) and the multi-host
+control plane (gRPC, TLS-configurable at deployment); what the *framework*
+still persists in the clear is the checkpoint: full model state on shared
+disk.  This module closes that surface with an executable confidentiality
+story: snapshots are encrypted under a key derived from the same session
+secret that already authenticates them.
+
+Construction (stdlib-only — the environment has no AEAD library, and the
+box's pip is sealed):
+
+- key      = SHA-256(secret || len("ckpt-enc") || "ckpt-enc" || 0)
+             (``auth.derive_worker_key`` — its own context, so the
+             encryption key family is disjoint from every tagging family)
+- nonce    = 16 fresh ``os.urandom`` bytes per snapshot
+- keystream = SHAKE-256(key || nonce || step), one ``digest(len(data))``
+             call — the sponge as an XOF-keyed stream cipher (the cSHAKE/
+             KMAC construction), C-speed for multi-MB states
+- ciphertext = plaintext XOR keystream  (numpy, vectorized)
+- blob     = MAGIC || nonce || ciphertext
+
+Integrity is NOT this layer's job: ``obs.Checkpoints`` tags the blob with
+the existing HMAC machinery (encrypt-then-MAC — verification rejects
+tampered ciphertext before a single keystream byte is derived).  A
+plaintext sentinel is still prepended before encryption so a cipher used
+WITHOUT an authenticator fails loudly on a wrong secret instead of feeding
+keystream garbage to the deserializer.
+"""
+
+import hashlib
+import os
+import struct
+
+import numpy as np
+
+from ..utils import UserException
+from .auth import derive_worker_key
+
+_MAGIC = b"ATPC1"  # versioned container tag: bump on format change
+_SENTINEL = b"ATPP"  # plaintext marker: wrong-key decrypt cannot produce it
+_NONCE_BYTES = 16
+
+
+def _keystream(key, nonce, step, length):
+    material = key + nonce + struct.pack("<q", int(step))
+    return hashlib.shake_256(material).digest(length)
+
+
+def _xor(data, stream):
+    a = np.frombuffer(data, np.uint8)
+    b = np.frombuffer(stream, np.uint8)
+    return np.bitwise_xor(a, b).tobytes()
+
+
+class SnapshotCipher:
+    """Encrypts/decrypts snapshot byte blobs under a session-secret key.
+
+    Step binding: the step number seasons the keystream, so two snapshots
+    at different steps never share a keystream even under nonce reuse."""
+
+    def __init__(self, session_secret):
+        self.key = derive_worker_key(session_secret, 0, context=b"ckpt-enc")
+
+    def encrypt(self, step, data):
+        nonce = os.urandom(_NONCE_BYTES)
+        plain = _SENTINEL + bytes(data)
+        return _MAGIC + nonce + _xor(plain, _keystream(self.key, nonce, step, len(plain)))
+
+    def decrypt(self, step, blob):
+        blob = bytes(blob)
+        if not blob.startswith(_MAGIC):
+            raise UserException(
+                "Snapshot is not encrypted (or predates encryption): missing "
+                "the %r container tag. Restore it without --encrypt-checkpoints; "
+                "the next save writes an encrypted snapshot" % (_MAGIC,)
+            )
+        nonce = blob[len(_MAGIC):len(_MAGIC) + _NONCE_BYTES]
+        ct = blob[len(_MAGIC) + _NONCE_BYTES:]
+        plain = _xor(ct, _keystream(self.key, nonce, step, len(ct)))
+        if not plain.startswith(_SENTINEL):
+            raise UserException(
+                "Snapshot decryption failed: wrong --session-secret or a "
+                "corrupted snapshot"
+            )
+        return plain[len(_SENTINEL):]
+
+    @staticmethod
+    def is_encrypted(blob):
+        return bytes(blob[:len(_MAGIC)]) == _MAGIC

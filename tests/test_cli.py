@@ -377,6 +377,33 @@ def test_runner_session_secret_tags_checkpoints(tmp_path):
         run(base + ["--max-step", "7"])
 
 
+def test_runner_encrypted_checkpoints(tmp_path):
+    """--encrypt-checkpoints: snapshots hit disk as ciphertext, resume
+    decrypts transparently, and the flag demands --session-secret (the
+    executable confidentiality story for state at rest — the TLS row of
+    docs/transport.md; reference: grpc_channel.patch:70-85)."""
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "average", "--nb-workers", "4",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--checkpoint-dir", ckpt, "--session-secret", "launch-secret",
+        "--encrypt-checkpoints",
+    ]
+    assert 0 == run(base + ["--max-step", "3"])
+    [snap] = [n for n in os.listdir(ckpt) if n.endswith("-3.ckpt")]
+    with open(os.path.join(ckpt, snap), "rb") as fd:
+        blob = fd.read()
+    assert blob.startswith(b"ATPC1")  # ciphertext container, not msgpack
+    assert 0 == run(base + ["--max-step", "5"])  # decrypting resume
+    with pytest.raises(UserException, match="session-secret"):
+        run([
+            "--experiment", "mnist", "--aggregator", "average",
+            "--nb-workers", "4", "--encrypt-checkpoints",
+            "--checkpoint-dir", ckpt, "--max-step", "1",
+        ])
+
+
 def test_runner_sharded_mesh_full_composition(tmp_path):
     """Every engine extension composes through the --mesh CLI path in one
     run: worker momentum, bf16 wire exchange, lossy link (NaN infill),
