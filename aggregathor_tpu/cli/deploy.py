@@ -23,6 +23,10 @@ metadata); arguments after ``--`` go to the runner verbatim.
 ``--local-simulate K`` instead forks K local processes that form a K-process
 CPU "cluster" on localhost — the single-machine deployment story of the
 reference (README.md:141-146) and the integration-test hook for the DCN path.
+
+``--cluster SPEC`` resolves the three flags from the reference's cluster-spec
+forms (inline JSON / file / ``G5k`` reading ``$OAR_FILE_NODES`` —
+tools/cluster.py:48-91) via ``utils.cluster.cluster_spec``.
 """
 
 import argparse
@@ -39,10 +43,21 @@ def build_parser():
     parser.add_argument("--num-processes", type=int, default=None, help="total process count")
     parser.add_argument("--process-id", type=int, default=None, help="this process' rank")
     parser.add_argument(
+        "--cluster", default=None, metavar="SPEC",
+        help="resolve the bring-up triple from a cluster spec instead of the "
+             "three flags above: inline JSON ('[\"h0\",\"h1\"]' or "
+             "'{\"hosts\": [...], \"port\": N}'), a nodefile/JSON path, or "
+             "'G5k' to read $OAR_FILE_NODES — the reference's --cluster "
+             "forms (tools/cluster.py:48-91) mapped to SPMD bring-up; this "
+             "host's rank comes from hostname match or $AGGREGATHOR_PROCESS_ID",
+    )
+    parser.add_argument(
         "--local-simulate", type=int, default=0, metavar="K",
         help="fork K local CPU processes forming a cluster on localhost (single-machine parity)",
     )
-    parser.add_argument("--port", type=int, default=7000, help="coordinator port (reference: tools/cluster.py:60)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="coordinator port when the spec names none (default 7000, "
+                             "the reference's fixed port, tools/cluster.py:60)")
     parser.add_argument("runner_args", nargs=argparse.REMAINDER, help="arguments after -- go to the runner")
     return parser
 
@@ -76,7 +91,28 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     runner_args = _strip_separator(args.runner_args)
     if args.local_simulate > 0:
-        return local_simulate(args.local_simulate, args.port, runner_args)
+        from ..utils.cluster import DEFAULT_PORT
+
+        return local_simulate(args.local_simulate, args.port or DEFAULT_PORT, runner_args)
+    if args.cluster is not None:
+        if (
+            args.coordinator_address is not None
+            or args.num_processes is not None
+            or args.process_id is not None
+        ):
+            from ..utils import UserException
+
+            raise UserException(
+                "--cluster and --coordinator-address/--num-processes/"
+                "--process-id are two ways to name the same thing; pass one "
+                "(a spec'd host's rank can be pinned via "
+                "$AGGREGATHOR_PROCESS_ID)"
+            )
+        from ..utils.cluster import cluster_spec
+
+        (args.coordinator_address, args.num_processes, args.process_id) = cluster_spec(
+            args.cluster, port=args.port
+        )
 
     import jax
 

@@ -20,6 +20,13 @@ def run(args):
     return runner.main(args)
 
 
+def _free_port():
+    """An ephemeral port for a throwaway localhost cluster."""
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
 def test_runner_end_to_end(tmp_path):
     eval_file = str(tmp_path / "eval.tsv")
     ckpt_dir = str(tmp_path / "ckpt")
@@ -92,10 +99,7 @@ def test_deploy_local_simulate(tmp_path):
     cluster connected via jax.distributed (reference single-machine story,
     deploy.py:190-309 / README.md:141-146), runs mnist+krum over the spanning
     mesh, and only process 0 writes the eval file."""
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
+    port = _free_port()
     eval_file = tmp_path / "eval.tsv"
     proc = subprocess.run(
         [sys.executable, "-m", "aggregathor_tpu.cli.deploy",
@@ -328,10 +332,7 @@ def test_deploy_session_secret_mismatch_rejected():
     handshake (no training step runs with an unauthenticated host) —
     VERDICT r2 next-step 7; reference parity: signed worker->PS pushes
     (mpi_rendezvous_mgr.patch:585-627)."""
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
+    port = _free_port()
     common = [
         "--experiment", "mnist", "--experiment-args", "batch-size:8",
         "--aggregator", "average", "--nb-workers", "2", "--max-step", "2",
@@ -354,6 +355,34 @@ def test_deploy_session_secret_mismatch_rejected():
     outs = [p.communicate(timeout=300)[0] for p in procs]
     assert all(p.returncode != 0 for p in procs), outs
     assert any("authentication FAILED" in out for out in outs), outs
+
+
+def test_deploy_cluster_spec_two_process():
+    """--cluster resolves the bring-up triple from a spec (the reference's
+    tools/cluster.py input forms): a 2-process localhost cluster trains to
+    completion with ranks from $AGGREGATHOR_PROCESS_ID."""
+    port = _free_port()
+    spec = '["127.0.0.1:%d", "127.0.0.1"]' % port
+    common = [
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "average", "--nb-workers", "2", "--max-step", "2",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+    ]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for rank in (0, 1):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["AGGREGATHOR_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "aggregathor_tpu.cli.deploy",
+             "--cluster", spec, "--"] + common,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo,
+        ))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
 
 
 def test_runner_session_secret_tags_checkpoints(tmp_path):

@@ -1,0 +1,99 @@
+"""Cluster-spec resolution tests (reference: tools/cluster.py:48-91 mapped
+to the jax.distributed bring-up triple)."""
+
+import pytest
+
+from aggregathor_tpu.utils import UserException
+from aggregathor_tpu.utils.cluster import (
+    DEFAULT_PORT,
+    cluster_spec,
+    parse_nodefile,
+    resolve_process_id,
+)
+
+
+def _pin_rank(monkeypatch, rank):
+    monkeypatch.setenv("AGGREGATHOR_PROCESS_ID", str(rank))
+
+
+def test_parse_nodefile_dedups_in_order(tmp_path):
+    """OAR nodefiles repeat one line per core; hosts collapse, order kept."""
+    path = tmp_path / "nodes"
+    path.write_text("b\nb\na\n\nb\nc\n")
+    assert parse_nodefile(str(path)) == ["b", "a", "c"]
+    empty = tmp_path / "empty"
+    empty.write_text("\n\n")
+    with pytest.raises(UserException):
+        parse_nodefile(str(empty))
+    with pytest.raises(UserException):
+        parse_nodefile(str(tmp_path / "missing"))
+
+
+def test_cluster_spec_inline_json(monkeypatch):
+    _pin_rank(monkeypatch, 1)
+    coord, nb, rank = cluster_spec('["h0", "h1", "h2"]')
+    assert (coord, nb, rank) == ("h0:%d" % DEFAULT_PORT, 3, 1)
+    # dict form carries its own port; explicit --port wins over it
+    coord, nb, _ = cluster_spec('{"hosts": ["h0", "h1"], "port": 9000}')
+    assert (coord, nb) == ("h0:9000", 2)
+    coord, _, _ = cluster_spec('{"hosts": ["h0", "h1"], "port": 9000}', port=4321)
+    assert coord == "h0:4321"
+    # a host naming its own port is taken verbatim
+    coord, _, _ = cluster_spec('["h0:555", "h1"]')
+    assert coord == "h0:555"
+
+
+def test_cluster_spec_files(tmp_path, monkeypatch):
+    _pin_rank(monkeypatch, 0)
+    nodes = tmp_path / "nodes"
+    nodes.write_text("n0\nn0\nn1\n")
+    assert cluster_spec(str(nodes)) == ("n0:%d" % DEFAULT_PORT, 2, 0)
+    spec = tmp_path / "spec.json"
+    spec.write_text('{"hosts": ["j0", "j1"], "port": 7171}')
+    assert cluster_spec(str(spec)) == ("j0:7171", 2, 0)
+
+
+def test_cluster_spec_g5k(tmp_path, monkeypatch):
+    """The reference's special parser keyword: $OAR_FILE_NODES nodefile,
+    first host elected coordinator (it elected the PS, tools/cluster.py:60)."""
+    _pin_rank(monkeypatch, 2)
+    nodes = tmp_path / "oar"
+    nodes.write_text("g0\ng0\ng1\ng2\n")
+    monkeypatch.setenv("OAR_FILE_NODES", str(nodes))
+    assert cluster_spec("G5k") == ("g0:%d" % DEFAULT_PORT, 3, 2)
+    monkeypatch.delenv("OAR_FILE_NODES")
+    with pytest.raises(UserException, match="OAR_FILE_NODES"):
+        cluster_spec("G5k")
+
+
+def test_cluster_spec_rejections(monkeypatch, tmp_path):
+    _pin_rank(monkeypatch, 0)
+    for bad in (
+        "[]", '{"hosts": []}', '["h0", 3]', "{not json", "/nonexistent/path",
+        '{"hosts": ["h0"], "port": "9000"}',  # string port: clean error, not %d TypeError
+        str(tmp_path),  # a directory: OSError path, not a raw IsADirectoryError
+    ):
+        with pytest.raises(UserException):
+            cluster_spec(bad)
+
+
+def test_non_integer_rank_env(monkeypatch):
+    monkeypatch.setenv("AGGREGATHOR_PROCESS_ID", "$RANK")  # unexpanded template
+    with pytest.raises(UserException, match="not an integer"):
+        resolve_process_id(["a", "b"])
+
+
+def test_resolve_process_id(monkeypatch):
+    # env override validated against the host count
+    _pin_rank(monkeypatch, 5)
+    with pytest.raises(UserException):
+        resolve_process_id(["a", "b"])
+    monkeypatch.delenv("AGGREGATHOR_PROCESS_ID")
+    # hostname match, including short-vs-fqdn and host:port forms
+    import socket
+
+    monkeypatch.setattr(socket, "gethostname", lambda: "node1.site.grid")
+    monkeypatch.setattr(socket, "getfqdn", lambda: "node1.site.grid")
+    assert resolve_process_id(["node0", "node1:700", "node2"]) == 1
+    with pytest.raises(UserException, match="AGGREGATHOR_PROCESS_ID"):
+        resolve_process_id(["other0", "other1"])
