@@ -23,6 +23,15 @@ non-finite value keys as +inf (sorts last); ties break by lower worker
 index; a selected non-finite value is returned *as-is* (the original
 NaN/inf poisons that coordinate, same identity in every tier).
 
+Tile alignment (Mosaic lowers f32 in (8, 128) sublane x lane tiles): the
+host wrappers pad the worker dim to a multiple of 8 and the coordinate
+kernels write full (8, blk) output tiles — no sub-tile block shapes reach
+the compiler.  Worker padding is provably neutral: a padded row is all-NaN,
+keys +inf at the highest indices, and its rank is exactly n (every real row
+precedes it), strictly above every selection threshold (n//2 < n, beta <=
+n); ``average_nan_columns`` ignores non-finite rows by construction, and
+the distance wrappers slice padded rows/columns off before returning.
+
 All kernels auto-fall back to interpreter mode off-TPU, so the same code
 path is exercised by the CPU test suite.
 """
@@ -94,9 +103,16 @@ def _inf_key(x):
 # --------------------------------------------------------------------------- #
 # Coordinate-wise selection kernels
 
+def _store_row(out_ref, row):
+    # Full-tile store: writing all 8 sublanes of the (8, blk) output block
+    # keeps the store aligned (no masked sub-tile write); the wrapper reads
+    # row 0.
+    out_ref[:] = jnp.broadcast_to(row[None, :], out_ref.shape)
+
+
 def _median_kernel(n, x_ref, out_ref):
     x = x_ref[:]
-    out_ref[0, :] = _select_rank(x, _ranks(_inf_key(x), n), n // 2)
+    _store_row(out_ref, _select_rank(x, _ranks(_inf_key(x), n), n // 2))
 
 
 def _averaged_median_kernel(n, beta, x_ref, out_ref):
@@ -104,21 +120,27 @@ def _averaged_median_kernel(n, beta, x_ref, out_ref):
     med = _select_rank(x, _ranks(_inf_key(x), n), n // 2)
     dev_ranks = _ranks(_inf_key(jnp.abs(x - med[None, :])), n)
     chosen = jnp.where(dev_ranks < beta, x, 0.0)
-    out_ref[0, :] = jnp.sum(chosen, axis=0) / float(beta)
+    _store_row(out_ref, jnp.sum(chosen, axis=0) / float(beta))
 
 
 def _coordinate_call(kernel, x, block_d=None):
-    """Run a (n, blk) -> (1, blk) coordinate kernel over column blocks."""
+    """Run a (n, blk) -> row coordinate kernel over column blocks.
+
+    Rank thresholds inside ``kernel`` use the REAL n; the slab rows are
+    padded to the f32 sublane multiple with NaN (neutral, module docstring).
+    """
     n, d = x.shape
-    blk = block_d or _pick_block_coord(n, d)
+    rows = n + (-n) % 8  # the slab the kernel actually holds is padded
+    blk = block_d or _pick_block_coord(rows, d)
     xp = _pad_axis(x.astype(jnp.float32), 1, blk)
+    xp = _pad_axis(xp, 0, 8, jnp.nan)
     grid = xp.shape[1] // blk
     out = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[pl.BlockSpec((n, blk), lambda i: (0, i), memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, xp.shape[1]), jnp.float32),
+        in_specs=[pl.BlockSpec((rows, blk), lambda i: (0, i), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, blk), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, xp.shape[1]), jnp.float32),
         interpret=_interpret(),
     )(xp)
     return out[0, :d]
@@ -143,10 +165,10 @@ def average_nan_columns(x, block_d=None):
 
     def kernel(x_ref, out_ref):
         v = x_ref[:]
-        finite = jnp.isfinite(v)
+        finite = jnp.isfinite(v)  # NaN-padded rows count for nothing
         total = jnp.sum(jnp.where(finite, v, 0.0), axis=0)
         count = jnp.sum(finite.astype(jnp.float32), axis=0)
-        out_ref[0, :] = jnp.where(count > 0, total / jnp.maximum(count, 1.0), 0.0)
+        _store_row(out_ref, jnp.where(count > 0, total / jnp.maximum(count, 1.0), 0.0))
 
     return _coordinate_call(kernel, x, block_d)
 
@@ -189,12 +211,13 @@ def pairwise_sq_distances(x, block_d=None, use_mxu=None):
     matching the jnp tier.
     """
     n, d = x.shape
+    rows = n + (-n) % 8  # VMEM budgets must see the padded slab size
     if use_mxu is None:
         use_mxu = n > 64
     x = x.astype(jnp.float32)
     if use_mxu:
         kernel = _dist_gram_kernel
-        blk = block_d or _pick_block_coord(n, d)
+        blk = block_d or _pick_block_coord(rows, d)
         # Robust centering outside the kernel (distances are translation-
         # invariant, one global center suffices): NaN-ignoring coordinate
         # median, same scheme as gars/common.py centered_gram_sq_distances.
@@ -202,18 +225,23 @@ def pairwise_sq_distances(x, block_d=None, use_mxu=None):
         x = x - center[None, :]
     else:
         kernel = _dist_diff_kernel
-        blk = block_d or _pick_block_diff(n, d)
+        blk = block_d or _pick_block_diff(rows, d)
     xp = _pad_axis(x, 1, blk)
+    # Sublane-align the worker dim with zero rows; every real-pair entry is
+    # computed rowwise-independently, so padded rows only affect their own
+    # (sliced-off) rows/columns.
+    xp = _pad_axis(xp, 0, 8, 0.0)
     grid = xp.shape[1] // blk
     out = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[pl.BlockSpec((n, blk), lambda i: (0, i), memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((n, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+        in_specs=[pl.BlockSpec((rows, blk), lambda i: (0, i), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((rows, rows), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, rows), jnp.float32),
         interpret=_interpret(),
     )(xp)
-    # Padding contributes zero to every distance.  The Gram form can go
-    # slightly negative from cancellation — clamp it (NaN passes through
+    out = out[:n, :n]
+    # Column padding contributes zero to every distance.  The Gram form can
+    # go slightly negative from cancellation — clamp it (NaN passes through
     # jnp.maximum); downstream scoring masks the diagonal itself.
     return jnp.maximum(out, 0.0) if use_mxu else out
