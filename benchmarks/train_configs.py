@@ -121,6 +121,21 @@ CONFIGS = {
                  "--granularity", "leaf", "--leaf-bucketing", "off",
                  "--experiment-args", "batch-size:8", "dtype:bfloat16"],
     },
+    "5f": {
+        "name": "transformer_leaf_krum_n8_f2_single_chip",
+        "note": "BASELINE config 5 (stretch) at single-chip scale: per-layer "
+                "Krum on a real transformer via the FLAT engine's leaf path "
+                "(8 vmapped workers on one chip, ~50 leaves bucketed by "
+                "shape) — the per-layer-GAR-on-a-transformer capability "
+                "measured without a pod; the dp x pp x tp version is "
+                "benchmarks/sharded_transformer.py",
+        "args": ["--experiment", "transformer",
+                 "--experiment-args", "d-model:256", "heads:4", "layers:8",
+                 "seq:256", "batch-size:8", "vocab:1024", "corpus:65536",
+                 "--aggregator", "krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+                 "--granularity", "leaf"],
+    },
     "4": {
         "name": "inception_v3_median_little_n32_f8",
         "note": "BASELINE config 4: coordinate-median under a real 'little' "

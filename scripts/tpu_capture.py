@@ -27,6 +27,13 @@ Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
                     under attack, n=32 f=8), through the real CLI on TPU
   leaf_resnet     — per-layer granularity on a slim ResNet (the bucketed
                     leaf path) through the real CLI
+  trace           — config 2b sizing with a jax.profiler trace banked to
+                    benchmarks/trace_r03 for offline MFU attribution
+  robustness      — accuracy-under-attack table at conv scale (cnnet)
+  sharded_transformer — BASELINE config 5's machinery on a 1,1,1 mesh
+                    (pipeline/ring/MoE code path on silicon)
+  leaf_transformer — config 5f: per-layer Krum on a transformer with 8
+                    vmapped workers via the flat engine's leaf path
 
 A stage that succeeds is recorded in ``scripts/tpu_capture_state.json`` and
 not re-run, so a short up-window makes incremental progress and the next
@@ -82,6 +89,18 @@ def _stages(py):
         ("trace",
          b("benchmarks/train_configs.py", "--configs", "2t",
            "--steps", "40", "--platform", "tpu", "--timeout", "1500"), 1800),
+        # BASELINE config 5 (stretch), both single-chip expressions: the
+        # sharded engine's full machinery on a 1,1,1 mesh (pipeline/ring/MoE
+        # code path on silicon) and per-layer Krum with real worker
+        # multiplicity via the flat engine's bucketed leaf path.
+        ("sharded_transformer",
+         b("benchmarks/sharded_transformer.py", "--mesh", "1,1,1",
+           "--gar", "median", "--d-model", "512", "--layers", "8",
+           "--seq", "512", "--batch", "8", "--steps", "10",
+           "--platform", "tpu"), 2400),
+        ("leaf_transformer",
+         b("benchmarks/train_configs.py", "--configs", "5f",
+           "--steps", "20", "--platform", "tpu", "--timeout", "1500"), 1800),
         ("robustness",
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
            "--batch", "32", "--rules", "average,krum,median,dnc",
