@@ -1,0 +1,25 @@
+"""Process-lifecycle helpers shared by the benchmark/capture entry points.
+
+One concern lives here: making SIGTERM unwind the interpreter instead of
+killing the process outright.  The capture watcher (scripts/tpu_capture.py)
+and bench.py's watchdog escalate TERM-before-KILL so a timed-out child can
+close its tunneled-backend connection cleanly — hard-killing a client
+mid-RPC is a plausible trigger for wedging the backend for every subsequent
+client (both multi-hour chip-down records in benchmarks/tpu_capture.jsonl
+start right after a SIGKILL mid-operation).  CPython's DEFAULT SIGTERM
+disposition terminates as abruptly as SIGKILL, so every TERM-able entry
+point must install this handler for the escalation to buy anything.
+"""
+
+import signal
+import sys
+
+
+def graceful_sigterm(code=143):
+    """Install a SIGTERM handler that raises SystemExit(code).
+
+    SystemExit unwinds the main thread: ``finally`` blocks and ``atexit``
+    hooks run, which is where the JAX backend client tears down its
+    connection.  143 = 128 + SIGTERM, the conventional shell exit code.
+    """
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(code))

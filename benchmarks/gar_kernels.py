@@ -142,4 +142,9 @@ def main():
 
 
 if __name__ == "__main__":
+    # TERM must unwind the interpreter so the backend client closes
+    # cleanly — the capture watcher escalates TERM-before-KILL.
+    from aggregathor_tpu.utils.proc import graceful_sigterm
+
+    graceful_sigterm()
     main()

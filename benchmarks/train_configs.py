@@ -227,4 +227,10 @@ def main():
 
 
 if __name__ == "__main__":
+    # TERM must unwind the interpreter so the backend client closes
+    # cleanly — the capture watcher escalates TERM-before-KILL.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from aggregathor_tpu.utils.proc import graceful_sigterm
+
+    graceful_sigterm()
     main()

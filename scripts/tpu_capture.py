@@ -68,12 +68,18 @@ PROBE_CODE = (
 
 def _stages(py):
     b = lambda *a: [py] + list(a)
+    # Ordered by evidence-per-second: pallas_check first (small compiles,
+    # and the on-silicon Pallas proof is the single highest-value pending
+    # cell), then the headline bench; the multi-config CLI drives last.
     return [
         # (name, argv, timeout_s)
-        ("bench", b("bench.py"), 1200),
         ("pallas_check",
          b("scripts/pallas_tpu_check.py", "--n", "32", "--f", "8",
            "--dims", "65536,1048576,8388608"), 2400),
+        # 2200 s: bench.py's own child watchdogs total 90 (probe) + 1500
+        # (TPU attempt) + 480 (CPU fallback); every completed phase flushes
+        # an updated result line, so a long leash risks no evidence.
+        ("bench", b("bench.py"), 2200),
         ("gar_kernels",
          b("benchmarks/gar_kernels.py", "--n", "32", "--f", "8",
            "--dims", "65536,1048576,8388608", "--reps", "10"), 3600),
@@ -145,19 +151,32 @@ def _run_guarded(argv, timeout, env=None):
         stdout, stderr = proc.communicate(timeout=timeout)
         return proc.returncode, stdout, stderr
     except subprocess.TimeoutExpired:
+        # SIGTERM first, SIGKILL only on refusal: hard-killing a client
+        # mid-RPC is a plausible trigger for wedging the tunneled backend
+        # for every subsequent client (both multi-hour chip-down records
+        # start right after a SIGKILL mid-operation), and a clean client
+        # shutdown costs only a few seconds of grace.
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             pass
-        stdout = ""
+        stdout, stderr = "", ""
         try:
             # Keep whatever the child flushed before wedging — partial rows
             # from a short up-window are exactly the incremental progress
-            # this watcher exists to bank.
-            stdout, _ = proc.communicate(timeout=15)
+            # this watcher exists to bank, and the stderr BENCH_PHASE trail
+            # is the only record of WHICH phase wedged.
+            stdout, stderr = proc.communicate(timeout=20)
         except subprocess.TimeoutExpired:
-            pass  # D-state child: abandon it
-        return None, stdout or "", "timeout after %ds" % timeout
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                stdout, stderr = proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child: abandon it
+        return None, stdout or "", ("timeout after %ds | %s" % (timeout, (stderr or "").strip()[-700:]))
 
 
 def probe(timeout=100):
@@ -182,7 +201,16 @@ def _tpu_datum(row):
     """
     if row.get("error"):
         return False
-    platform = row.get("platform") or (row.get("detail") or {}).get("platform") or ""
+    detail = row.get("detail") or {}
+    platform = row.get("platform") or detail.get("platform") or ""
+    if str(row.get("metric", "")).startswith("cnnet_cifar10_multikrum"):
+        # bench.py emits an updated row after EVERY phase; an early partial
+        # (e.g. per-step dispatch only, wedge before the scanned/bf16
+        # phases) is banked in the log but must NOT retire the stage, or
+        # the remaining phases are never captured.  Completeness marker:
+        # the bf16 secondary's resident rate is the LAST field written.
+        return (platform == "tpu"
+                and bool((detail.get("bfloat16") or {}).get("steps_per_s_resident_batch")))
     if platform:
         return platform == "tpu"
     tier = row.get("tier", "")
@@ -204,9 +232,12 @@ def run_stage(name, argv, timeout):
                 lines.append(json.loads(line))
             except ValueError:
                 pass
+    # stderr tail recorded on EVERY outcome: a stage can exit 0 yet carry
+    # only a CPU fallback, and its BENCH_PHASE trail (which phase the TPU
+    # attempt wedged in) is then the only diagnostic that exists.
     _log({
         "stage": name, "rc": rc, "elapsed_s": round(time.time() - t0, 1),
-        "results": lines, "stderr_tail": err.strip()[-600:] if rc not in (0,) else "",
+        "results": lines, "stderr_tail": err.strip()[-900:],
     })
     return rc == 0 and any(_tpu_datum(r) for r in lines)
 
