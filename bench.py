@@ -97,12 +97,15 @@ def run_bench(force_cpu=False, emit=lambda result: None):
         batch_size, unroll, chunks = 16, 1, 8
     else:
         batch_size, unroll, chunks = 128, 20, 10
-    if os.environ.get("GRAFT_BENCH_SIZING"):
-        # Testing hook: exercise every phase of this harness with a tiny
-        # workload ("batch,unroll,chunks") — numbers produced under an
-        # override are for harness validation, never for BENCHMARKS.md.
-        batch_size, unroll, chunks = (
-            int(x) for x in os.environ["GRAFT_BENCH_SIZING"].split(","))
+    sizing_override = os.environ.get("GRAFT_BENCH_SIZING")
+    if sizing_override:
+        # Sizing hook ("batch,unroll,chunks"): used by the harness tests
+        # (tiny workloads) and by the watcher's bench_mini stage (full
+        # batch, shorter scan/loops — insurance that a short chip
+        # up-window still banks a real TPU datum).  The metric name gains
+        # a suffix so an override row is never compared to the standard
+        # workload under one name.
+        batch_size, unroll, chunks = (int(x) for x in sizing_override.split(","))
 
     _phase("backend init (JAX_PLATFORMS=%r)" % platform)
     devices = jax.devices()
@@ -147,6 +150,8 @@ def run_bench(force_cpu=False, emit=lambda result: None):
     name = "cnnet_cifar10_multikrum_n8_f2_steps_per_s"
     if force_cpu:
         name += "_cpu_fallback"
+    if sizing_override:
+        name += "_sizing_override"
     result = {
         "metric": name,
         "value": 0.0,
@@ -161,6 +166,8 @@ def run_bench(force_cpu=False, emit=lambda result: None):
             "unroll": unroll,
         },
     }
+    if sizing_override:
+        result["detail"]["sizing_override"] = sizing_override
     if force_cpu:
         # The fallback runs a REDUCED workload (so it finishes inside the
         # watchdog on one CPU core); a reader of the JSON alone must not

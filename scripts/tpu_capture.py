@@ -68,11 +68,17 @@ PROBE_CODE = (
 
 def _stages(py):
     b = lambda *a: [py] + list(a)
-    # Ordered by evidence-per-second: pallas_check first (small compiles,
-    # and the on-silicon Pallas proof is the single highest-value pending
-    # cell), then the headline bench; the multi-config CLI drives last.
+    # Ordered by evidence-per-second: bench_mini first — the SAME config-2
+    # program at batch 128, just a shorter scan (K=10) and fewer timed
+    # loops, so even a ~10 min up-window banks a real TPU training datum
+    # with MFU before anything heavier is attempted.  Then pallas_check
+    # (small compiles, and the on-silicon Pallas proof is the single
+    # highest-value pending cell), then the full headline bench; the
+    # multi-config CLI drives last.  A stage entry may carry a 4th element:
+    # extra environment for the child.
     return [
-        # (name, argv, timeout_s)
+        # (name, argv, timeout_s[, extra_env])
+        ("bench_mini", b("bench.py"), 1600, {"GRAFT_BENCH_SIZING": "128,10,3"}),
         ("pallas_check",
          b("scripts/pallas_tpu_check.py", "--n", "32", "--f", "8",
            "--dims", "65536,1048576,8388608"), 2400),
@@ -221,9 +227,13 @@ def _tpu_datum(row):
     return False
 
 
-def run_stage(name, argv, timeout):
+def run_stage(name, argv, timeout, extra_env=None):
     t0 = time.time()
-    rc, out, err = _run_guarded(argv, timeout)
+    env = None
+    if extra_env:
+        env = dict(os.environ)
+        env.update(extra_env)
+    rc, out, err = _run_guarded(argv, timeout, env=env)
     lines = []
     for line in out.splitlines():
         line = line.strip()
@@ -266,8 +276,8 @@ def main():
             return
         if probe():
             _log({"event": "chip-up", "todo": [s[0] for s in todo]})
-            for name, argv, timeout in todo:
-                if run_stage(name, argv, timeout):
+            for name, argv, timeout, *extra in todo:
+                if run_stage(name, argv, timeout, *(extra or [None])):
                     state["done"].append(name)
                     _save_state(state)
                 else:
