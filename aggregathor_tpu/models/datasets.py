@@ -137,6 +137,42 @@ def load_mnist():
     return _synthetic_classification("mnist", (28, 28, 1), 10, nb_train=8192, nb_test=2048, seed=7)
 
 
+def load_digits8x8(train_fraction=0.8, seed=11):
+    """REAL handwritten digits: the UCI ML hand-written digits set (1797
+    8x8 grayscale images, 10 classes) bundled INSIDE scikit-learn — the one
+    real vision dataset reachable on a zero-egress box.
+
+    Same role as the reference's real-MNIST path (experiments/mnist.py:51-81
+    downloads via keras): a genuine accuracy target instead of a synthetic
+    stand-in.  Deterministic seeded shuffle then an 80/20 split; pixels are
+    0..16 ints, normalized to [0, 1].  Resolution order: a digits.npz under
+    $AGGREGATHOR_DATA (so the _synthetic_classification recovery hint is a
+    live path), then sklearn, then the synthetic stand-in (flagged via
+    ``.synthetic``), mirroring the 1797-image corpus at the same split.
+    """
+    path = _find_npz("digits.npz")
+    if path:
+        return _load_npz(path, (8, 8, 1), 16.0, nb_classes=10)
+    nb_train = int(1797 * train_fraction)
+    try:
+        from sklearn.datasets import load_digits as _sk_load_digits
+    except ImportError:
+        return _synthetic_classification(
+            "digits", (8, 8, 1), 10, nb_train=nb_train, nb_test=1797 - nb_train,
+            seed=seed)
+    bunch = _sk_load_digits()
+    images = (bunch.images.astype(np.float32) / 16.0).reshape(-1, 8, 8, 1)
+    labels = bunch.target.astype(np.int32)
+    order = np.random.default_rng(seed).permutation(len(labels))
+    images, labels = images[order], labels[order]
+    split = int(len(labels) * train_fraction)
+    info("Loaded REAL sklearn digits: %d train / %d test" % (split, len(labels) - split))
+    return ArrayDataset(
+        images[:split], labels[:split], images[split:], labels[split:],
+        nb_classes=10, synthetic=False,
+    )
+
+
 def _find_cifar10_tfrecords():
     from .tfrecord import has_cifar10_tfrecords
 

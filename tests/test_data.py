@@ -101,24 +101,32 @@ def test_convert_script_both_ways(tmp_path):
     np.testing.assert_array_equal(back_y, labels)
 
 
-def _train_and_eval_mnist(nb_steps, gar_name="krum", f=1, lr=0.1):
+def _train_and_eval(nb_steps, experiment="mnist", gar_name="krum", nb_workers=4,
+                    f=1, lr=0.1, batch_size=64, sync_every=25):
     import jax
     import optax
 
     from aggregathor_tpu import gars, models
     from aggregathor_tpu.parallel import RobustEngine, make_mesh
 
-    exp = models.instantiate("mnist", ["batch-size:64"])
-    engine = RobustEngine(make_mesh(nb_workers=4), gars.instantiate(gar_name, 4, f), 4)
+    exp = models.instantiate(experiment, ["batch-size:%d" % batch_size])
+    engine = RobustEngine(
+        make_mesh(nb_workers=nb_workers),
+        gars.instantiate(gar_name, nb_workers, f), nb_workers)
     tx = optax.sgd(lr)
     state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx)
     step = engine.build_step(exp.loss, tx)
-    it = exp.make_train_iterator(4, seed=0)
-    for _ in range(nb_steps):
-        state, _ = step(state, engine.shard_batch(next(it)))
+    it = exp.make_train_iterator(nb_workers, seed=0)
+    for i in range(nb_steps):
+        state, m = step(state, engine.shard_batch(next(it)))
+        if sync_every and i % sync_every == sync_every - 1:
+            # Bound the async dispatch queue: XLA:CPU's n-participant
+            # collective rendezvous (20 s deadline) starves on one core if
+            # hundreds of steps are left in flight.
+            jax.device_get(m["total_loss"])
     ev = engine.build_eval_sums(exp.metrics)
     sums = None
-    for batch in exp.make_eval_iterator(4):
+    for batch in exp.make_eval_iterator(nb_workers):
         folded = jax.device_get(ev(state, engine.shard_batch(batch)))
         sums = folded if sums is None else jax.tree_util.tree_map(lambda a, b: a + b, sums, folded)
     return float(sums["accuracy"][0]) / float(sums["accuracy"][1])
@@ -140,7 +148,7 @@ def test_mnist_accuracy_target_synthetic():
     # nearest mean under squared distance == argmax of the linear score
     scores = flat_test @ means.T - 0.5 * np.sum(means * means, axis=1)
     bayes_accuracy = float(np.mean(np.argmax(scores, axis=1) == data.y_test))
-    accuracy = _train_and_eval_mnist(300)
+    accuracy = _train_and_eval(300)
     assert bayes_accuracy > 0.3, "fixture degenerated: bayes %.3f" % bayes_accuracy
     assert accuracy >= 0.8 * bayes_accuracy, (
         "accuracy %.3f below 80%% of the %.3f near-optimal bar" % (accuracy, bayes_accuracy)
@@ -154,7 +162,7 @@ def test_mnist_accuracy_target_on_real_data():
     data = datasets.load_mnist()
     if data.synthetic:
         pytest.skip("no real mnist.npz on disk (synthetic stand-in active)")
-    accuracy = _train_and_eval_mnist(300)
+    accuracy = _train_and_eval(300)
     assert accuracy >= 0.9, "MNIST accuracy %.3f below target after 300 robust steps" % accuracy
 
 
@@ -249,3 +257,34 @@ def test_head_size_empty_split():
     assert _head_size(0, y, empty, "t") == 3
     assert _head_size(None, empty, empty, "t") == 1
     assert _head_size(7, empty, y, "t") == 7
+
+
+def test_digits_loads_real_data():
+    """The sklearn-bundled UCI digits are REAL data reachable with zero
+    egress (datasets.load_digits8x8) — the repo's real-accuracy anchor."""
+    pytest.importorskip("sklearn")
+    data = datasets.load_digits8x8()
+    assert not data.synthetic
+    assert data.x_train.shape == (1437, 8, 8, 1)
+    assert data.x_test.shape == (360, 8, 8, 1)
+    assert data.nb_classes == 10
+    # Pixels normalized from the 0..16 int range; both splits stratify all
+    # ten classes under the seeded shuffle.
+    assert 0.0 <= data.x_train.min() and data.x_train.max() <= 1.0
+    assert set(np.unique(data.y_test)) == set(range(10))
+    # Deterministic: same split on every load.
+    again = datasets.load_digits8x8()
+    np.testing.assert_array_equal(again.y_train, data.y_train)
+
+
+def test_digits_real_accuracy_under_krum():
+    """REAL-data accuracy target (VERDICT r3 task 9): the digits MLP under
+    Multi-Krum (n=8, f=2) must clear 85% real test accuracy in 300 steps
+    (it reaches ~96% at 4000 — see docs/robustness.md)."""
+    pytest.importorskip("sklearn")
+    from aggregathor_tpu import models
+
+    assert not models.instantiate("digits", []).dataset.synthetic
+    accuracy = _train_and_eval(
+        300, experiment="digits", nb_workers=8, f=2, batch_size=32)
+    assert accuracy > 0.85, "real digits accuracy %.3f below target" % accuracy
