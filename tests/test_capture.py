@@ -59,3 +59,57 @@ def test_run_guarded_success():
         [sys.executable, "-c", "print('hello')"], timeout=30
     )
     assert rc == 0 and "hello" in out
+
+
+def test_bench_partial_rows_do_not_retire_stage():
+    """bench.py emits an updated row after EVERY phase: an early partial
+    (wedge before the scanned/bf16 phases) is banked but must not mark the
+    stage done, or the remaining phases are never captured."""
+    partial = {"metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+               "detail": {"platform": "tpu", "per_step_dispatch": {}}}
+    mini = {"metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s_sizing_override",
+            "detail": {"platform": "tpu", "steps_per_s_resident_batch": 5.0}}
+    complete = {"metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+                "detail": {"platform": "tpu",
+                           "bfloat16": {"steps_per_s_resident_batch": 4.2}}}
+    fallback = {"metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s_cpu_fallback",
+                "detail": {"platform": "cpu",
+                           "bfloat16": {"steps_per_s_resident_batch": 1.0}}}
+    assert not tpu_capture._tpu_datum(partial)
+    assert not tpu_capture._tpu_datum(mini)
+    assert tpu_capture._tpu_datum(complete)
+    assert not tpu_capture._tpu_datum(fallback)
+
+
+def test_stage_table_shape():
+    """Stage entries are (name, argv, timeout[, extra_env]); bench_mini runs
+    first so a short up-window banks a real datum before heavier stages."""
+    stages = tpu_capture._stages(sys.executable)
+    assert stages[0][0] == "bench_mini"
+    names = [s[0] for s in stages]
+    assert names.index("pallas_check") < names.index("bench") < names.index("train_configs")
+    for entry in stages:
+        name, argv, timeout = entry[0], entry[1], entry[2]
+        assert isinstance(name, str) and argv[0] == sys.executable and timeout > 0
+        if len(entry) == 4:
+            assert all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in entry[3].items())
+        else:
+            assert len(entry) == 3
+
+
+def test_run_guarded_sigterm_lets_child_unwind():
+    """The watchdog TERMs before KILLing: a child with the graceful handler
+    gets to flush and exit cleanly (backend-connection teardown), and the
+    timeout error keeps the child's stderr trail (the BENCH_PHASE record of
+    WHICH phase wedged)."""
+    code = (
+        "import signal, sys, time;"
+        "signal.signal(signal.SIGTERM, lambda *_: (print('TERM_UNWOUND', flush=True), sys.exit(143)));"
+        "print('BENCH_PHASE 0.0s compile', file=sys.stderr, flush=True);"
+        "print('started', flush=True); time.sleep(300)"
+    )
+    rc, out, err = tpu_capture._run_guarded([sys.executable, "-c", code], timeout=25)
+    assert rc is None
+    assert "started" in out and "TERM_UNWOUND" in out
+    assert "timeout" in err and "BENCH_PHASE" in err
