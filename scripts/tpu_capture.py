@@ -249,7 +249,13 @@ def run_stage(name, argv, timeout, extra_env=None):
         "stage": name, "rc": rc, "elapsed_s": round(time.time() - t0, 1),
         "results": lines, "stderr_tail": err.strip()[-900:],
     })
-    return rc == 0 and any(_tpu_datum(r) for r in lines)
+    # Retire only on a COMPLETE capture: at least one real TPU row and no
+    # error rows.  A multi-config stage (train_configs --configs 2,2b,2c)
+    # where one config succeeds and another times out must re-run next
+    # window, or the failed configs are never captured; same for a
+    # gar_kernels sweep with a failing tier.
+    return (rc == 0 and any(_tpu_datum(r) for r in lines)
+            and not any(r.get("error") for r in lines))
 
 
 def main():

@@ -113,3 +113,15 @@ def test_run_guarded_sigterm_lets_child_unwind():
     assert rc is None
     assert "started" in out and "TERM_UNWOUND" in out
     assert "timeout" in err and "BENCH_PHASE" in err
+
+
+def test_stage_with_error_rows_does_not_retire(monkeypatch, tmp_path):
+    """A multi-config stage where one config succeeded and another errored
+    must NOT retire — the failed configs would otherwise never be captured."""
+    monkeypatch.setattr(tpu_capture, "LOG_PATH", str(tmp_path / "log.jsonl"))
+    ok = '{"platform": "tpu", "config": "2", "value": 1.0}'
+    bad = '{"platform": "tpu", "config": "2b", "value": null, "error": "timed out"}'
+    code = "print('%s'); print('%s')" % (ok, bad)
+    assert not tpu_capture.run_stage("x", [sys.executable, "-c", code], 30)
+    code_ok = "print('%s')" % ok
+    assert tpu_capture.run_stage("x", [sys.executable, "-c", code_ok], 30)
