@@ -428,3 +428,52 @@ def test_sharded_engine_reputation_quarantine(rng):
     assert rep[0] < 0.1, rep
     assert rep[1:].min() > 0.9, rep
     assert int(jax.device_get(metrics["nb_quarantined"])) == 1
+
+
+def test_code_corpus_real_text_lm():
+    """REAL-text LM anchor (the transformer-family analogue of the real
+    digits accuracy test): corpus-source:code trains on the Python stdlib's
+    own bytes with a held-out final-10% split, and 150 robust steps push
+    held-out nll decisively below the corpus's unigram entropy — context is
+    being used, which no uniform/Markov synthetic stream can demonstrate."""
+    from aggregathor_tpu import models
+    from aggregathor_tpu.parallel.engine import RobustEngine
+
+    exp = models.instantiate(
+        "transformer",
+        ["corpus-source:code", "corpus:500000", "d-model:32", "layers:1",
+         "seq:64", "batch-size:8", "heads:2"])
+    assert not exp.synthetic
+    assert exp.cfg.vocab_size == 256
+    assert len(exp.corpus) == 450000 and len(exp.eval_corpus) == 50000
+    # Deterministic assembly: a second instantiation sees identical bytes.
+    again = models.instantiate("transformer", ["corpus-source:code", "corpus:500000"])
+    np.testing.assert_array_equal(
+        np.concatenate([again.corpus, again.eval_corpus])[:450000], exp.corpus)
+
+    counts = np.bincount(exp.corpus, minlength=256).astype(np.float64)
+    p = counts / counts.sum()
+    p = p[p > 0]
+    unigram_nats = float(-(p * np.log(p)).sum())
+    assert unigram_nats > 2.5, "stdlib bytes should be far from uniform"
+
+    eng = RobustEngine(make_mesh(nb_workers=4), gars.instantiate("krum", 4, 1), 4)
+    tx = optax.adam(3e-3)
+    step = eng.build_step(exp.loss, tx)
+    state = eng.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    it = exp.make_train_iterator(4, seed=2)
+    for i in range(150):
+        state, m = step(state, eng.shard_batch(next(it)))
+        if i % 25 == 24:
+            jax.device_get(m["total_loss"])  # bound the async dispatch queue
+    ev = eng.build_eval_sums(exp.metrics)
+    sums = None
+    for b in exp.make_eval_iterator(4):
+        f = jax.device_get(ev(state, eng.shard_batch(b)))
+        sums = f if sums is None else jax.tree_util.tree_map(lambda a, b: a + b, sums, f)
+    nll = float(sums["nll"][0]) / float(sums["nll"][1])
+    # Calibrated: ~2.24 nats at these settings vs ~3.14 unigram; 0.95x the
+    # unigram bar leaves slack for backend jitter while still requiring
+    # genuinely sub-unigram (context-using) prediction.
+    assert nll < 0.95 * unigram_nats, (
+        "held-out nll %.3f not below unigram %.3f" % (nll, unigram_nats))
