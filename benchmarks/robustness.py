@@ -107,6 +107,12 @@ def main():
     ap.add_argument("--experiment", default="cnnet")
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--timeout", type=int, default=3600, help="per-cell seconds")
+    ap.add_argument("--resume-file", default=None,
+                    help="JSON path recording completed cells: a re-run skips "
+                         "them (and REPRINTS their rows, so the final "
+                         "invocation still emits the full table).  Lets a "
+                         "scarce TPU up-window make incremental progress "
+                         "instead of restarting the 12-cell grid each time.")
     ap.add_argument("--runner-args", default="",
                     help="extra flags appended to every runner invocation, as "
                          "ONE quoted string (argparse cannot nest leading "
@@ -114,12 +120,26 @@ def main():
     args = ap.parse_args()
     args.runner_args = shlex.split(args.runner_args)
 
+    sys.path.insert(0, REPO)
+    from aggregathor_tpu.utils.state import load_json, save_json_atomic
+
     rules = args.rules.split(",")
     attacks = args.attacks.split(",")
+    resume = load_json(args.resume_file) if args.resume_file else {}
     rows = []
     for rule, attack in itertools.product(rules, attacks):
-        row = run_cell(rule, attack, args.steps, args.batch, args.platform,
-                       args.timeout, args.experiment, extra_args=args.runner_args)
+        # EVERY measurement condition is in the key — a row cached under one
+        # platform/batch/runner-args must never answer for another.
+        key = "%s|%s|%s|%d|%d|%s|%s" % (
+            args.experiment, rule, attack, args.steps, args.batch,
+            args.platform or "ambient", " ".join(args.runner_args))
+        row = resume.get(key)
+        if row is None or row.get("error"):
+            row = run_cell(rule, attack, args.steps, args.batch, args.platform,
+                           args.timeout, args.experiment, extra_args=args.runner_args)
+            if args.resume_file and not row.get("error"):
+                resume[key] = row
+                save_json_atomic(args.resume_file, resume)
         rows.append(row)
         print(json.dumps(row), flush=True)
 

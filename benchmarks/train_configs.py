@@ -210,9 +210,23 @@ def main():
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--platform", default=None, help="platform for non-CPU configs (default ambient)")
     ap.add_argument("--timeout", type=int, default=1200)
+    ap.add_argument("--resume-file", default=None,
+                    help="JSON path recording completed configs: a re-run "
+                         "skips them (and reprints their rows) so a scarce "
+                         "TPU up-window resumes instead of restarting the "
+                         "whole sweep.")
     args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    from aggregathor_tpu.utils.state import load_json, save_json_atomic
+
+    resume = load_json(args.resume_file) if args.resume_file else {}
     for key in args.configs.split(","):
         key = key.strip()
+        rkey = "%s|%d|%s" % (key, args.steps, args.platform or "ambient")
+        result = resume.get(rkey)
+        if result is not None and not result.get("error"):
+            print(json.dumps(result), flush=True)
+            continue
         # One hung config (e.g. a wedged accelerator) or a bad key must not
         # abort the sweep: every requested config gets exactly one JSON line.
         try:
@@ -223,6 +237,9 @@ def main():
         except subprocess.TimeoutExpired:
             result = {"metric": "train_steps_per_s", "config": CONFIGS[key]["name"],
                       "value": None, "error": "timed out after %ds" % args.timeout}
+        if args.resume_file and not result.get("error"):
+            resume[rkey] = result
+            save_json_atomic(args.resume_file, resume)
         print(json.dumps(result), flush=True)
 
 

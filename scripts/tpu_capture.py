@@ -59,6 +59,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STATE_PATH = os.path.join(REPO, "scripts", "tpu_capture_state.json")
 LOG_PATH = os.path.join(REPO, "benchmarks", "tpu_capture.jsonl")
 
+sys.path.insert(0, REPO)
+from aggregathor_tpu.utils.state import load_json, save_json_atomic  # noqa: E402
+
 PROBE_CODE = (
     "import jax, jax.numpy as jnp;"
     "x = jnp.ones((512, 512), jnp.float32);"
@@ -91,13 +94,16 @@ def _stages(py):
            "--dims", "65536,1048576,8388608", "--reps", "10"), 3600),
         ("train_configs",
          b("benchmarks/train_configs.py", "--configs", "2,2b,2c",
-           "--steps", "40", "--platform", "tpu", "--timeout", "1200"), 4200),
+           "--steps", "40", "--platform", "tpu", "--timeout", "1200",
+           "--resume-file", "benchmarks/resume_train_configs.json"), 4200),
         ("train_configs34",
          b("benchmarks/train_configs.py", "--configs", "3,3k,4",
-           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 6000),
+           "--steps", "10", "--platform", "tpu", "--timeout", "1800",
+           "--resume-file", "benchmarks/resume_train_configs34.json"), 6000),
         ("leaf_resnet",
          b("benchmarks/train_configs.py", "--configs", "6,6u",
-           "--steps", "10", "--platform", "tpu", "--timeout", "1800"), 4200),
+           "--steps", "10", "--platform", "tpu", "--timeout", "1800",
+           "--resume-file", "benchmarks/resume_leaf_resnet.json"), 4200),
         ("trace",
          b("benchmarks/train_configs.py", "--configs", "2t",
            "--steps", "40", "--platform", "tpu", "--timeout", "1500"), 1800),
@@ -116,23 +122,17 @@ def _stages(py):
         ("robustness",
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
            "--batch", "32", "--rules", "average,krum,median,dnc",
-           "--platform", "tpu", "--timeout", "600"), 8400),
+           "--platform", "tpu", "--timeout", "600",
+           "--resume-file", "benchmarks/resume_robustness.json"), 8400),
     ]
 
 
 def _load_state():
-    try:
-        with open(STATE_PATH) as fd:
-            return json.load(fd)
-    except (OSError, ValueError):
-        return {"done": []}
+    return load_json(STATE_PATH, default={"done": []})
 
 
 def _save_state(state):
-    tmp = STATE_PATH + ".tmp"
-    with open(tmp, "w") as fd:
-        json.dump(state, fd, indent=1)
-    os.replace(tmp, STATE_PATH)
+    save_json_atomic(STATE_PATH, state)
 
 
 def _log(record):
@@ -274,6 +274,16 @@ def main():
     if args.fresh:
         state = {"done": []}
         _save_state(state)
+        # A fresh capture must also forget the children's per-cell resume
+        # caches, or the "re-measured" stages would just reprint stale rows.
+        for entry in stages:
+            argv = entry[1]
+            if "--resume-file" in argv:
+                path = os.path.join(REPO, argv[argv.index("--resume-file") + 1])
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     while True:
         todo = [s for s in stages if s[0] not in state["done"]]
