@@ -13,7 +13,7 @@ import numpy as np
 
 from ..utils import parse_keyval
 from . import register
-from .datasets import WorkerBatchIterator
+from .datasets import WorkerBatchIterator, load_digits8x8
 from .mnist import MNISTExperiment
 
 
@@ -42,3 +42,17 @@ class MNISTAttackExperiment(MNISTExperiment):
 
 
 register("mnistAttack", MNISTAttackExperiment)
+
+
+class DigitsAttackExperiment(MNISTAttackExperiment):
+    """The same data-poisoning stream over REAL data (sklearn digits):
+    clean-eval accuracy after training on a severity-2 poisoned cluster
+    collapses to chance on a real corpus, not just on the synthetic
+    stand-in — the reference's mnistAttack failure-mode demonstration
+    (experiments/mnistAttack.py:51-92) with a real measurement."""
+
+    sample_shape = (8, 8, 1)
+    load_dataset = staticmethod(load_digits8x8)
+
+
+register("digitsAttack", DigitsAttackExperiment)

@@ -166,3 +166,27 @@ def test_bad_dtype_rejected_at_init():
             models.instantiate("cnnet", ["dtype:%s" % bad])
     with pytest.raises(UserException):
         models.instantiate("slim-resnet_v1_18-cifar10", ["dtype:bf16"])
+
+
+def test_digits_attack_poisons_real_stream():
+    """digitsAttack = the reference's mnistAttack failure-mode demo over
+    REAL data: the training stream is poisoned (severity 2 destroys the
+    input/label correspondence at 1e12 scale), eval stays clean.  Measured
+    through the CLI: severity 2 diverges within steps, severity 1 pins
+    clean accuracy at chance (docs/robustness.md)."""
+    pytest.importorskip("sklearn")
+    from aggregathor_tpu import models
+
+    exp = models.instantiate("digitsAttack", ["batch-size:8"])
+    assert not exp.dataset.synthetic
+    it = exp.make_train_iterator(2, seed=0)
+    batch = next(it)
+    # severity 2: inputs blown up to 1e12 scale, labels shuffled away from
+    # their images (the clean stream is in [0, 1])
+    assert float(np.max(np.abs(batch["image"]))) > 1e10
+    # eval stream stays CLEAN real data
+    eval_batch = next(iter(exp.make_eval_iterator(2)))
+    assert float(np.max(eval_batch["image"])) <= 1.0
+    sev1 = models.instantiate("digitsAttack", ["batch-size:8", "severity:1"])
+    b1 = next(sev1.make_train_iterator(2, seed=0))
+    assert float(np.min(b1["image"])) >= -100.0 and float(np.max(b1["image"])) <= 0.0
