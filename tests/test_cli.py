@@ -457,3 +457,24 @@ def test_runner_sharded_mesh_full_composition(tmp_path):
     assert all("total_loss" in ev for ev in events)
     assert any("worker_reputation" in ev for ev in events)
     assert any("nb_quarantined" in ev for ev in events)
+
+
+def test_runner_digits_real_data_end_to_end(tmp_path):
+    """The real-data experiment through the full CLI: 120 steps of Multi-Krum
+    on the sklearn digits corpus must clear 60% REAL test accuracy in the
+    eval TSV (reaches 0.96 at 4000 steps — docs/robustness.md)."""
+    pytest.importorskip("sklearn")
+    eval_file = str(tmp_path / "eval.tsv")
+    assert 0 == run([
+        "--experiment", "digits", "--experiment-args", "batch-size:32",
+        "--aggregator", "krum",
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--max-step", "120",
+        "--learning-rate-args", "initial-rate:0.1",
+        "--evaluation-delta", "120", "--evaluation-period", "-1",
+        "--evaluation-file", eval_file,
+    ])
+    lines = [l.split("\t") for l in open(eval_file).read().strip().splitlines()]
+    assert int(lines[-1][1]) == 120
+    metrics = dict(kv.split(":", 1) for kv in lines[-1][2:])
+    assert float(metrics["accuracy"]) > 0.6, metrics
