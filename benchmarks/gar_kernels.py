@@ -65,6 +65,11 @@ def main():
     )
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--platform", default=None, help="force a JAX platform")
+    ap.add_argument("--resume-file", default=None,
+                    help="JSON path recording completed (rule, tier, d) "
+                         "cells: a re-run skips them (and reprints their "
+                         "rows) so a scarce TPU up-window resumes the sweep "
+                         "instead of restarting it.")
     args = ap.parse_args()
 
     if args.platform:
@@ -78,21 +83,47 @@ def main():
     from aggregathor_tpu import gars
     from aggregathor_tpu.ops import native
 
+    from aggregathor_tpu.utils.state import load_json, save_json_atomic
+
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     native_ok = native.available()
-    rng = np.random.default_rng(0)
     rules = args.rules.split(",")
     dims = [int(d) for d in args.dims.split(",")]
     rows = []
+    resume = load_json(args.resume_file) if args.resume_file else {}
+
+    def measured(rule, tier, d, f, thunk):
+        """The cell's ms: from the resume cache, or measured via thunk()."""
+        key = "%s|%s|%d|%d|%d|%d" % (rule, tier, d, args.n, args.f, args.reps)
+        ms = resume.get(key)
+        if ms is None:
+            ms = thunk()
+            if args.resume_file:
+                resume[key] = ms
+                save_json_atomic(args.resume_file, resume)
+        rows.append((rule, tier, d, ms, f))
 
     _first = jax.jit(lambda x: x.ravel()[0])
     dev_sync = lambda out: float(_first(out))  # real sync: host fetch
     host_sync = lambda out: out  # native tier is synchronous already
 
     for d in dims:
-        g_host = rng.normal(size=(args.n, d)).astype(np.float32)
-        g_dev = jax.device_put(g_host)
+        # The d=8.4M fixture is ~1 GB of random floats; build it LAZILY so
+        # a fully-cached d costs neither the generation nor the device
+        # transfer.  Seeded per-d, so laziness never changes the values.
+        fixture = {}
+
+        def g_host(d=d, fixture=fixture):
+            if "host" not in fixture:
+                fixture["host"] = np.random.default_rng(d).normal(
+                    size=(args.n, d)).astype(np.float32)
+            return fixture["host"]
+
+        def g_dev(fixture=fixture):
+            if "dev" not in fixture:
+                fixture["dev"] = jax.device_put(g_host())
+            return fixture["dev"]
 
         for rule in rules:
             # Bulyan's bound is n >= 4f + 3; clamp f so every rule runs at
@@ -101,25 +132,25 @@ def main():
             # jit tier
             gar = gars.instantiate(rule, args.n, f)
             agg = jax.jit(gar.aggregate)
-            ms = time_fn(lambda: agg(g_dev), dev_sync, args.reps)
-            rows.append((rule, "jnp:" + platform, d, ms, f))
+            measured(rule, "jnp:" + platform, d, f,
+                     lambda: time_fn(lambda: agg(g_dev()), dev_sync, args.reps))
 
             # pallas tier (TPU only)
             if on_tpu and (rule + "-pallas") in gars.itemize():
                 pgar = gars.instantiate(rule + "-pallas", args.n, f)
                 pagg = jax.jit(pgar.aggregate)
-                ms = time_fn(lambda: pagg(g_dev), dev_sync, args.reps)
-                rows.append((rule, "pallas", d, ms, f))
+                measured(rule, "pallas", d, f,
+                         lambda: time_fn(lambda: pagg(g_dev()), dev_sync, args.reps))
 
             # native host tier
             if native_ok and hasattr(native, rule.replace("-", "_")):
                 nfn = getattr(native, rule.replace("-", "_"))
                 if rule in ("krum", "bulyan", "averaged-median"):
-                    call = lambda nfn=nfn, f=f: nfn(g_host, f)
+                    call = lambda nfn=nfn, f=f: nfn(g_host(), f)
                 else:
-                    call = lambda nfn=nfn: nfn(g_host)
-                ms = time_fn(call, host_sync, max(3, args.reps // 4))
-                rows.append((rule, "native", d, ms, f))
+                    call = lambda nfn=nfn: nfn(g_host())
+                measured(rule, "native", d, f,
+                         lambda: time_fn(call, host_sync, max(3, args.reps // 4)))
 
     print("%-18s %-12s %12s %12s" % ("rule", "tier", "d", "ms"))
     for rule, tier, d, ms, f in rows:

@@ -91,7 +91,8 @@ def _stages(py):
         ("bench", b("bench.py"), 2200),
         ("gar_kernels",
          b("benchmarks/gar_kernels.py", "--n", "32", "--f", "8",
-           "--dims", "65536,1048576,8388608", "--reps", "10"), 3600),
+           "--dims", "65536,1048576,8388608", "--reps", "10",
+           "--resume-file", "benchmarks/resume_gar_kernels.json"), 3600),
         ("train_configs",
          b("benchmarks/train_configs.py", "--configs", "2,2b,2c",
            "--steps", "40", "--platform", "tpu", "--timeout", "1200",
