@@ -127,3 +127,53 @@ def test_pallas_krum_rejects_outlier():
     # The selected-subset mean differs from the full honest mean by O(1);
     # what matters is the attacker (distance ~1e6·sqrt(d)) was excluded.
     assert np.linalg.norm(out - honest) < 1e-3 * np.linalg.norm(g[0] - honest)
+
+
+# Tile-boundary shapes: d exactly one lane tile (128), an exact multiple,
+# and one past the boundary — the shapes where Mosaic block specs and the
+# grid iteration must agree (ops/pallas_kernels.py block_d handling); plus
+# the n=2 minimum.
+TILE_CASES = [
+    dict(n=2, d=128, seed=10, nan_frac=0.0),
+    dict(n=9, d=256, seed=11, nan_frac=0.1),
+    dict(n=8, d=129, seed=12, nan_frac=0.0),
+    dict(n=3, d=384, seed=13, nan_frac=0.3),
+]
+
+
+@pytest.mark.parametrize("case", TILE_CASES)
+def test_coordinate_kernels_at_tile_boundaries(case):
+    g = _rand(**case)
+    np.testing.assert_allclose(
+        np.asarray(pk.coordinate_median(g, block_d=128)), oracle.median(g),
+        rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(pk.average_nan_columns(g, block_d=128)), oracle.average_nan(g),
+        rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("use_mxu", [False, True])
+@pytest.mark.parametrize("d", [128, 129, 256])
+def test_pairwise_distances_at_tile_boundaries(use_mxu, d):
+    g = _rand(6, d, 14)
+    out = np.array(pk.pairwise_sq_distances(g, block_d=128, use_mxu=use_mxu))
+    ref = oracle._pairwise_sq_distances(g.astype(np.float64))
+    np.fill_diagonal(out, 0.0)
+    tol = 1e-4 if use_mxu else 1e-5
+    np.testing.assert_allclose(out, ref, rtol=tol, atol=tol)
+
+
+def test_pallas_krum_excludes_fully_nan_row_like_jnp():
+    """A worker whose whole row is NaN (total datagram loss) must be treated
+    identically by the pallas and jnp tiers: excluded from selection, finite
+    aggregate out."""
+    import jax.numpy as jnp
+
+    from aggregathor_tpu import gars
+
+    g = _rand(9, 160, 15)
+    g[2, :] = np.nan
+    a = np.asarray(gars.instantiate("krum", 9, 2).aggregate(jnp.asarray(g)))
+    b = np.asarray(gars.instantiate("krum-pallas", 9, 2).aggregate(jnp.asarray(g)))
+    assert np.all(np.isfinite(a)) and np.all(np.isfinite(b))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
