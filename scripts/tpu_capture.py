@@ -16,6 +16,9 @@ the strategy:
 
 Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
 
+  bench_mini      — config-2 at full batch but a short scan (K=10 via
+                    GRAFT_BENCH_SIZING): first, so a ~10 min up-window
+                    still banks a real TPU training datum with MFU
   bench           — headline config-2 steps/s (bench.py, own watchdog)
   pallas_check    — Pallas kernels compiled on silicon, parity + ms
                     (scripts/pallas_tpu_check.py)
