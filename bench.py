@@ -451,6 +451,32 @@ def _attempt(args, timeout):
     return result
 
 
+def _last_banked_tpu_row():
+    """Newest config-2 TPU row banked by the capture watcher, or None.
+
+    Scans benchmarks/tpu_capture.jsonl (stage records carry a ``results``
+    list) for rows of this bench's metric family measured on TPU, returning
+    the latest one with the record's timestamp attached."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "tpu_capture.jsonl")
+    newest = None
+    try:
+        with open(path) as fd:
+            for line in fd:
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue
+                for row in record.get("results", ()):
+                    detail = row.get("detail") or {}
+                    if (str(row.get("metric", "")).startswith("cnnet_cifar10_multikrum")
+                            and detail.get("platform") == "tpu"):
+                        newest = {"ts": record.get("ts"), "row": row}
+    except OSError:
+        return None
+    return newest
+
+
 def main(cpu_only=False):
     result = None
     if not cpu_only:
@@ -470,6 +496,15 @@ def main(cpu_only=False):
                 print("bench: accelerator attempt unusable, falling back to CPU", file=sys.stderr)
     if result is None:
         result = _attempt(["--child", "--cpu"], timeout=480)
+        if result is not None:
+            banked = _last_banked_tpu_row()
+            if banked is not None:
+                # The chip is down NOW, but the up-window watcher
+                # (scripts/tpu_capture.py) may have banked a real TPU
+                # capture earlier — surface it so the driver-recorded JSON
+                # carries the TPU evidence, clearly labeled as a banked
+                # capture, not this run's measurement.
+                result.setdefault("detail", {})["last_banked_tpu_capture"] = banked
     if result is None:
         result = {
             "metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
