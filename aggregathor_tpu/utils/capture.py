@@ -1,0 +1,33 @@
+"""Shared predicate: is a benchmark result row a COMPLETE TPU capture?
+
+Two consumers must agree on this or they diverge (they did, once): the
+up-window watcher (scripts/tpu_capture.py) uses it to decide stage
+retirement, and bench.py uses it to pick which banked row to surface as
+TPU evidence when the chip is down at measurement time.
+"""
+
+
+def is_complete_tpu_datum(row):
+    """True iff ``row`` is a real, complete TPU-captured number.
+
+    A harness may exit 0 yet carry only CPU-fallback, error, or
+    phase-partial rows (bench.py emits an updated row after EVERY phase) —
+    those must not count as a finished capture.
+    """
+    if row.get("error"):
+        return False
+    detail = row.get("detail") or {}
+    platform = row.get("platform") or detail.get("platform") or ""
+    if str(row.get("metric", "")).startswith("cnnet_cifar10_multikrum"):
+        # bench.py rows: complete only once the LAST phase (the bf16
+        # secondary's resident rate) has been written.
+        return (platform == "tpu"
+                and bool((detail.get("bfloat16") or {}).get("steps_per_s_resident_batch")))
+    if platform:
+        return platform == "tpu"
+    tier = row.get("tier", "")
+    if tier:  # gar_kernels rows carry a tier, not a platform
+        return tier == "pallas" or tier.endswith(":tpu")
+    if row.get("metric") == "pallas_tpu_check":  # script itself exits 2 off-TPU
+        return row.get("parity") == "ok"
+    return False

@@ -455,11 +455,16 @@ def _last_banked_tpu_row():
     """Newest config-2 TPU row banked by the capture watcher, or None.
 
     Scans benchmarks/tpu_capture.jsonl (stage records carry a ``results``
-    list) for rows of this bench's metric family measured on TPU, returning
-    the latest one with the record's timestamp attached."""
+    list) for rows of this bench's metric family measured on TPU.  A row
+    that passes the shared completeness predicate (the same one the watcher
+    uses for stage retirement — aggregathor_tpu/utils/capture.py) always
+    wins over a phase-partial or mini-sizing row; a partial is surfaced
+    only when no complete capture exists, and is labeled as such."""
+    from aggregathor_tpu.utils.capture import is_complete_tpu_datum
+
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benchmarks", "tpu_capture.jsonl")
-    newest = None
+    newest_complete = newest_partial = None
     try:
         with open(path) as fd:
             for line in fd:
@@ -470,11 +475,16 @@ def _last_banked_tpu_row():
                 for row in record.get("results", ()):
                     detail = row.get("detail") or {}
                     if (str(row.get("metric", "")).startswith("cnnet_cifar10_multikrum")
-                            and detail.get("platform") == "tpu"):
-                        newest = {"ts": record.get("ts"), "row": row}
+                            and detail.get("platform") == "tpu"
+                            and not row.get("error")):
+                        banked = {"ts": record.get("ts"), "row": row}
+                        if is_complete_tpu_datum(row):
+                            newest_complete = banked
+                        else:
+                            newest_partial = dict(banked, partial=True)
     except OSError:
         return None
-    return newest
+    return newest_complete or newest_partial
 
 
 def main(cpu_only=False):

@@ -64,6 +64,7 @@ LOG_PATH = os.path.join(REPO, "benchmarks", "tpu_capture.jsonl")
 
 sys.path.insert(0, REPO)
 from aggregathor_tpu.utils.state import load_json, save_json_atomic  # noqa: E402
+from aggregathor_tpu.utils.capture import is_complete_tpu_datum as _tpu_datum  # noqa: E402
 
 PROBE_CODE = (
     "import jax, jax.numpy as jnp;"
@@ -199,35 +200,6 @@ def probe(timeout=100):
     for line in out.splitlines():
         if line.startswith("PROBE_OK"):
             return line.strip().split()[-1] == "tpu"
-    return False
-
-
-def _tpu_datum(row):
-    """True iff this result row is a real TPU-captured number.
-
-    A stage may exit 0 yet carry only CPU-fallback or error rows (bench.py's
-    fallback contract; train_configs' per-config timeout rows) — those must
-    NOT retire the stage, or the scarce next up-window skips it forever.
-    """
-    if row.get("error"):
-        return False
-    detail = row.get("detail") or {}
-    platform = row.get("platform") or detail.get("platform") or ""
-    if str(row.get("metric", "")).startswith("cnnet_cifar10_multikrum"):
-        # bench.py emits an updated row after EVERY phase; an early partial
-        # (e.g. per-step dispatch only, wedge before the scanned/bf16
-        # phases) is banked in the log but must NOT retire the stage, or
-        # the remaining phases are never captured.  Completeness marker:
-        # the bf16 secondary's resident rate is the LAST field written.
-        return (platform == "tpu"
-                and bool((detail.get("bfloat16") or {}).get("steps_per_s_resident_batch")))
-    if platform:
-        return platform == "tpu"
-    tier = row.get("tier", "")
-    if tier:  # gar_kernels rows carry a tier, not a platform
-        return tier == "pallas" or tier.endswith(":tpu")
-    if row.get("metric") == "pallas_tpu_check":  # script itself exits 2 off-TPU
-        return row.get("parity") == "ok"
     return False
 
 
