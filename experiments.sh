@@ -80,8 +80,11 @@ function run_sharded {
 # Begin experiments (reference default: run mnist average 2 0 50 100000)
 run mnist average 2 0 50 10000
 # Extras this framework adds over the reference (uncomment to run):
+#   REAL data with zero egress — sklearn digits to ~96% under Multi-Krum
+#   (docs/robustness.md "Measured on REAL data"):
+# run digits krum 8 2 32 4000
 #   per-layer Krum on the dp x pp x tp transformer (BASELINE config 5):
 # run_sharded transformer krum 4 2 1 1 16 1000
 #   accuracy-under-attack sweep (docs/robustness.md):
-# python3 benchmarks/robustness.py --experiment mnist --steps 500 --batch 32
+# python3 benchmarks/robustness.py --experiment digits --steps 500 --batch 32
 # End experiments
