@@ -29,3 +29,37 @@ def test_can_access_write_only_check(tmp_path):
     f = tmp_path / "w.txt"
     f.write_text("")
     assert can_access(str(f), write=True)
+
+
+def test_state_json_roundtrip_and_corruption(tmp_path):
+    """utils/state: atomic save + tolerant load (the watcher's children are
+    routinely killed mid-write; a half-written or non-dict file must read
+    as the default, never raise)."""
+    from aggregathor_tpu.utils.state import load_json, save_json_atomic
+
+    path = str(tmp_path / "s.json")
+    assert load_json(path) == {}
+    assert load_json(path, default={"done": []}) == {"done": []}
+    save_json_atomic(path, {"a": 1})
+    assert load_json(path) == {"a": 1}
+    with open(path, "w") as fd:
+        fd.write('{"a": 1')  # truncated by a kill mid-write
+    assert load_json(path) == {}
+    with open(path, "w") as fd:
+        fd.write('[1, 2]')  # valid JSON, wrong top-level type
+    assert load_json(path, default={"done": []}) == {"done": []}
+
+
+def test_capture_completeness_predicate():
+    """utils/capture: the shared stage-retirement / banked-row predicate."""
+    from aggregathor_tpu.utils.capture import is_complete_tpu_datum
+
+    assert is_complete_tpu_datum(
+        {"metric": "cnnet_cifar10_multikrum_x", "detail": {
+            "platform": "tpu", "bfloat16": {"steps_per_s_resident_batch": 4.0}}})
+    assert not is_complete_tpu_datum(
+        {"metric": "cnnet_cifar10_multikrum_x", "detail": {"platform": "tpu"}})
+    assert not is_complete_tpu_datum({"platform": "tpu", "error": "timed out"})
+    assert is_complete_tpu_datum({"platform": "tpu", "value": 1.0})
+    assert is_complete_tpu_datum({"tier": "pallas", "value": 1.0})
+    assert not is_complete_tpu_datum({"tier": "native", "value": 1.0})
