@@ -24,6 +24,8 @@ Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
                     (scripts/pallas_tpu_check.py)
   gar_kernels     — per-rule kernel ms vs d, jnp:tpu + pallas tiers
   train_configs   — configs 2, 2b, 2c through the real CLI on TPU
+  opt_sweep       — unroll x dtype x augment x input ladder on config 2
+                    (the VERDICT-r3 task-3 optimizer; per-combo resumable)
   train_configs34 — configs 3 (ResNet-50+Bulyan n=32 f=7 — BASELINE's f=8
                     violates Bulyan's n >= 4f+3 bound), 3k (ResNet-50+Krum
                     at the prescribed n=32 f=8) and 4 (Inception-v3+median
@@ -101,6 +103,15 @@ def _stages(py):
          b("benchmarks/train_configs.py", "--configs", "2,2b,2c",
            "--steps", "40", "--platform", "tpu", "--timeout", "1200",
            "--resume-file", "benchmarks/resume_train_configs.json"), 4200),
+        # The VERDICT-r3 task-3 optimizer: sweep unroll x dtype x augment x
+        # input sourcing on the real config-2 program; per-combo resumable,
+        # one row per combination plus opt_sweep_best (trainable) and
+        # opt_sweep_best_compute (resident upper bound) summary rows.
+        # AFTER the unique evidence cells (pallas/bench/gar/train_configs):
+        # optimization must not cost pending evidence its up-window.
+        ("opt_sweep",
+         b("benchmarks/opt_sweep.py", "--platform", "tpu", "--steps", "60",
+           "--resume-file", "benchmarks/resume_opt_sweep.json"), 4800),
         ("train_configs34",
          b("benchmarks/train_configs.py", "--configs", "3,3k,4",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800",
