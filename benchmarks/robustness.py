@@ -117,6 +117,11 @@ def main():
                     help="extra flags appended to every runner invocation, as "
                          "ONE quoted string (argparse cannot nest leading "
                          "dashes): --runner-args '--worker-momentum 0.9'")
+    ap.add_argument("--seeds", default=None,
+                    help="comma list of --seed values; each cell runs once "
+                         "per seed and the table reports mean ± half-range "
+                         "(the docs/robustness.md multi-seed protocol). "
+                         "Default: single run at the runner's default seed.")
     args = ap.parse_args()
     args.runner_args = shlex.split(args.runner_args)
 
@@ -125,36 +130,49 @@ def main():
 
     rules = args.rules.split(",")
     attacks = args.attacks.split(",")
+    seeds = args.seeds.split(",") if args.seeds else [None]
     resume = load_json(args.resume_file) if args.resume_file else {}
     rows = []
     for rule, attack in itertools.product(rules, attacks):
-        # EVERY measurement condition is in the key — a row cached under one
-        # platform/batch/runner-args must never answer for another.
-        key = "%s|%s|%s|%d|%d|%s|%s" % (
-            args.experiment, rule, attack, args.steps, args.batch,
-            args.platform or "ambient", " ".join(args.runner_args))
-        row = resume.get(key)
-        if row is None or row.get("error"):
-            row = run_cell(rule, attack, args.steps, args.batch, args.platform,
-                           args.timeout, args.experiment, extra_args=args.runner_args)
-            if args.resume_file and not row.get("error"):
-                resume[key] = row
-                save_json_atomic(args.resume_file, resume)
-        rows.append(row)
-        print(json.dumps(row), flush=True)
+        per_seed = []
+        for seed in seeds:
+            extra = args.runner_args + (["--seed", seed] if seed is not None else [])
+            # EVERY measurement condition is in the key — a row cached under
+            # one platform/batch/seed/runner-args must never answer for
+            # another.
+            key = "%s|%s|%s|%d|%d|%s|%s" % (
+                args.experiment, rule, attack, args.steps, args.batch,
+                args.platform or "ambient", " ".join(extra))
+            row = resume.get(key)
+            if row is None or row.get("error"):
+                row = run_cell(rule, attack, args.steps, args.batch, args.platform,
+                               args.timeout, args.experiment, extra_args=extra)
+                if seed is not None:
+                    row["seed"] = seed
+                if args.resume_file and not row.get("error"):
+                    resume[key] = row
+                    save_json_atomic(args.resume_file, resume)
+            per_seed.append(row)
+            print(json.dumps(row), flush=True)
+        rows.append((rule, attack, per_seed))
 
     print("\n| rule | " + " | ".join(attacks) + " |")
     print("|------|" + "---|" * len(attacks))
     for rule in rules:
         cells = []
         for attack in attacks:
-            row = next(r for r in rows if r["rule"] == rule and r["attack"] == attack)
-            if row.get("diverged"):
+            per_seed = next(ps for r, a, ps in rows if r == rule and a == attack)
+            if any(r.get("diverged") for r in per_seed):
                 cells.append("diverged (NaN abort)")
-            elif row.get("accuracy") is None:
-                cells.append(row.get("error", "error"))
+                continue
+            accs = [r["accuracy"] for r in per_seed if r.get("accuracy") is not None]
+            if not accs:
+                cells.append(per_seed[0].get("error", "error"))
+            elif len(accs) == 1:
+                cells.append("%.3f" % accs[0])
             else:
-                cells.append("%.3f" % row["accuracy"])
+                cells.append("%.3f ± %.3f" % (
+                    sum(accs) / len(accs), (max(accs) - min(accs)) / 2))
         print("| %s | %s |" % (rule, " | ".join(cells)))
 
 
