@@ -125,3 +125,18 @@ def test_stage_with_error_rows_does_not_retire(monkeypatch, tmp_path):
     assert not tpu_capture.run_stage("x", [sys.executable, "-c", code], 30)
     code_ok = "print('%s')" % ok
     assert tpu_capture.run_stage("x", [sys.executable, "-c", code_ok], 30)
+
+
+def test_run_stage_delivers_extra_env(monkeypatch, tmp_path):
+    """bench_mini works only if the stage's extra_env (GRAFT_BENCH_SIZING)
+    actually reaches the child on top of the inherited environment."""
+    monkeypatch.setattr(tpu_capture, "LOG_PATH", str(tmp_path / "log.jsonl"))
+    code = ("import os, json;"
+            "print(json.dumps({'platform': 'tpu', 'sizing': os.environ.get('GRAFT_BENCH_SIZING'),"
+            " 'inherited_path': bool(os.environ.get('PATH'))}))")
+    assert tpu_capture.run_stage(
+        "x", [sys.executable, "-c", code], 30, {"GRAFT_BENCH_SIZING": "128,10,3"})
+    logged = [l for l in open(str(tmp_path / "log.jsonl"))]
+    import json as _json
+    row = _json.loads(logged[-1])["results"][0]
+    assert row["sizing"] == "128,10,3" and row["inherited_path"] is True
