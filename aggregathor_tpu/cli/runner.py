@@ -118,6 +118,17 @@ def build_parser():
              "chunks already amortize the input cost)",
     )
     parser.add_argument(
+        "--input-source", default="stream", choices=["stream", "device"],
+        help="stream: per-step host batches (the reference's input path, "
+             "runner.py:562-576). device: hold the training split on the "
+             "accelerator (transferred once) and gather each worker's fresh "
+             "i.i.d. batch in-graph — removes the per-step host->device "
+             "transfer that bounds a tunneled TPU (measured r4: config 2 at "
+             "2.0 steps/s streamed vs 26 resident); needs an experiment "
+             "exposing train_arrays() (no host-side transform) and the flat "
+             "engine, single process",
+    )
+    parser.add_argument(
         "--backend-timeout", type=float, default=300.0, metavar="SECONDS",
         help="fail loudly if the accelerator backend does not initialize in "
              "this many seconds (a wedged chip otherwise hangs forever); "
@@ -266,6 +277,14 @@ def main(argv=None):
                 continue
     else:
         effective_platform = os.environ.get("JAX_PLATFORMS", "")
+        if effective_platform:
+            # Mirror the env var at the config level: the env filter alone
+            # is applied AFTER accelerator-plugin discovery, and a wedged
+            # tunneled plugin can hang that discovery forever (measured r4:
+            # ``JAX_PLATFORMS=cpu jax.devices()`` blocked indefinitely while
+            # the TPU tunnel was wedged; with the config update it returned
+            # the CPU immediately).
+            jax.config.update("jax_platforms", effective_platform)
         if effective_platform == "cpu" and want_cpu_devices():
             jax.config.update("jax_num_cpu_devices", requested_devices)
 
@@ -364,10 +383,17 @@ def main(argv=None):
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
         tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
 
+        device_dataset = None
         if mesh_axes is not None:
             # ---- fully-sharded engine (per-layer GAR on sharded grads) ----
             from ..parallel.sharded_engine import ShardedRobustEngine
 
+            if args.input_source == "device":
+                raise UserException(
+                    "--input-source device needs the flat engine (the sharded "
+                    "engine's batches flow through the pipeline stages); drop "
+                    "--mesh or use --input-source stream"
+                )
             if not getattr(experiment, "supports_sharded", False):
                 raise UserException(
                     "Experiment %r does not publish sharded hooks (sharded_init/"
@@ -448,7 +474,31 @@ def main(argv=None):
             state = engine.init_state(params, tx, seed=args.seed)
             step_fn = engine.build_step(loss_fn, tx)
             unroll = max(1, args.unroll)
-            multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
+            if args.input_source == "device":
+                if jax.process_count() > 1:
+                    raise UserException(
+                        "--input-source device is single-process for now: "
+                        "replicating the dataset would device_put onto "
+                        "non-addressable devices; use --input-source stream"
+                    )
+                arrays = experiment.train_arrays()
+                if arrays is None:
+                    raise UserException(
+                        "--input-source device: experiment %r keeps a host-side "
+                        "batch transform or a streaming corpus (train_arrays() "
+                        "is None), so an in-graph gather cannot reproduce its "
+                        "input stream; use --input-source stream" % args.experiment
+                    )
+                # The whole train split lives on the accelerator; the
+                # unrolled branch dispatches the in-graph sampling trainer
+                # (one scan per chunk, zero per-step host transfer).
+                device_dataset = engine.replicate(arrays)
+                multi_fn = engine.build_sampled_multi_step(
+                    loss_fn, tx, repeat_steps=unroll,
+                    batch_size=experiment.batch_size,
+                )
+            else:
+                multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
             eval_fn = engine.build_eval_sums(experiment.metrics)
             eval_loss_fn = None
 
@@ -585,7 +635,7 @@ def main(argv=None):
 
     prefetcher = None
     chunk_prefetcher = None
-    if args.prefetch > 0 and nb_processes == 1:
+    if args.prefetch > 0 and nb_processes == 1 and device_dataset is None:
         # Overlap host batch assembly + host->device transfer with compute
         # (the reference's fetcher/batcher threads + prefetch queue,
         # cnnet.py:115-146).  Under --unroll the prefetcher carries whole
@@ -744,6 +794,7 @@ def main(argv=None):
                 diverged = True
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
 
+        tail_warned = False
         try:
             while step < max_step and not stop["requested"]:
                 if args.trace and step == offstep + 2:  # skip compile + warmup step
@@ -754,7 +805,11 @@ def main(argv=None):
                 chunk = 1
                 if multi_fn is not None and max_step - step >= unroll and trace_ctx is None:
                     # Unrolled dispatch: K distinct batches, one executable
-                    if chunk_prefetcher is not None:
+                    # (device-sampled: the resident dataset IS the input and
+                    # the trainer draws its own fresh per-step batches)
+                    if device_dataset is not None:
+                        device_chunk = device_dataset
+                    elif chunk_prefetcher is not None:
                         device_chunk = next(chunk_prefetcher)
                     else:
                         device_chunk = engine.shard_batches(next_chunk())
@@ -767,6 +822,19 @@ def main(argv=None):
                     chunk = unroll
                     pending_loss = many["total_loss"]  # full vector: see check_divergence
                 else:
+                    if (device_dataset is not None and not tail_warned
+                            and not stop["requested"]):
+                        # Tail steps (max_step % unroll) and --trace windows
+                        # fall back to per-step HOST batches — say so once,
+                        # or a tunnel-bound tail is inexplicable from the
+                        # logs.  (device_dataset itself stays set: the
+                        # unrolled branch resumes after a --trace window.)
+                        tail_warned = True
+                        warning(
+                            "--input-source device: per-step host batches for "
+                            "%d step(s) (the sampled trainer dispatches whole "
+                            "--unroll chunks)" % min(max_step - step, unroll)
+                        )
                     if chunk_prefetcher is not None:
                         # Entering the per-step tail: retire the chunk
                         # producer FIRST — its daemon shares train_iter and

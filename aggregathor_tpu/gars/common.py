@@ -8,6 +8,8 @@ strip this handling by using explicit ``isfinite`` masking rather than NaN
 comparisons.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -15,6 +17,40 @@ import jax.numpy as jnp
 def nonfinite_to_inf(x):
     """Replace every non-finite entry with +inf (NaN-last ordering convention)."""
     return jnp.where(jnp.isfinite(x), x, jnp.inf)
+
+
+#: Column count above which the Pallas coordinate kernels serve a TPU block.
+#: Measured on the v5e (round 4, benchmarks/tpu_capture.jsonl pallas_check):
+#: at d=65k the Pallas rank-select already wins (averaged-median 1.4 ms vs
+#: 16.9 ms for the XLA sort path) and the gap widens with d (8.4M: median
+#: 8.2 ms vs 168 ms, averaged-median 16 ms vs 3871 ms); below ~16k columns a
+#: per-call pad+launch is not worth displacing one small fused sort.
+PALLAS_MIN_COLUMNS = 16384
+
+
+def use_pallas_coordinate_tier(block):
+    """Backend auto-dispatch for the coordinate-wise selection rules.
+
+    Mirrors the reference's tier policy — the C++ custom op serves the rule
+    when loadable, the graph tier otherwise (aggregators/median.py:40-48) —
+    re-targeted at XLA: on TPU, large column blocks go to the hand-written
+    Pallas rank-selection kernels (ops/pallas_kernels.py), which make the
+    SAME selections as the jnp tier (same ranks, same tie-breaks) and agree
+    numerically to float tolerance — the summation order of averaged means
+    differs, so low bits can (asserted on NaN-poisoned inputs by
+    tests/test_pallas.py and on silicon by scripts/pallas_tpu_check.py).
+    ``GRAFT_GAR_TIER=jnp|pallas`` forces a tier (tests, A/B timing).
+    """
+    forced = os.environ.get("GRAFT_GAR_TIER")
+    if forced == "pallas":
+        return True
+    if forced == "jnp":
+        return False
+    return (
+        jax.default_backend() == "tpu"
+        and block.ndim == 2
+        and block.shape[1] >= PALLAS_MIN_COLUMNS
+    )
 
 
 def centered_gram_sq_distances(g):
@@ -55,6 +91,10 @@ def pairwise_sq_distances(grads, direct_threshold=1 << 22):
     if n * n * d <= direct_threshold:
         diff = g[:, None, :] - g[None, :, :]
         return jnp.sum(diff * diff, axis=-1)
+    if use_pallas_coordinate_tier(g):
+        from ..ops import pallas_kernels as pk
+
+        return pk.pairwise_sq_distances(g)
     dist2 = centered_gram_sq_distances(g)
     return jnp.maximum(dist2, 0.0)  # clamp matmul-form negatives; NaN passes through
 

@@ -9,7 +9,7 @@ non-finite treated as +inf (i.e. the upper median for even n).
 import jax.numpy as jnp
 
 from . import GAR, register
-from .common import nonfinite_to_inf
+from .common import nonfinite_to_inf, use_pallas_coordinate_tier
 
 
 def median_columns(block, nb_rows):
@@ -20,7 +20,15 @@ def median_columns(block, nb_rows):
     so every tier (jnp/oracle/native/pallas) agrees bit-for-bit on which
     poison value reaches the optimizer.  jnp.argsort is stable, matching the
     oracle's tie-breaking.
+
+    On TPU, large blocks dispatch to the Pallas rank-selection kernel
+    (identical selection, measured 20x faster at d=8.4M — see
+    ``use_pallas_coordinate_tier``).
     """
+    if block.shape[0] == nb_rows and use_pallas_coordinate_tier(block):
+        from ..ops import pallas_kernels as pk
+
+        return pk.coordinate_median(block)
     order = jnp.argsort(nonfinite_to_inf(block), axis=0)
     return jnp.take_along_axis(block, order[nb_rows // 2][None, :], axis=0)[0]
 

@@ -81,5 +81,23 @@ class Experiment:
         """
         return None
 
+    def train_arrays(self):
+        """Optional array-backed training corpus for DEVICE-SIDE sampling.
+
+        Returns the full training split as a batch-structured pytree (same
+        keys as ``make_train_iterator``'s batches, leading axis = examples)
+        when — and only when — a uniform in-graph row gather reproduces the
+        iterator's stream semantics: i.i.d.-with-replacement draws and NO
+        host-side transform (poisoning, host augmentation, windowing).
+        ``None`` (the default) keeps the experiment on the streaming path.
+
+        Consumers: ``RobustEngine.build_sampled_multi_step`` and the CLI's
+        ``--input-source device`` — on a tunneled TPU the per-step
+        host->device transfer bounds training (measured r4: config 2 streams
+        at 2.0 steps/s vs 26 resident), and a dataset transferred once
+        removes it.
+        """
+        return None
+
 
 import_directory(__name__, __path__, skip=("datasets",))

@@ -110,6 +110,11 @@ class CNNetExperiment(Experiment):
 
         return device_transform(self.preprocessing)
 
+    def train_arrays(self):
+        if self.augment != "device":
+            return None  # host augmentation must see every batch
+        return {"image": self.dataset.x_train, "label": self.dataset.y_train}
+
     def make_eval_iterator(self, nb_workers):
         return eval_batches(self.dataset.x_test, self.dataset.y_test, nb_workers, self.eval_batch_size)
 

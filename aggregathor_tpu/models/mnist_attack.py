@@ -40,6 +40,11 @@ class MNISTAttackExperiment(MNISTExperiment):
             seed=seed, transform=self._poison,
         )
 
+    def train_arrays(self):
+        # the poisoning is a HOST batch transform — a plain device-side row
+        # gather would silently train on clean data
+        return None
+
 
 register("mnistAttack", MNISTAttackExperiment)
 

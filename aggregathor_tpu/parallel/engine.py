@@ -107,8 +107,22 @@ def _partial_pairwise_sq_distances(block):
     memory, so the shared centered-Gram helper is used; psum across blocks
     then yields the same convention as the dense tier (NaN anywhere -> NaN
     entry; per-block median centering is a valid translation per block).
+
+    On TPU, large blocks dispatch to the Pallas streaming distance kernel
+    (ops/pallas_kernels.py): the Gram form's robust centering pass is a
+    per-column median — the same order-statistic cost the Pallas tier
+    removes from the coordinate rules (measured r4: krum dist+score at
+    d=8.4M, 9.5 ms Pallas vs 398 ms jnp) — while the streamed difference
+    form needs no centering because it never cancels.
     """
-    return centered_gram_sq_distances(block.astype(jnp.float32))
+    block = block.astype(jnp.float32)
+    from ..gars.common import use_pallas_coordinate_tier
+
+    if use_pallas_coordinate_tier(block):
+        from ..ops import pallas_kernels as pk
+
+        return pk.pairwise_sq_distances(block)
+    return centered_gram_sq_distances(block)
 
 
 class RobustEngine:
@@ -666,6 +680,66 @@ class RobustEngine:
             many,
             mesh=self.mesh,
             in_specs=(self._state_spec(), batch_spec),
+            out_specs=(self._state_spec(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def build_sampled_multi_step(self, loss_fn, tx, repeat_steps, batch_size):
+        """K-step trainer drawing FRESH per-worker batches ON DEVICE each
+        step from a device-resident dataset.
+
+        Rationale: on a tunneled TPU the host->device input path is the
+        measured bound — config 2 streams at ~2.0 steps/s while the same
+        program with the batch already resident runs at ~26 steps/s
+        (bench_mini, round 4).  The reference streams each worker's batches
+        through a local tf.data pipeline every step (graph.py:224-233); the
+        TPU-native equivalent is to transfer the dataset ONCE (CIFAR-10
+        train is ~0.6 GB in f32 — a few percent of HBM) and gather each
+        worker's sampled rows in-graph, so every step still trains on a
+        fresh i.i.d.-with-replacement draw (the same stream semantics as
+        ``WorkerBatchIterator``, datasets.py:318-325) but no step pays the
+        tunnel.
+
+        Returns ``multi(state, data) -> (state, metrics)`` where ``data`` is
+        the dataset pytree (e.g. ``{"image": x_train, "label": y_train}``),
+        placed replicated via :meth:`replicate`.  Worker w's step-s draw is
+        a pure function of ``(state.rng, s, w)`` — independent of the mesh
+        layout, reproducible across restores, and disjoint (fold tag 4) from
+        the attack (1) / lossy (2) / augment (3) streams derived from the
+        same key.  Device-side augmentation (``batch_transform``) composes
+        unchanged: it runs inside the step body on the sampled batch.
+        """
+        step_body = self._make_body(loss_fn, tx)
+        k = self.workers_per_device
+        nb_steps = int(repeat_steps)
+        batch_size = int(batch_size)
+
+        def many(state, data):
+            nb_examples = jax.tree_util.tree_leaves(data)[0].shape[0]
+
+            def sampled_body(s, _):
+                key = jax.random.fold_in(s.rng, s.step)
+                didx = jax.lax.axis_index(worker_axis)
+
+                def draw(j):
+                    # fold tag 4: the data-sampling stream, disjoint from
+                    # attack (1) / lossy (2) / augment (3)
+                    wkey = jax.random.fold_in(
+                        jax.random.fold_in(key, didx * k + j), 4
+                    )
+                    idx = jax.random.randint(wkey, (batch_size,), 0, nb_examples)
+                    return jax.tree_util.tree_map(lambda a: a[idx], data)
+
+                batch = jax.vmap(draw)(jnp.arange(k))
+                return step_body(s, batch)
+
+            return jax.lax.scan(sampled_body, state, None, length=nb_steps)
+
+        sharded = jax.shard_map(
+            many,
+            mesh=self.mesh,
+            in_specs=(self._state_spec(), P()),
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )

@@ -27,6 +27,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Pin the plain rule names to the pure-jnp tier: round 4 made the base
+# coordinate rules auto-dispatch to the Pallas kernels on TPU
+# (gars/common.py use_pallas_coordinate_tier), which would silently turn
+# this script's jnp column into a second Pallas column.  The *-pallas
+# registrations override aggregate_block directly and ignore this.
+os.environ["GRAFT_GAR_TIER"] = "jnp"
+
 
 def time_fn(fn, sync, reps):
     """Amortized per-call ms with a REAL device sync.

@@ -478,3 +478,37 @@ def test_runner_digits_real_data_end_to_end(tmp_path):
     assert int(lines[-1][1]) == 120
     metrics = dict(kv.split(":", 1) for kv in lines[-1][2:])
     assert float(metrics["accuracy"]) > 0.6, metrics
+
+
+def test_runner_input_source_device(tmp_path):
+    """--input-source device: the training split lives on the accelerator and
+    the unrolled trainer draws fresh in-graph batches — the run trains to a
+    sane accuracy through the full CLI (eval/summaries/checkpoints intact)."""
+    eval_file = str(tmp_path / "eval.tsv")
+    assert 0 == run([
+        "--experiment", "mnist", "--experiment-args", "batch-size:16",
+        "--aggregator", "krum",
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--nb-real-byz-workers", "2", "--attack", "signflip",
+        "--max-step", "120", "--unroll", "10",
+        "--input-source", "device",
+        "--learning-rate-args", "initial-rate:0.05",
+        "--evaluation-delta", "60", "--evaluation-period", "-1",
+        "--evaluation-file", eval_file,
+    ])
+    lines = [l.split("\t") for l in open(eval_file).read().strip().splitlines()]
+    assert int(lines[-1][1]) == 120
+    # fields past walltime/step are metric:value pairs; accuracy above chance
+    metrics = dict(field.split(":") for field in lines[-1][2:])
+    assert float(metrics["accuracy"]) > 0.2
+
+
+def test_runner_input_source_device_rejects_host_transform():
+    """Experiments whose stream needs a host transform (mnistAttack poisons
+    each batch) must refuse device sampling instead of training on clean data."""
+    with pytest.raises(UserException, match="train_arrays"):
+        run([
+            "--experiment", "mnistAttack", "--aggregator", "average",
+            "--nb-workers", "4", "--nb-decl-byz-workers", "0",
+            "--max-step", "4", "--input-source", "device",
+        ])

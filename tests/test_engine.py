@@ -658,3 +658,65 @@ def test_leaf_bucketed_matches_unrolled():
         )
     for a, b in zip(outs["bucketed"], outs["unrolled"]):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_sampled_multi_step_trains_and_is_mesh_invariant():
+    """The device-resident sampled trainer (build_sampled_multi_step) draws
+    fresh in-graph batches: loss decreases, the draw stream is a function of
+    (rng, step, global worker) only — so 8-device and 1-device meshes
+    produce identical parameters — and re-running with the same seed is
+    bit-reproducible."""
+    import optax
+
+    results = []
+    for nb_devices in (8, 1):
+        exp = models.instantiate("mnist", ["batch-size:16"])
+        gar = gars.instantiate("krum", 8, 1)
+        tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+        engine = RobustEngine(make_mesh(nb_workers=nb_devices), gar, nb_workers=8)
+        multi = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=12, batch_size=16)
+        data = engine.replicate({
+            "image": exp.dataset.x_train, "label": exp.dataset.y_train,
+        })
+        state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+        state, metrics = multi(state, data)
+        losses = np.asarray(jax.device_get(metrics["total_loss"]))
+        assert losses.shape == (12,)
+        assert losses[-1] < losses[0]
+        # fresh draws each step: a same-batch scan would still vary through
+        # the params, but per-step losses must not be an exact repeat chain
+        assert len({round(float(x), 6) for x in losses}) > 1
+        results.append(flat_params(state))
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
+
+    # reproducibility: identical seed, identical final parameters
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    gar = gars.instantiate("krum", 8, 1)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=8), gar, nb_workers=8)
+    multi = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=12, batch_size=16)
+    data = engine.replicate({"image": exp.dataset.x_train, "label": exp.dataset.y_train})
+    state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    state, _ = multi(state, data)
+    np.testing.assert_array_equal(results[0], flat_params(state))
+
+
+def test_sampled_multi_step_differs_from_repeat_batch():
+    """Sampling must actually change the data each step: the sampled trainer
+    and the one-resident-batch repeat trainer diverge after a few steps."""
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    gar = gars.instantiate("average", 4, 0)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=4), gar, nb_workers=4)
+    data = engine.replicate({"image": exp.dataset.x_train, "label": exp.dataset.y_train})
+
+    sampled = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=5, batch_size=16)
+    s1 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=2)
+    s1, _ = sampled(s1, data)
+
+    repeat = engine.build_multi_step(exp.loss, tx, repeat_steps=5)
+    it = exp.make_train_iterator(4, seed=2)
+    s2 = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=2)
+    s2, _ = repeat(s2, engine.shard_batch(next(it)))
+
+    assert not np.allclose(flat_params(s1), flat_params(s2), rtol=1e-4)

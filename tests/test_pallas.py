@@ -177,3 +177,47 @@ def test_pallas_krum_excludes_fully_nan_row_like_jnp():
     b = np.asarray(gars.instantiate("krum-pallas", 9, 2).aggregate(jnp.asarray(g)))
     assert np.all(np.isfinite(a)) and np.all(np.isfinite(b))
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_engine_auto_tier_matches_jnp(monkeypatch):
+    """The round-4 backend auto-dispatch (gars/common.use_pallas_coordinate_tier):
+    forcing GRAFT_GAR_TIER=pallas routes median/averaged-median/bulyan-final
+    selections AND the engine's partial distances through the Pallas kernels
+    (interpret mode on CPU) inside the full shard_map step — and the result
+    matches the default jnp tier."""
+    import jax
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.core import build_optimizer, build_schedule
+    from aggregathor_tpu.parallel import RobustEngine, make_mesh
+
+    def run(tier):
+        monkeypatch.setenv("GRAFT_GAR_TIER", tier)
+        exp = models.instantiate("mnist", ["batch-size:8"])
+        # bulyan: needs_distances (the engine's partial-distance dispatch)
+        # AND an averaged-median final phase (the coordinate dispatch)
+        gar = gars.instantiate("bulyan", 8, 1)
+        tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+        engine = RobustEngine(make_mesh(nb_workers=4), gar, nb_workers=8)
+        step = engine.build_step(exp.loss, tx)
+        state = engine.init_state(exp.init(jax.random.PRNGKey(5)), tx, seed=2)
+        it = exp.make_train_iterator(8, seed=7)
+        for _ in range(2):
+            state, metrics = step(state, engine.shard_batch(next(it)))
+        return np.concatenate(
+            [np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(state.params)]
+        )
+
+    np.testing.assert_allclose(run("pallas"), run("jnp"), rtol=1e-5, atol=1e-6)
+
+
+def test_use_pallas_tier_env_force(monkeypatch):
+    from aggregathor_tpu.gars.common import use_pallas_coordinate_tier
+
+    block = np.zeros((8, 4), np.float32)
+    monkeypatch.setenv("GRAFT_GAR_TIER", "pallas")
+    assert use_pallas_coordinate_tier(block)
+    monkeypatch.setenv("GRAFT_GAR_TIER", "jnp")
+    assert not use_pallas_coordinate_tier(block)
+    monkeypatch.delenv("GRAFT_GAR_TIER")
+    # CPU backend: auto stays on the jnp tier regardless of size
+    assert not use_pallas_coordinate_tier(np.zeros((8, 1 << 20), np.float32))
