@@ -831,9 +831,9 @@ def main(argv=None):
                         # unrolled branch resumes after a --trace window.)
                         tail_warned = True
                         warning(
-                            "--input-source device: per-step host batches for "
-                            "%d step(s) (the sampled trainer dispatches whole "
-                            "--unroll chunks)" % min(max_step - step, unroll)
+                            "--input-source device: trace-window/tail steps "
+                            "use per-step host batches (the sampled trainer "
+                            "dispatches whole --unroll chunks)"
                         )
                     if chunk_prefetcher is not None:
                         # Entering the per-step tail: retire the chunk

@@ -11,7 +11,9 @@ Knobs swept (the ones bench.py's phases identified as mattering):
   dtype    — float32 vs bfloat16 compute (MXU rate)
   augment  — host- vs device-side crop/flip (input-path cost placement)
   input    — resident batch (pure-compute upper bound — NOT trainable),
-             fresh sync, or prefetched fresh
+             fresh sync, prefetched fresh, or device-sampled fresh (the
+             dataset lives on-chip and each step gathers its own fresh
+             i.i.d. batch in-graph — trainable, r4)
 
 Setup (dataset, engine, state, compiles) is shared across the input modes
 of each (unroll, dtype, augment) triple — sync and prefetch time the SAME
@@ -95,7 +97,10 @@ def main():
     for unroll, dtype, augment in itertools.product(
             [int(u) for u in args.unrolls.split(",")],
             ["float32", "bfloat16"], ["device", "host"]):
-        inputs = ["resident", "sync", "prefetch"] if unroll > 1 else ["sync"]
+        inputs = ["resident", "sampled", "sync", "prefetch"] if unroll > 1 else ["sync"]
+        if augment == "host":
+            # host augmentation must see every batch: train_arrays() is None
+            inputs = [i for i in inputs if i != "sampled"]
         todo = [i for i in inputs
                 if resume.get(combo_key(unroll, dtype, augment, i)) is None]
         for inp in [i for i in inputs if i not in todo]:
@@ -127,6 +132,7 @@ def main():
                 flops = float(cost["flops"])
             except Exception:
                 pass
+            dataset = None
             if unroll == 1:
                 fns = {"sync": engine.build_step(experiment.loss, tx)}
             else:
@@ -134,6 +140,16 @@ def main():
                 fns = {"resident": engine.build_multi_step(
                            experiment.loss, tx, repeat_steps=unroll),
                        "sync": fresh_fn, "prefetch": fresh_fn}
+                if "sampled" in inputs:
+                    arrays = experiment.train_arrays()
+                    if arrays is None:  # host transform: not device-samplable
+                        inputs = [i for i in inputs if i != "sampled"]
+                        todo = [i for i in todo if i != "sampled"]
+                    else:
+                        fns["sampled"] = engine.build_sampled_multi_step(
+                            experiment.loss, tx, repeat_steps=unroll,
+                            batch_size=args.batch)
+                        dataset = engine.replicate(arrays)
         except Exception as exc:
             for inp in todo:
                 finish(dict(base, input=inp,
@@ -155,6 +171,8 @@ def main():
                     fn, make = fns["sync"], lambda: engine.shard_batch(next(it))
                 elif inp == "resident":
                     fn, make = fns["resident"], lambda: resident
+                elif inp == "sampled":
+                    fn, make = fns["sampled"], lambda: dataset
                 else:
                     fn = fns["sync"]
                     make = lambda: engine.shard_batches(it.next_many(unroll))
