@@ -77,9 +77,16 @@ class Experiment:
         Experiments that support ``augment:device`` return the in-step
         augmentation here (models/preprocessing.py ``device_transform``) and
         leave their host iterator transform-free; the engine applies it per
-        worker with (seed, step, worker)-keyed randomness.  Default: none.
+        worker with (seed, step, worker)-keyed randomness.  Default: the
+        in-step tier of ``self.preprocessing`` when the experiment opted
+        into ``augment:device`` (the cnnet/zoo convention: ``self.augment``
+        is ``"host"`` or ``"device"``); none otherwise.
         """
-        return None
+        if getattr(self, "augment", "host") != "device":
+            return None
+        from .preprocessing import device_transform
+
+        return device_transform(self.preprocessing)
 
     def train_arrays(self):
         """Optional array-backed training corpus for DEVICE-SIDE sampling.
@@ -96,8 +103,18 @@ class Experiment:
         host->device transfer bounds training (measured r4: config 2 streams
         at 2.0 steps/s vs 26 resident), and a dataset transferred once
         removes it.
+
+        Default: the ``self.dataset`` train split for experiments that
+        moved their augmentation in-step (``augment:device`` — the host
+        path is then a plain gather); None otherwise (host augmentation or
+        a host transform must see every batch).
         """
-        return None
+        if getattr(self, "augment", "host") != "device":
+            return None
+        dataset = getattr(self, "dataset", None)
+        if dataset is None:
+            return None
+        return {"image": dataset.x_train, "label": dataset.y_train}
 
 
 import_directory(__name__, __path__, skip=("datasets",))

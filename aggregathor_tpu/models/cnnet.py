@@ -103,17 +103,8 @@ class CNNetExperiment(Experiment):
                        else make_preprocessing(self.preprocessing, seed=seed)),
         )
 
-    def device_transform(self):
-        if self.augment != "device":
-            return None
-        from .preprocessing import device_transform
-
-        return device_transform(self.preprocessing)
-
-    def train_arrays(self):
-        if self.augment != "device":
-            return None  # host augmentation must see every batch
-        return {"image": self.dataset.x_train, "label": self.dataset.y_train}
+    # device_transform / train_arrays: Experiment base defaults keyed off
+    # self.augment / self.preprocessing / self.dataset
 
     def make_eval_iterator(self, nb_workers):
         return eval_batches(self.dataset.x_test, self.dataset.y_test, nb_workers, self.eval_batch_size)

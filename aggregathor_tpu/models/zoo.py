@@ -131,6 +131,11 @@ class ZooExperiment(Experiment):
                 "preprocessing": "",
                 "nb-fetcher-threads": 0,
                 "nb-batcher-threads": 0,
+                # host (reference-faithful: fetcher threads transform each
+                # batch) or device (the same augmentation as a jnp transform
+                # INSIDE the jitted step — frees the host path to a plain
+                # gather and enables --input-source device; like cnnet's)
+                "augment": "host",
             },
         )
         self.batch_size = kv["batch-size"]
@@ -144,6 +149,11 @@ class ZooExperiment(Experiment):
         self.preprocessing = check_preprocessing(
             kv["preprocessing"] or default_for(self.model_name)
         )
+        self.augment = kv["augment"]
+        if self.augment not in ("host", "device"):
+            from ..utils import UserException
+
+            raise UserException("augment must be host|device, got %r" % (self.augment,))
         self.aux_weight = kv["aux-weight"] if self.model_name in AUX_CAPABLE else 0.0
         self.dataset = DATASETS[self.dataset_name](kv)
         from .common import check_dtype
@@ -204,8 +214,12 @@ class ZooExperiment(Experiment):
 
         return WorkerBatchIterator(
             self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size, seed=seed,
-            transform=make_preprocessing(self.preprocessing, seed=seed),
+            transform=(None if self.augment == "device"
+                       else make_preprocessing(self.preprocessing, seed=seed)),
         )
+
+    # device_transform / train_arrays: Experiment base defaults keyed off
+    # self.augment / self.preprocessing / self.dataset
 
     def make_eval_iterator(self, nb_workers):
         return eval_batches(self.dataset.x_test, self.dataset.y_test, nb_workers, self.eval_batch_size)

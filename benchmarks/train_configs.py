@@ -54,6 +54,18 @@ CONFIGS = {
                  "--nb-workers", "8", "--nb-decl-byz-workers", "2",
                  "--experiment-args", "batch-size:128", "dtype:bfloat16", "augment:device"],
     },
+    "2d": {
+        "name": "cnnet_krum_n8_f2_bf16_devicesampled",
+        "note": "config 2b plus the r4 input-path fix: --input-source device "
+                "holds the train split on-chip and gathers fresh i.i.d. "
+                "per-worker batches in-graph, removing the per-step tunnel "
+                "transfer that bounds the streamed rows (measured 13x gap, "
+                "BENCHMARKS.md row 2)",
+        "args": ["--experiment", "cnnet", "--aggregator", "krum",
+                 "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+                 "--unroll", "10", "--input-source", "device",
+                 "--experiment-args", "batch-size:128", "dtype:bfloat16", "augment:device"],
+    },
     "2c": {
         "name": "cnnet_bucketing_krum_n8_f1",
         "note": "config 2's model with the bucketing meta-rule (s=2, inner "
@@ -87,6 +99,17 @@ CONFIGS = {
         "args": ["--experiment", "slim-resnet_v1_50-imagenet", "--aggregator", "krum",
                  "--nb-workers", "32", "--nb-decl-byz-workers", "8",
                  "--experiment-args", "batch-size:4", "image-size:128", "dtype:bfloat16"],
+    },
+    "3d": {
+        "name": "resnet50_krum_n32_f8_devicesampled",
+        "note": "config 3k with the r4 input-path fix (augment:device + "
+                "--input-source device --unroll 5): ImageNet-shaped batches "
+                "gathered on-chip instead of 25 MB/step over the tunnel",
+        "args": ["--experiment", "slim-resnet_v1_50-imagenet", "--aggregator", "krum",
+                 "--nb-workers", "32", "--nb-decl-byz-workers", "8",
+                 "--unroll", "5", "--input-source", "device",
+                 "--experiment-args", "batch-size:4", "image-size:128",
+                 "dtype:bfloat16", "augment:device"],
     },
     "6": {
         "name": "resnet50_cifar10_leaf_krum_n8_f2",

@@ -23,13 +23,15 @@ Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
   pallas_check    — Pallas kernels compiled on silicon, parity + ms
                     (scripts/pallas_tpu_check.py)
   gar_kernels     — per-rule kernel ms vs d, jnp:tpu + pallas tiers
-  train_configs   — configs 2, 2b, 2c through the real CLI on TPU
+  train_configs   — configs 2, 2b, 2d (device-sampled), 2c through the
+                    real CLI on TPU
   opt_sweep       — unroll x dtype x augment x input ladder on config 2
                     (the VERDICT-r3 task-3 optimizer; per-combo resumable)
   train_configs34 — configs 3 (ResNet-50+Bulyan n=32 f=7 — BASELINE's f=8
                     violates Bulyan's n >= 4f+3 bound), 3k (ResNet-50+Krum
-                    at the prescribed n=32 f=8) and 4 (Inception-v3+median
-                    under attack, n=32 f=8), through the real CLI on TPU
+                    at the prescribed n=32 f=8), 3d (3k device-sampled) and
+                    4 (Inception-v3+median under attack, n=32 f=8),
+                    through the real CLI on TPU
   leaf_resnet     — per-layer granularity on a slim ResNet (the bucketed
                     leaf path) through the real CLI
   trace           — config 2b sizing with a jax.profiler trace banked to
@@ -100,9 +102,9 @@ def _stages(py):
            "--dims", "65536,1048576,8388608", "--reps", "10",
            "--resume-file", "benchmarks/resume_gar_kernels.json"), 3600),
         ("train_configs",
-         b("benchmarks/train_configs.py", "--configs", "2,2b,2c",
+         b("benchmarks/train_configs.py", "--configs", "2,2b,2d,2c",
            "--steps", "40", "--platform", "tpu", "--timeout", "1200",
-           "--resume-file", "benchmarks/resume_train_configs.json"), 4200),
+           "--resume-file", "benchmarks/resume_train_configs.json"), 5400),
         # The VERDICT-r3 task-3 optimizer: sweep unroll x dtype x augment x
         # input sourcing on the real config-2 program; per-combo resumable,
         # one row per combination plus opt_sweep_best (trainable) and
@@ -113,9 +115,9 @@ def _stages(py):
          b("benchmarks/opt_sweep.py", "--platform", "tpu", "--steps", "60",
            "--resume-file", "benchmarks/resume_opt_sweep.json"), 4800),
         ("train_configs34",
-         b("benchmarks/train_configs.py", "--configs", "3,3k,4",
+         b("benchmarks/train_configs.py", "--configs", "3,3k,3d,4",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800",
-           "--resume-file", "benchmarks/resume_train_configs34.json"), 6000),
+           "--resume-file", "benchmarks/resume_train_configs34.json"), 7800),
         ("leaf_resnet",
          b("benchmarks/train_configs.py", "--configs", "6,6u",
            "--steps", "10", "--platform", "tpu", "--timeout", "1800",

@@ -190,3 +190,39 @@ def test_digits_attack_poisons_real_stream():
     sev1 = models.instantiate("digitsAttack", ["batch-size:8", "severity:1"])
     b1 = next(sev1.make_train_iterator(2, seed=0))
     assert float(np.min(b1["image"])) >= -100.0 and float(np.max(b1["image"])) <= 0.0
+
+
+def test_zoo_device_augment_and_train_arrays():
+    """augment:device moves the slim preprocessing into the jitted step
+    (device_transform set, iterator transform-free) and exposes the corpus
+    for device-side sampling; augment:host keeps the reference-faithful
+    host transform and refuses train_arrays."""
+    dev = models.instantiate(
+        "slim-lenet-cifar10", ["batch-size:2", "eval-batch-size:2", "augment:device"])
+    assert dev.train_arrays() is not None
+    it = dev.make_train_iterator(2)
+    assert it.transform is None
+    # lenet preprocessing is the identity: device transform may be None; a
+    # conv family with real augmentation must return a callable
+    vgg = models.instantiate(
+        "slim-vgg_16-cifar10", ["batch-size:2", "eval-batch-size:2", "augment:device"])
+    assert callable(vgg.device_transform())
+    host = models.instantiate(
+        "slim-vgg_16-cifar10", ["batch-size:2", "eval-batch-size:2"])
+    assert host.train_arrays() is None and host.device_transform() is None
+
+    # the sampled trainer runs end-to-end on the zoo experiment
+    import jax
+    import optax
+    from aggregathor_tpu import gars
+    from aggregathor_tpu.parallel import RobustEngine, make_mesh
+
+    gar = gars.instantiate("krum", 4, 1)
+    engine = RobustEngine(make_mesh(nb_workers=4), gar, nb_workers=4,
+                          batch_transform=vgg.device_transform())
+    tx = optax.sgd(0.01)
+    multi = engine.build_sampled_multi_step(vgg.loss, tx, repeat_steps=2, batch_size=2)
+    state = engine.init_state(vgg.init(jax.random.PRNGKey(0)), tx, seed=1)
+    state, metrics = multi(state, engine.replicate(vgg.train_arrays()))
+    import numpy as np
+    assert np.isfinite(np.asarray(metrics["total_loss"])).all()
