@@ -27,6 +27,31 @@ def nonfinite_to_inf(x):
 #: per-call pad+launch is not worth displacing one small fused sort.
 PALLAS_MIN_COLUMNS = 16384
 
+_pallas_tier_suspended = False
+
+
+class suspend_pallas_tier:
+    """Trace-time opt-out for the Pallas auto-dispatch.
+
+    The bucketed leaf path calls the rules under ``jax.vmap``; a vmapped
+    ``pallas_call`` compiles through Pallas' batching rule, which is
+    exercised in interpret mode by the CPU suite but UNVALIDATED on real
+    TPU silicon here.  Until the ``leaf_resnet`` capture stage proves it,
+    the bucketed path wraps its vmapped rule calls in this context so a
+    leaf-granularity run cannot gamble an up-window on an uncompiled code
+    path.  (Plain Python state is trace-time-correct: the flag is read
+    while the caller's jit/vmap trace is being built.)
+    """
+
+    def __enter__(self):
+        global _pallas_tier_suspended
+        self._prev = _pallas_tier_suspended
+        _pallas_tier_suspended = True
+
+    def __exit__(self, *exc):
+        global _pallas_tier_suspended
+        _pallas_tier_suspended = self._prev
+
 
 def use_pallas_coordinate_tier(block):
     """Backend auto-dispatch for the coordinate-wise selection rules.
@@ -43,7 +68,10 @@ def use_pallas_coordinate_tier(block):
     """
     forced = os.environ.get("GRAFT_GAR_TIER")
     if forced == "pallas":
-        return True
+        return True  # explicit force outranks the vmap suspension: it is
+        # the only way to exercise/A-B the vmapped Pallas path end to end
+    if _pallas_tier_suspended:
+        return False  # vmapped context: see suspend_pallas_tier
     if forced == "jnp":
         return False
     return (

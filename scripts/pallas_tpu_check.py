@@ -150,6 +150,41 @@ def main():
                 failures += 1
             print(json.dumps(row), flush=True)
 
+    # Vmapped-kernel proof: the bucketed leaf path calls the rules under
+    # jax.vmap (engine._aggregate_per_leaf_bucketed), which routes every
+    # guarded kernel — coordinate median, averaged-median, AND the
+    # streamed pairwise distances — through Pallas' batching rule:
+    # exercised interpret-mode by the CPU suite, proven compiled here.
+    # Green on ALL THREE means the engine's suspend_pallas_tier() guard
+    # around the vmapped calls can be lifted.
+    beta = max(1, args.n - args.f)
+    vmap_cases = [
+        ("median-vmap4", pk.coordinate_median),
+        ("averaged-median-vmap4", lambda x: pk.coordinate_averaged_median(x, beta)),
+        ("pairwise-dist-vmap4", pk.pairwise_sq_distances),
+    ]
+    for d in sorted(dims)[:2]:  # smallest two: the proof, not a sweep
+        stack_host = rng.normal(size=(4, args.n, d)).astype(np.float32)
+        stack_host[0, 0, :: max(1, d // 64)] = np.nan
+        stack = jax.device_put(stack_host)
+        for name, kernel in vmap_cases:
+            row = {"metric": "pallas_tpu_check", "rule": name, "n": args.n,
+                   "f": args.f, "d": d}
+            try:
+                vm = jax.jit(jax.vmap(kernel))
+                out_v = np.asarray(vm(stack))
+                out_l = np.stack([np.asarray(kernel(stack[i]))
+                                  for i in range(stack.shape[0])])
+                ok = bool(np.allclose(out_v, out_l, rtol=1e-6, atol=1e-6, equal_nan=True))
+                row["parity"] = "ok" if ok else "FAIL"
+                row["pallas_ms"] = round(time_fn(lambda: vm(stack), dev_sync, args.reps), 4)
+                failures += 0 if ok else 1
+            except Exception as exc:  # batching-rule lowering failure is a finding
+                row["parity"] = "ERROR"
+                row["error"] = "%s: %s" % (type(exc).__name__, str(exc)[:400])
+                failures += 1
+            print(json.dumps(row), flush=True)
+
     sys.exit(1 if failures else 0)
 
 
