@@ -137,10 +137,17 @@ def _stages(py):
         ("leaf_transformer",
          b("benchmarks/train_configs.py", "--configs", "5f",
            "--steps", "20", "--platform", "tpu", "--timeout", "1500"), 1800),
+        # Device-sampled input (same training distribution, different PRNG
+        # stream) + unroll: a 300-step cell pays the tunnel once for the
+        # dataset instead of 300 times for batches — the 13x input-path
+        # difference is what makes a 12-cell accuracy grid fit an up-window.
         ("robustness",
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
            "--batch", "32", "--rules", "average,krum,median,dnc",
            "--platform", "tpu", "--timeout", "600",
+           "--runner-args",
+           "--experiment-args batch-size:32 augment:device "
+           "--unroll 10 --input-source device",
            "--resume-file", "benchmarks/resume_robustness.json"), 8400),
     ]
 
