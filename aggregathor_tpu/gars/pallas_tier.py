@@ -18,6 +18,7 @@ from .averaged_median import AveragedMedianGAR
 from .bulyan import BulyanGAR
 from .krum import KrumGAR
 from .median import MedianGAR
+from .trimmed_mean import TrimmedMeanGAR
 from .common import select_combine
 from ..ops import pallas_kernels as pk
 
@@ -60,7 +61,15 @@ class PallasBulyanGAR(BulyanGAR):
         return pk.coordinate_averaged_median(selections, self.nb_closest)
 
 
+class PallasTrimmedMeanGAR(TrimmedMeanGAR):
+    def aggregate_block(self, block, dist2=None):
+        return pk.coordinate_trimmed_mean(
+            block, self.nb_trim, self.nb_workers - 2 * self.nb_trim
+        )
+
+
 register("median-pallas", PallasMedianGAR)
+register("trimmed-mean-pallas", PallasTrimmedMeanGAR)
 register("averaged-median-pallas", PallasAveragedMedianGAR)
 register("average-nan-pallas", PallasAverageNaNGAR)
 register("krum-pallas", PallasKrumGAR)

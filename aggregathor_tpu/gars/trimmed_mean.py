@@ -16,12 +16,20 @@ coordinate-wise rules (gars/common.py).
 import jax.numpy as jnp
 
 from . import GAR, register
-from .common import nonfinite_to_inf
+from .common import nonfinite_to_inf, use_pallas_coordinate_tier
 
 
 def trimmed_mean_columns(block, nb_rows, nb_trim):
-    """Per-column mean of the middle ``nb_rows - 2*nb_trim`` sorted values."""
+    """Per-column mean of the middle ``nb_rows - 2*nb_trim`` sorted values.
+
+    On TPU, large blocks dispatch to the Pallas rank-selection kernel
+    (same selected multiset per column; see
+    ``common.use_pallas_coordinate_tier``)."""
     keep = nb_rows - 2 * nb_trim
+    if block.shape[0] == nb_rows and use_pallas_coordinate_tier(block):
+        from ..ops import pallas_kernels as pk
+
+        return pk.coordinate_trimmed_mean(block, nb_trim, keep)
     clean = nonfinite_to_inf(block)
     ordered = jnp.sort(clean, axis=0)[nb_trim:nb_trim + keep]
     # Columns whose kept band still contains inf had > nb_trim poisoned

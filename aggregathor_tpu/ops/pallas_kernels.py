@@ -123,6 +123,19 @@ def _averaged_median_kernel(n, beta, x_ref, out_ref):
     _store_row(out_ref, jnp.sum(chosen, axis=0) / float(beta))
 
 
+def _trimmed_mean_kernel(n, trim, keep, x_ref, out_ref):
+    # Mean of the CLEANED (+inf-mapped) values at ranks [trim, trim+keep):
+    # an inf in the kept band poisons the sum -> NaN surfaced, matching
+    # gars/trimmed_mean.trimmed_mean_columns.  Padded rows rank exactly n
+    # (every real row outranks or index-ties below them), never selected.
+    x = x_ref[:]
+    key = _inf_key(x)
+    ranks = _ranks(key, n)
+    sel = jnp.where((ranks >= trim) & (ranks < trim + keep), key, 0.0)
+    mean = jnp.sum(sel, axis=0) / float(keep)
+    _store_row(out_ref, jnp.where(jnp.isfinite(mean), mean, jnp.nan))
+
+
 def _coordinate_call(kernel, x, block_d=None):
     """Run a (n, blk) -> row coordinate kernel over column blocks.
 
@@ -157,6 +170,15 @@ def coordinate_averaged_median(x, beta, block_d=None):
     n = x.shape[0]
     return _coordinate_call(
         functools.partial(_averaged_median_kernel, n, int(beta)), x, block_d
+    )
+
+
+def coordinate_trimmed_mean(x, trim, keep, block_d=None):
+    """(d,) per-column mean of the values at sorted ranks [trim, trim+keep)
+    with non-finite mapped to +inf; NaN where the kept band is poisoned."""
+    n = x.shape[0]
+    return _coordinate_call(
+        functools.partial(_trimmed_mean_kernel, n, int(trim), int(keep)), x, block_d
     )
 
 

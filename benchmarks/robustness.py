@@ -35,12 +35,14 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cell(rule, attack, steps, batch, platform, timeout, experiment, extra_args=()):
+def run_cell(rule, attack, steps, batch, platform, timeout, experiment, extra_args=(),
+             experiment_args=()):
     eval_dir = tempfile.mkdtemp(prefix="aggregathor_rob_")
     eval_file = os.path.join(eval_dir, "eval.tsv")
     cmd = [
         sys.executable, "-m", "aggregathor_tpu.cli.runner",
-        "--experiment", experiment, "--experiment-args", "batch-size:%d" % batch,
+        "--experiment", experiment,
+        "--experiment-args", "batch-size:%d" % batch, *experiment_args,
         "--aggregator", rule,
         "--nb-workers", "8", "--nb-decl-byz-workers", "2",
         "--max-step", str(steps),
@@ -117,6 +119,11 @@ def main():
                     help="extra flags appended to every runner invocation, as "
                          "ONE quoted string (argparse cannot nest leading "
                          "dashes): --runner-args '--worker-momentum 0.9'")
+    ap.add_argument("--experiment-args-extra", default="",
+                    help="extra key:value tokens APPENDED to the harness's "
+                         "own --experiment-args (which carries batch-size "
+                         "from --batch — so batch stays single-sourced): "
+                         "--experiment-args-extra 'augment:device'")
     ap.add_argument("--seeds", default=None,
                     help="comma list of --seed values; each cell runs once "
                          "per seed and the table reports mean ± half-range "
@@ -124,6 +131,7 @@ def main():
                          "Default: single run at the runner's default seed.")
     args = ap.parse_args()
     args.runner_args = shlex.split(args.runner_args)
+    args.experiment_args_extra = shlex.split(args.experiment_args_extra)
 
     sys.path.insert(0, REPO)
     from aggregathor_tpu.utils.state import load_json, save_json_atomic
@@ -142,11 +150,13 @@ def main():
             # another.
             key = "%s|%s|%s|%d|%d|%s|%s" % (
                 args.experiment, rule, attack, args.steps, args.batch,
-                args.platform or "ambient", " ".join(extra))
+                args.platform or "ambient",
+                " ".join(args.experiment_args_extra + extra))
             row = resume.get(key)
             if row is None or row.get("error"):
                 row = run_cell(rule, attack, args.steps, args.batch, args.platform,
-                               args.timeout, args.experiment, extra_args=extra)
+                               args.timeout, args.experiment, extra_args=extra,
+                               experiment_args=args.experiment_args_extra)
                 if seed is not None:
                     row["seed"] = seed
                 if args.resume_file and not row.get("error"):

@@ -63,7 +63,8 @@ def main():
     ap.add_argument("--n", type=int, default=32)
     ap.add_argument("--f", type=int, default=8)
     ap.add_argument("--dims", default="65536,1048576,8388608")
-    ap.add_argument("--rules", default="average-nan,median,averaged-median,krum,bulyan")
+    ap.add_argument("--rules",
+                    default="average-nan,median,averaged-median,krum,bulyan,trimmed-mean")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--nan-workers", type=int, default=2,
                     help="rows given scattered NaN coordinates (lossy-link parity)")
@@ -152,15 +153,18 @@ def main():
 
     # Vmapped-kernel proof: the bucketed leaf path calls the rules under
     # jax.vmap (engine._aggregate_per_leaf_bucketed), which routes every
-    # guarded kernel — coordinate median, averaged-median, AND the
-    # streamed pairwise distances — through Pallas' batching rule:
+    # guarded kernel — coordinate median, averaged-median, trimmed-mean,
+    # AND the streamed pairwise distances — through Pallas' batching rule:
     # exercised interpret-mode by the CPU suite, proven compiled here.
-    # Green on ALL THREE means the engine's suspend_pallas_tier() guard
+    # Green on ALL FOUR means the engine's suspend_pallas_tier() guard
     # around the vmapped calls can be lifted.
     beta = max(1, args.n - args.f)
+    keep = max(1, args.n - 2 * args.f)
     vmap_cases = [
         ("median-vmap4", pk.coordinate_median),
         ("averaged-median-vmap4", lambda x: pk.coordinate_averaged_median(x, beta)),
+        ("trimmed-mean-vmap4",
+         lambda x: pk.coordinate_trimmed_mean(x, (args.n - keep) // 2, keep)),
         ("pairwise-dist-vmap4", pk.pairwise_sq_distances),
     ]
     for d in sorted(dims)[:2]:  # smallest two: the proof, not a sweep

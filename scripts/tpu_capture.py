@@ -145,9 +145,8 @@ def _stages(py):
          b("benchmarks/robustness.py", "--experiment", "cnnet", "--steps", "300",
            "--batch", "32", "--rules", "average,krum,median,dnc",
            "--platform", "tpu", "--timeout", "600",
-           "--runner-args",
-           "--experiment-args batch-size:32 augment:device "
-           "--unroll 10 --input-source device",
+           "--experiment-args-extra", "augment:device",
+           "--runner-args", "--unroll 10 --input-source device",
            "--resume-file", "benchmarks/resume_robustness.json"), 8400),
     ]
 

@@ -73,7 +73,7 @@ def test_pairwise_distances_nan_row():
 @pytest.mark.parametrize(
     "name,f",
     [("median-pallas", 2), ("averaged-median-pallas", 2), ("average-nan-pallas", 2),
-     ("krum-pallas", 2), ("bulyan-pallas", 1)],
+     ("krum-pallas", 2), ("bulyan-pallas", 1), ("trimmed-mean-pallas", 2)],
 )
 def test_registered_pallas_tier_matches_jnp(name, f):
     import jax.numpy as jnp
@@ -221,3 +221,23 @@ def test_use_pallas_tier_env_force(monkeypatch):
     monkeypatch.delenv("GRAFT_GAR_TIER")
     # CPU backend: auto stays on the jnp tier regardless of size
     assert not use_pallas_coordinate_tier(np.zeros((8, 1 << 20), np.float32))
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_coordinate_trimmed_mean(case):
+    g = _rand(**case)
+    n = g.shape[0]
+    trim = 2
+    out = np.asarray(pk.coordinate_trimmed_mean(g, trim, n - 2 * trim, block_d=128))
+    np.testing.assert_allclose(
+        out, oracle.trimmed_mean(g, trim), rtol=1e-5, atol=1e-6, equal_nan=True)
+
+
+def test_coordinate_trimmed_mean_poisoned_band():
+    """More than trim non-finite entries in a column -> NaN out, both tiers."""
+    g = _rand(8, 40, 11)
+    g[:3, 7] = np.nan  # 3 poisoned > trim=2: the kept band holds an inf
+    out = np.asarray(pk.coordinate_trimmed_mean(g, 2, 4, block_d=128))
+    ref = oracle.trimmed_mean(g, 2)
+    assert np.isnan(out[7]) and np.isnan(ref[7])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6, equal_nan=True)
