@@ -498,19 +498,23 @@ def _attempt(args, timeout):
     return result
 
 
-def _last_banked_tpu_row():
+def _last_banked_tpu_row(path=None):
     """Newest config-2 TPU row banked by the capture watcher, or None.
 
     Scans benchmarks/tpu_capture.jsonl (stage records carry a ``results``
     list) for rows of this bench's metric family measured on TPU.  A row
     that passes the shared completeness predicate (the same one the watcher
     uses for stage retirement — aggregathor_tpu/utils/capture.py) always
-    wins over a phase-partial or mini-sizing row; a partial is surfaced
-    only when no complete capture exists, and is labeled as such."""
+    wins over a phase-partial row; a partial is surfaced only when no
+    complete capture exists, and is labeled as such.  (Whether a complete
+    row may be PROMOTED to the primary result is decided by the caller:
+    mini-sizing ``_sizing_override`` rows are complete — they retire
+    bench_mini — but measure a shorter program.)"""
     from aggregathor_tpu.utils.capture import is_complete_tpu_datum
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "tpu_capture.jsonl")
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "tpu_capture.jsonl")
     newest_complete = newest_partial = None
     try:
         with open(path) as fd:

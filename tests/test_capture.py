@@ -7,6 +7,7 @@ retirement), which probe outputs count as chip-up, and that a timed-out
 child's partial stdout is banked.
 """
 
+import json
 import os
 import sys
 
@@ -140,3 +141,42 @@ def test_run_stage_delivers_extra_env(monkeypatch, tmp_path):
     import json as _json
     row = _json.loads(logged[-1])["results"][0]
     assert row["sizing"] == "128,10,3" and row["inherited_path"] is True
+
+
+def test_banked_row_scanner_ranking(tmp_path):
+    """bench._last_banked_tpu_row: newest COMPLETE row beats any partial;
+    partials are labeled; sizing-override completes are still returned
+    (promotion gating is the caller's job)."""
+    import bench
+
+    complete = {
+        "metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+        "value": 5.0,
+        "detail": {"platform": "tpu",
+                   "bfloat16": {"steps_per_s_resident_batch": 9.0}},
+    }
+    partial = {
+        "metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+        "value": 1.0,
+        "detail": {"platform": "tpu"},
+    }
+    log = tmp_path / "cap.jsonl"
+
+    log.write_text(json.dumps({"ts": "t1", "results": [partial]}) + "\n")
+    got = bench._last_banked_tpu_row(str(log))
+    assert got["partial"] and got["row"]["value"] == 1.0
+
+    with open(log, "a") as fd:
+        fd.write(json.dumps({"ts": "t2", "results": [complete]}) + "\n")
+        fd.write(json.dumps({"ts": "t3", "results": [partial]}) + "\n")
+    got = bench._last_banked_tpu_row(str(log))
+    assert not got.get("partial") and got["row"]["value"] == 5.0 and got["ts"] == "t2"
+
+    sizing = dict(complete,
+                  metric="cnnet_cifar10_multikrum_n8_f2_steps_per_s_sizing_override",
+                  value=7.0)
+    with open(log, "a") as fd:
+        fd.write(json.dumps({"ts": "t4", "results": [sizing]}) + "\n")
+    got = bench._last_banked_tpu_row(str(log))
+    assert got["row"]["value"] == 7.0  # newest complete; caller gates promotion
+    assert got["row"]["metric"].endswith("_sizing_override")

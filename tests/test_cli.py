@@ -512,3 +512,23 @@ def test_runner_input_source_device_rejects_host_transform():
             "--nb-workers", "4", "--nb-decl-byz-workers", "0",
             "--max-step", "4", "--input-source", "device",
         ])
+
+
+def test_runner_digits_real_data_device_sampled(tmp_path):
+    """REAL data + device sampling: the sklearn digits corpus lives on the
+    accelerator and the unrolled trainer draws in-graph — same accuracy bar
+    as the streamed real-data run."""
+    pytest.importorskip("sklearn")
+    eval_file = str(tmp_path / "eval.tsv")
+    assert 0 == run([
+        "--experiment", "digits", "--experiment-args", "batch-size:32",
+        "--aggregator", "krum",
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--max-step", "120", "--unroll", "10", "--input-source", "device",
+        "--learning-rate-args", "initial-rate:0.1",
+        "--evaluation-delta", "120", "--evaluation-period", "-1",
+        "--evaluation-file", eval_file,
+    ])
+    lines = [l.split("\t") for l in open(eval_file).read().strip().splitlines()]
+    metrics = dict(kv.split(":", 1) for kv in lines[-1][2:])
+    assert float(metrics["accuracy"]) > 0.6, metrics
