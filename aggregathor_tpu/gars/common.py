@@ -27,30 +27,25 @@ def nonfinite_to_inf(x):
 #: per-call pad+launch is not worth displacing one small fused sort.
 PALLAS_MIN_COLUMNS = 16384
 
-_pallas_tier_suspended = False
+def _is_batched_tracer(x):
+    """True when ``x`` is being traced under ``jax.vmap`` (batching trace).
 
+    Both engines call the rules under vmap on their bucketed paths
+    (engine._aggregate_per_leaf_bucketed, sharded_engine's per-bucket
+    loop); a vmapped ``pallas_call`` lowers through Pallas' batching rule,
+    which the CPU suite exercises only in interpret mode and which is
+    UNVALIDATED on real TPU silicon here (scripts/pallas_tpu_check.py's
+    ``*-vmap4`` rows are the armed proof).  Detecting the batching trace
+    centrally means no call site can forget an opt-out wrapper; the
+    explicit ``GRAFT_GAR_TIER=pallas`` force remains the one way to
+    exercise the vmapped Pallas path end to end.
 
-class suspend_pallas_tier:
-    """Trace-time opt-out for the Pallas auto-dispatch.
-
-    The bucketed leaf path calls the rules under ``jax.vmap``; a vmapped
-    ``pallas_call`` compiles through Pallas' batching rule, which is
-    exercised in interpret mode by the CPU suite but UNVALIDATED on real
-    TPU silicon here.  Until the ``leaf_resnet`` capture stage proves it,
-    the bucketed path wraps its vmapped rule calls in this context so a
-    leaf-granularity run cannot gamble an up-window on an uncompiled code
-    path.  (Plain Python state is trace-time-correct: the flag is read
-    while the caller's jit/vmap trace is being built.)
+    Detection is by tracer class name: ``jax.interpreters.batching`` is a
+    deprecated alias in current JAX and the `_src` home may move, while
+    the class NAME is stable across versions — and a false negative here
+    would silently re-enable the unproven path.
     """
-
-    def __enter__(self):
-        global _pallas_tier_suspended
-        self._prev = _pallas_tier_suspended
-        _pallas_tier_suspended = True
-
-    def __exit__(self, *exc):
-        global _pallas_tier_suspended
-        _pallas_tier_suspended = self._prev
+    return any(c.__name__ == "BatchTracer" for c in type(x).__mro__)
 
 
 def use_pallas_coordinate_tier(block):
@@ -70,8 +65,8 @@ def use_pallas_coordinate_tier(block):
     if forced == "pallas":
         return True  # explicit force outranks the vmap suspension: it is
         # the only way to exercise/A-B the vmapped Pallas path end to end
-    if _pallas_tier_suspended:
-        return False  # vmapped context: see suspend_pallas_tier
+    if _is_batched_tracer(block):
+        return False  # vmapped call: see _is_batched_tracer
     if forced == "jnp":
         return False
     return (

@@ -360,7 +360,7 @@ class RobustEngine:
         (None unless the corresponding feature is on).  No psums needed:
         every device sees complete rows."""
         from ..gars import GAR_KEY_TAG
-        from ..gars.common import pairwise_sq_distances, suspend_pallas_tier
+        from ..gars.common import pairwise_sq_distances
 
         W = self.nb_devices
         base_key = jax.random.fold_in(key, GAR_KEY_TAG)
@@ -414,8 +414,10 @@ class RobustEngine:
                     part = None
                 return agg_leaf.astype(jnp.float32), part, leaf_rows, raw_rows
 
-            with suspend_pallas_tier():  # vmapped pallas unproven on silicon
-                aggs, parts, prep_rows, raw_rows = jax.vmap(per_leaf)(rows, idxs)
+            # (vmapped rule calls: the Pallas auto-tier detects the
+            # batching trace centrally and stays on jnp — gars/common.py
+            # _is_batched_tracer)
+            aggs, parts, prep_rows, raw_rows = jax.vmap(per_leaf)(rows, idxs)
             if parts is not None:
                 participation_sum = participation_sum + jnp.sum(parts, axis=0)
                 participation_count += len(entries)

@@ -393,6 +393,9 @@ class ShardedRobustEngine:
             part_sum = jnp.zeros((self.nb_workers,), jnp.float32)
             part_count = 0.0  # global distinct-bucket count (static)
             rep_dist = jnp.zeros((self.nb_workers,), jnp.float32)
+            # (vmapped rule calls below: the Pallas auto-tier detects the
+            # batching trace centrally and stays on jnp — gars/common.py
+            # _is_batched_tracer)
             for rows, raw_rows, g, s in zip(all_rows, raw_all_rows, g_leaves, s_leaves):
                 participation = None
                 if gar.needs_distances:
@@ -404,9 +407,9 @@ class ShardedRobustEngine:
                         # One pass: the memoized selection graph serves both
                         # the aggregate and the participation (two separate
                         # vmaps would trace it twice per leaf).
-                        agg, participation = jax.vmap(gar.aggregate_block_and_participation)(
-                            rows, dist2
-                        )
+                        agg, participation = jax.vmap(
+                            gar.aggregate_block_and_participation
+                        )(rows, dist2)
                     else:
                         agg = jax.vmap(gar.aggregate_block)(rows, dist2)
                 elif gar.uses_axis or gar.uses_key:
@@ -428,7 +431,8 @@ class ShardedRobustEngine:
                         )(rows)
                     else:
                         agg = jax.vmap(
-                            lambda r, axis=axis: gar._call_aggregate(r, None, axis_name=axis, key=gkey)
+                            lambda r, axis=axis: gar._call_aggregate(
+                                r, None, axis_name=axis, key=gkey)
                         )(rows)
                 else:
                     agg = jax.vmap(lambda r: gar.aggregate_block(r, None))(rows)

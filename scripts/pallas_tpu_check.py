@@ -156,8 +156,8 @@ def main():
     # guarded kernel — coordinate median, averaged-median, trimmed-mean,
     # AND the streamed pairwise distances — through Pallas' batching rule:
     # exercised interpret-mode by the CPU suite, proven compiled here.
-    # Green on ALL FOUR means the engine's suspend_pallas_tier() guard
-    # around the vmapped calls can be lifted.
+    # Green on ALL FOUR means the central vmap suspension
+    # (gars/common.py _is_batched_tracer) can be lifted.
     beta = max(1, args.n - args.f)
     keep = max(1, args.n - 2 * args.f)
     vmap_cases = [

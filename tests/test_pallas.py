@@ -223,6 +223,28 @@ def test_use_pallas_tier_env_force(monkeypatch):
     assert not use_pallas_coordinate_tier(np.zeros((8, 1 << 20), np.float32))
 
 
+def test_use_pallas_tier_suspends_under_vmap(monkeypatch):
+    """The auto-dispatch detects a batching trace centrally: even on a
+    'tpu' backend with a large block, a vmapped rule call stays on the
+    jnp tier (vmapped pallas_call is unproven on silicon) — while the
+    same call outside vmap dispatches."""
+    import jax
+
+    from aggregathor_tpu.gars import common
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    decisions = []
+
+    def probe(x):
+        decisions.append(common.use_pallas_coordinate_tier(x))
+        return x.sum()
+
+    big = np.zeros((2, 8, common.PALLAS_MIN_COLUMNS), np.float32)
+    jax.vmap(probe)(big)          # batched (8, d) block -> suspended
+    probe(big[0])                 # same block, plain call -> dispatches
+    assert decisions == [False, True]
+
+
 @pytest.mark.parametrize("case", CASES)
 def test_coordinate_trimmed_mean(case):
     g = _rand(**case)
