@@ -14,7 +14,10 @@
 set -u
 
 RESULTS_DIR="${RESULTS_DIR:-results}"
-PLATFORM_ARGS=${PLATFORM_ARGS:-}    # e.g. "--platform cpu --nb-devices 8"
+PLATFORM_ARGS=${PLATFORM_ARGS:-}    # extra runner flags, e.g.
+                                    #   "--platform cpu --nb-devices 8"
+                                    # or the TPU-lean input path (r4):
+                                    #   "--unroll 10 --input-source device"
 RUNNING_PID=0
 
 mkdir -p "${RESULTS_DIR}"
