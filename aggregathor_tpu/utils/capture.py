@@ -17,6 +17,12 @@ def is_complete_tpu_datum(row):
     if row.get("error"):
         return False
     detail = row.get("detail") or {}
+    if detail.get("banked_capture"):
+        # An ECHO: bench.py re-emits a previously banked TPU row as its
+        # primary result on chip-down (provenance in banked_capture_ts).
+        # It must never retire a stage or be re-selected as evidence —
+        # no measurement ran.
+        return False
     platform = row.get("platform") or detail.get("platform") or ""
     if str(row.get("metric", "")).startswith("cnnet_cifar10_multikrum"):
         # bench.py rows: complete only once the LAST phase (the bf16

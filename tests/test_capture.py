@@ -180,3 +180,47 @@ def test_banked_row_scanner_ranking(tmp_path):
     got = bench._last_banked_tpu_row(str(log))
     assert got["row"]["value"] == 7.0  # newest complete; caller gates promotion
     assert got["row"]["metric"].endswith("_sizing_override")
+    # no full-sizing row has a finished (scanned) headline yet
+    assert got.get("promotable") is None
+
+    # a full-sizing row whose HEADLINE phase finished is promotable even if
+    # a wedge cost it the bf16 secondary (not "complete" for retirement)
+    headline_done = {
+        "metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+        "value": 3.5,
+        "detail": {"platform": "tpu", "headline_source": "scanned_fresh_sampled"},
+    }
+    with open(log, "a") as fd:
+        fd.write(json.dumps({"ts": "t5", "results": [headline_done]}) + "\n")
+    got = bench._last_banked_tpu_row(str(log))
+    assert got["promotable"]["row"]["value"] == 3.5
+    assert got["promotable"]["ts"] == "t5"
+    # sizing-override rows never enter the promotable track even when their
+    # headline is scanned
+    sizing_scanned = dict(sizing, value=8.0,
+                          detail={"platform": "tpu",
+                                  "headline_source": "scanned_fresh_sync",
+                                  "bfloat16": {"steps_per_s_resident_batch": 9.0}})
+    with open(log, "a") as fd:
+        fd.write(json.dumps({"ts": "t6", "results": [sizing_scanned]}) + "\n")
+    got = bench._last_banked_tpu_row(str(log))
+    assert got["promotable"]["row"]["value"] == 3.5  # unchanged
+
+
+def test_banked_row_echoes_never_reselected(tmp_path):
+    """A chip-down bench run re-prints a banked TPU row (banked_capture) and
+    the watcher banks that print: the echo must neither retire a stage
+    (shared predicate) nor be selected by the scanner."""
+    import bench
+
+    echo = {
+        "metric": "cnnet_cifar10_multikrum_n8_f2_steps_per_s",
+        "value": 3.5,
+        "detail": {"platform": "tpu", "headline_source": "scanned_fresh_sampled",
+                   "banked_capture": True, "banked_capture_ts": "t0",
+                   "bfloat16": {"steps_per_s_resident_batch": 9.0}},
+    }
+    assert not tpu_capture._tpu_datum(echo)
+    log = tmp_path / "cap.jsonl"
+    log.write_text(json.dumps({"ts": "t9", "results": [echo]}) + "\n")
+    assert bench._last_banked_tpu_row(str(log)) is None
