@@ -1,0 +1,12 @@
+"""Accelerator hardware constants shared by the benchmark harnesses.
+
+One place for the chip envelope so a hardware change edits one file
+(consumers: bench.py, benchmarks/opt_sweep.py, benchmarks/mfu_probe.py).
+Values are for the TPU v5e (v5litepod) chip this environment tunnels to.
+"""
+
+#: bf16 matmul peak, FLOP/s per chip
+V5E_PEAK_BF16_FLOPS = 1.97e14
+
+#: HBM bandwidth, bytes/s per chip
+V5E_HBM_BYTES_PER_S = 8.19e11

@@ -55,7 +55,6 @@ import sys
 import time
 
 NORTH_STAR_STEPS_PER_S = 2000.0
-HBM_BW_BYTES_PER_S = 8.19e11  # v5e chip HBM bandwidth (819 GB/s)
 RESULT_TOKEN = "GRAFT_BENCH_RESULT "
 _T0 = time.perf_counter()
 
@@ -121,7 +120,10 @@ def run_bench(force_cpu=False, emit=lambda result: None):
     on_tpu = devices[0].platform == "tpu"
     # Whole-program FLOPs vs whole-mesh peak: nb_devices chips have
     # nb_devices x the FLOP/s budget (197 bf16 TFLOP/s per v5e chip).
-    peak = 1.97e14 * nb_devices
+    from aggregathor_tpu.utils.hw import V5E_HBM_BYTES_PER_S, V5E_PEAK_BF16_FLOPS
+
+    peak = V5E_PEAK_BF16_FLOPS * nb_devices
+    hbm_bw = V5E_HBM_BYTES_PER_S
 
     def sync(m):
         # A REAL device sync: fetch the loss to host.  Under the tunneled
@@ -266,7 +268,7 @@ def run_bench(force_cpu=False, emit=lambda result: None):
                 # Whole-program bytes vs whole-mesh bandwidth — the same
                 # convention as flops vs peak above.
                 detail["hbm_roofline_steps_per_s"] = round(
-                    HBM_BW_BYTES_PER_S * nb_devices / bytes_per_step, 2)
+                    hbm_bw * nb_devices / bytes_per_step, 2)
             _phase("%s: cost analysis %.3e flops/step, %.3e bytes/step" % (
                 tag, detail["flops_per_step"], bytes_per_step))
             # Re-emit so the current best (still per-step dispatch at this
@@ -369,7 +371,7 @@ def run_bench(force_cpu=False, emit=lambda result: None):
         if detail.get("bytes_per_step") and on_tpu:
             detail["pct_of_hbm_roofline_resident"] = round(
                 100.0 * detail["bytes_per_step"] * resident_rate
-                / (HBM_BW_BYTES_PER_S * nb_devices), 1)
+                / (hbm_bw * nb_devices), 1)
         emit(result)
 
     # The f32 HEADLINE.  Note on the MFU field names: the f32 program does

@@ -42,7 +42,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PEAK_BF16 = 1.97e14  # v5e chip peak, FLOP/s
+from aggregathor_tpu.utils.hw import V5E_PEAK_BF16_FLOPS as PEAK_BF16  # noqa: E402
 
 
 def main():

@@ -41,6 +41,10 @@ Stages (the "*pending*" cells of BENCHMARKS.md §1-2):
                     (pipeline/ring/MoE code path on silicon)
   leaf_transformer — config 5f: per-layer Krum on a transformer with 8
                     vmapped workers via the flat engine's leaf path
+  mfu_probe       — the >10% MFU demonstration: compute-dense robust
+                    training (ResNet-50 @224, n=8 krum, batch 16/worker,
+                    bf16, device-sampled input) — the BASELINE configs
+                    are bandwidth-bound by their own envelopes
 
 A stage that succeeds is recorded in ``scripts/tpu_capture_state.json`` and
 not re-run, so a short up-window makes incremental progress and the next
@@ -137,6 +141,15 @@ def _stages(py):
         ("leaf_transformer",
          b("benchmarks/train_configs.py", "--configs", "5f",
            "--steps", "20", "--platform", "tpu", "--timeout", "1500"), 1800),
+        # The >10% MFU demonstration: BASELINE configs are bandwidth-bound
+        # (config 2 by the model's own intensity, config 3 by the GAR's
+        # batch-independent n*d gradient traffic — BENCHMARKS.md); this is
+        # the compute-dense robust-training shape that can actually show
+        # MXU utilization (ResNet-50 @224, n=8 krum, batch 16/worker,
+        # bf16, device-sampled input).
+        ("mfu_probe",
+         b("benchmarks/mfu_probe.py", "--platform", "tpu",
+           "--steps", "30", "--unroll", "10"), 2400),
         # Device-sampled input (same training distribution, different PRNG
         # stream) + unroll: a 300-step cell pays the tunnel once for the
         # dataset instead of 300 times for batches — the 13x input-path
