@@ -57,6 +57,35 @@ def main():
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+
+    row = _measure(args, args.batch)
+    failed = bool(row.get("error"))
+    if _looks_oom(row.get("error")):
+        # One retry at half batch: an HBM miss must not waste the scarce
+        # up-window.  BOTH rows are printed — the retry labeled by its own
+        # batch_size_per_worker + oom_at_batch — and on a successful retry
+        # the full-batch row's error is demoted to a non-error ``oom``
+        # field so the watcher retires the stage on the half-batch datum
+        # (a full-batch re-attempt would just re-OOM) while the record
+        # still shows what was tried.
+        retry = _measure(args, max(1, args.batch // 2))
+        retry["oom_at_batch"] = args.batch
+        if not retry.get("error"):
+            row["oom"] = row.pop("error")
+            failed = False
+        print(json.dumps(row), flush=True)
+        row = retry
+    print(json.dumps(row), flush=True)
+    sys.exit(1 if failed or row.get("error") else 0)
+
+
+def _looks_oom(error):
+    text = (error or "").lower()
+    return "resource_exhausted" in text or "out of memory" in text
+
+
+def _measure(args, batch):
+    import jax
     import numpy as np
     import optax
 
@@ -68,7 +97,7 @@ def main():
         "metric": "mfu_probe_resnet50_krum",
         "platform": "uninitialized",
         "workers": args.workers, "byz": args.byz,
-        "batch_size_per_worker": args.batch,
+        "batch_size_per_worker": batch,
         "image_size": args.image_size,
         "unroll": args.unroll,
         "unit": "steps/s",
@@ -80,9 +109,9 @@ def main():
         platform = row["platform"] = jax.devices()[0].platform
         exp = models.instantiate(
             "slim-resnet_v1_50-imagenet",
-            ["batch-size:%d" % args.batch, "image-size:%d" % args.image_size,
+            ["batch-size:%d" % batch, "image-size:%d" % args.image_size,
              "dtype:bfloat16", "augment:device",
-             "eval-batch-size:%d" % args.batch],
+             "eval-batch-size:%d" % batch],
         )
         gar = gars.instantiate("krum", args.workers, args.byz)
         mesh = make_mesh(nb_workers=1, devices=jax.devices()[:1])
@@ -106,7 +135,7 @@ def main():
             pass
 
         multi = engine.build_sampled_multi_step(
-            exp.loss, tx, repeat_steps=args.unroll, batch_size=args.batch)
+            exp.loss, tx, repeat_steps=args.unroll, batch_size=batch)
         data = engine.replicate(exp.train_arrays())
 
         def sync(m):
@@ -132,8 +161,7 @@ def main():
                     100.0 * row["bytes_per_step"] * rate / HBM_BW, 1)
     except Exception as exc:
         row["error"] = "%s: %s" % (type(exc).__name__, str(exc)[:300])
-    print(json.dumps(row), flush=True)
-    sys.exit(1 if row.get("error") else 0)
+    return row
 
 
 if __name__ == "__main__":

@@ -224,3 +224,52 @@ def test_banked_row_echoes_never_reselected(tmp_path):
     log = tmp_path / "cap.jsonl"
     log.write_text(json.dumps({"ts": "t9", "results": [echo]}) + "\n")
     assert bench._last_banked_tpu_row(str(log)) is None
+
+
+def test_mfu_probe_oom_retry_flow(monkeypatch, capsys):
+    """mfu_probe's OOM handling: a half-batch retry prints BOTH rows (the
+    full-batch row's error demoted to a non-error 'oom' field so the stage
+    can retire on the half-batch datum), a failed retry keeps both error
+    rows, and non-OOM errors never retry."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmarks"))
+    import mfu_probe
+
+    assert mfu_probe._looks_oom("RESOURCE_EXHAUSTED: while allocating")
+    assert mfu_probe._looks_oom("XlaRuntimeError: Out of memory in HBM")
+    assert not mfu_probe._looks_oom("ValueError: bad shape")
+    assert not mfu_probe._looks_oom(None)
+
+    def run(measure_results, argv):
+        results = list(measure_results)
+        monkeypatch.setattr(mfu_probe, "_measure",
+                            lambda args, batch: dict(results.pop(0), batch=batch))
+        monkeypatch.setattr(sys, "argv", ["mfu_probe.py", "--platform", ""] + argv)
+        code = None
+        try:
+            mfu_probe.main()
+        except SystemExit as exc:
+            code = exc.code
+        rows = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+        return code, rows
+
+    # OOM then success: both rows printed, first demoted, exit 0
+    code, rows = run([
+        {"platform": "tpu", "error": "RESOURCE_EXHAUSTED: hbm"},
+        {"platform": "tpu", "value": 9.0},
+    ], ["--batch", "16"])
+    assert code == 0 and len(rows) == 2
+    assert "error" not in rows[0] and rows[0]["oom"].startswith("RESOURCE")
+    assert rows[1]["oom_at_batch"] == 16 and rows[1]["batch"] == 8
+
+    # OOM then failed retry: both error rows, exit 1
+    code, rows = run([
+        {"platform": "tpu", "error": "RESOURCE_EXHAUSTED: hbm"},
+        {"platform": "tpu", "error": "ValueError: nope"},
+    ], ["--batch", "16"])
+    assert code == 1 and len(rows) == 2
+    assert rows[0]["error"] and rows[1]["error"]
+
+    # non-OOM error: single row, no retry, exit 1
+    code, rows = run([{"platform": "cpu", "error": "ValueError: bad"}], [])
+    assert code == 1 and len(rows) == 1
