@@ -40,12 +40,22 @@ def _is_batched_tracer(x):
     explicit ``GRAFT_GAR_TIER=pallas`` force remains the one way to
     exercise the vmapped Pallas path end to end.
 
-    Detection is by tracer class name: ``jax.interpreters.batching`` is a
-    deprecated alias in current JAX and the `_src` home may move, while
-    the class NAME is stable across versions — and a false negative here
-    would silently re-enable the unproven path.
+    Detection is isinstance-first against the real tracer class (imported
+    from its current `_src` home), with the class-NAME scan as fallback in
+    case the module moves in a future JAX — a false negative here would
+    silently re-enable the unproven path, so
+    ``tests/test_pallas.py::test_batched_tracer_detected_under_vmap``
+    fails loudly if neither detection fires under ``jax.vmap``.
     """
+    if _BATCH_TRACER_CLS is not None and isinstance(x, _BATCH_TRACER_CLS):
+        return True
     return any(c.__name__ == "BatchTracer" for c in type(x).__mro__)
+
+
+try:  # the canonical home today; the name-scan above covers a future move
+    from jax._src.interpreters.batching import BatchTracer as _BATCH_TRACER_CLS
+except ImportError:  # pragma: no cover
+    _BATCH_TRACER_CLS = None
 
 
 def use_pallas_coordinate_tier(block):
@@ -60,6 +70,14 @@ def use_pallas_coordinate_tier(block):
     differs, so low bits can (asserted on NaN-poisoned inputs by
     tests/test_pallas.py and on silicon by scripts/pallas_tpu_check.py).
     ``GRAFT_GAR_TIER=jnp|pallas`` forces a tier (tests, A/B timing).
+
+    Gating note (ADVICE r4): unlike the vmapped path (suspended until its
+    armed silicon proof lands), the un-batched in-engine tier stays ON by
+    default even though its standalone-kernel silicon proof does not cover
+    the full shard_map/scan step — the kernels make the same selections as
+    the jnp tier by construction, the train_configs 2d/3d stages are armed
+    to exercise exactly this path on silicon, and ``GRAFT_GAR_TIER=jnp``
+    is the escape hatch if they surface a divergence.
     """
     forced = os.environ.get("GRAFT_GAR_TIER")
     if forced == "pallas":

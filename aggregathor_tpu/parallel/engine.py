@@ -696,7 +696,10 @@ class RobustEngine:
         measured bound — config 2 streams at ~2.0 steps/s while the same
         program with the batch already resident runs at ~26 steps/s
         (bench_mini, round 4).  The reference streams each worker's batches
-        through a local tf.data pipeline every step (graph.py:224-233); the
+        through a local queue-runner pipeline every step (graph.py:251-254
+        places each worker's input ops on that task's CPU; the pipeline
+        itself is the experiment's DatasetDataProvider + tf.train.batch +
+        prefetch_queue stack, experiments/cnnet.py:127-141); the
         TPU-native equivalent is to transfer the dataset ONCE (CIFAR-10
         train is ~0.6 GB in f32 — a few percent of HBM) and gather each
         worker's sampled rows in-graph, so every step still trains on a

@@ -12,7 +12,11 @@ Two timing modes are reported:
     The headline is the scanned trainer (best of synchronous, prefetched,
     and device-sampled input sourcing — detail.headline_source says which;
     device-sampled holds the dataset on-chip, transferred once, and gathers
-    each worker's fresh i.i.d. batch in-graph); a per-step-dispatch
+    each worker's fresh i.i.d. batch in-graph).  A device-sampled WIN
+    renames the metric with a ``_device_input_`` infix and keeps the best
+    streamed rate in detail.steps_per_s_streamed, so streamed rows from
+    earlier rounds are never compared to a different input architecture
+    under one name (ADVICE r4); a per-step-dispatch
     figure is emitted EARLY as a provisional stand-in (smallest compile
     first, wedge-resilience below) and is replaced the moment the scanned
     loop is measured, remaining in detail.per_step_dispatch;
@@ -337,6 +341,12 @@ def run_bench(force_cpu=False, emit=lambda result: None):
         # the per-step host->device transfer that bounds phases c/d.
         arrays = experiment.train_arrays()
         if arrays is not None:  # None = a host transform must see each batch
+            # The best STREAMED rate (sync/prefetched — both pay the host
+            # iterator + host->device transfer, like the reference's input
+            # architecture) is recorded unconditionally, so cross-round and
+            # vs-reference comparisons stay apples-to-apples even when the
+            # device-sampled program wins the headline below (ADVICE r4).
+            detail["steps_per_s_streamed"] = round(best_fresh, 3)
             sampled_fn = engine.build_sampled_multi_step(
                 experiment.loss, tx, repeat_steps=unroll, batch_size=batch_size)
             dataset = engine.replicate(arrays)
@@ -350,6 +360,13 @@ def run_bench(force_cpu=False, emit=lambda result: None):
                 "steps_per_s": round(sampled_fresh, 3), "timed_steps": unroll * n_chunks}
             if sampled_fresh > best_fresh:
                 best_fresh = sampled_fresh
+                if is_headline and "_device_input_" not in result["metric"]:
+                    # A device-sampled headline measures a different input
+                    # architecture than the streamed rows of earlier rounds;
+                    # the metric NAME says so (suffix order keeps the
+                    # banked-row scanner's startswith/endswith checks valid).
+                    result["metric"] = result["metric"].replace(
+                        "_steps_per_s", "_device_input_steps_per_s")
                 refresh(best_fresh, "scanned_fresh_sampled", unroll * n_chunks)
             else:
                 emit(result)

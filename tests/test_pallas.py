@@ -245,6 +245,30 @@ def test_use_pallas_tier_suspends_under_vmap(monkeypatch):
     assert decisions == [False, True]
 
 
+def test_batched_tracer_detected_under_vmap():
+    """ADVICE r4: the vmap suspension must not silently die with a JAX
+    upgrade.  The isinstance path must be LIVE (the tracer class resolves
+    from its current home) and _is_batched_tracer must fire under vmap by
+    isinstance alone, not only by the class-name fallback."""
+    import jax
+
+    from aggregathor_tpu.gars import common
+
+    assert common._BATCH_TRACER_CLS is not None, (
+        "BatchTracer moved: update the import in gars/common.py or the "
+        "vmapped-Pallas suspension rests on the name-scan fallback alone")
+    seen = []
+
+    def probe(x):
+        seen.append((common._is_batched_tracer(x),
+                     isinstance(x, common._BATCH_TRACER_CLS)))
+        return x
+
+    jax.vmap(probe)(np.zeros((2, 4), np.float32))
+    probe(np.zeros((4,), np.float32))
+    assert seen == [(True, True), (False, False)]
+
+
 @pytest.mark.parametrize("case", CASES)
 def test_coordinate_trimmed_mean(case):
     g = _rand(**case)
