@@ -22,6 +22,7 @@ score update a vector subtraction.  All t selection rows are emitted as one
 apply unchanged to dimension-sharded column blocks.
 """
 
+import jax
 import jax.numpy as jnp
 
 from . import GAR, register
@@ -63,15 +64,23 @@ class BulyanGAR(GAR):
         ranks = jnp.argsort(order, axis=-1)  # inverse permutation = ranks
         pruned = jnp.where(ranks < in_score, clean, 0.0)
         scores = jnp.sum(pruned, axis=-1)
-        # Selection loop (t is small and static: unrolled at trace time).
-        rows = []
-        for k in range(self.nb_selections):
-            rows.append(selection_mean_weights(scores, self.nb_multikrum - k))
-            if k + 1 < self.nb_selections:
-                best = jnp.argmin(nonfinite_to_inf(scores))
-                scores = scores - pruned[:, best]
-                scores = scores.at[best].set(jnp.inf)
-        return jnp.stack(rows, axis=0)
+
+        # Selection loop as a lax.scan: the trace/compile cost stays FLAT in
+        # t (= n - 2f - 2), where the previous trace-time unrolling grew the
+        # graph by t copies of the O(n²) rank mask — prohibitive at the
+        # reference-plausible n = 512-1024, whose C++ loop had no such limit
+        # (op_bulyan/cpu.cpp:134-161).  The final round's carry update is
+        # computed and discarded (the reference guards it with k+1 < t; the
+        # scan output is identical since only the emitted rows matter).
+        def one_round(live, k):
+            row = selection_mean_weights(live, self.nb_multikrum - k)
+            best = jnp.argmin(nonfinite_to_inf(live))
+            nxt = (live - jnp.take(pruned, best, axis=1)).at[best].set(jnp.inf)
+            return nxt, row
+
+        _, rows = jax.lax.scan(
+            one_round, scores, jnp.arange(self.nb_selections))
+        return rows
 
     def aggregate_block(self, block, dist2=None):
         assert dist2 is not None, "bulyan requires the pairwise distance matrix"

@@ -164,8 +164,11 @@ def smallest_k_mask(scores, k):
 
 
 def selection_mean_weights(scores, k):
-    """(n,) weights averaging the k smallest-scoring rows: mask / k."""
-    return smallest_k_mask(scores, k).astype(jnp.float32) / float(k)
+    """(n,) weights averaging the k smallest-scoring rows: mask / k.
+
+    ``k`` may be a Python int or a traced scalar (Bulyan's lax.scan passes
+    the round index)."""
+    return smallest_k_mask(scores, k).astype(jnp.float32) / jnp.asarray(k, jnp.float32)
 
 
 def alive_rows(rows, axis_name=None):

@@ -71,6 +71,13 @@ def main():
                 "trimmed-mean,centered-clip,geometric-median,bucketing,dnc",
     )
     ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--scale-ns", default=None,
+                    help="comma list of worker counts: sweep krum+bulyan at "
+                         "--scale-d, reporting COMPILE seconds + kernel ms "
+                         "(the reference's C++ selection loop had no n limit, "
+                         "op_bulyan/cpu.cpp:134-161; Bulyan's lax.scan form "
+                         "must keep compile time flat in t = n - 2f - 2)")
+    ap.add_argument("--scale-d", type=int, default=65536)
     ap.add_argument("--platform", default=None, help="force a JAX platform")
     ap.add_argument("--resume-file", default=None,
                     help="JSON path recording completed (rule, tier, d) "
@@ -95,8 +102,8 @@ def main():
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     native_ok = native.available()
-    rules = args.rules.split(",")
-    dims = [int(d) for d in args.dims.split(",")]
+    rules = args.rules.split(",") if args.rules else []
+    dims = [int(d) for d in args.dims.split(",") if d]  # "" = scale-n only
     rows = []
     resume = load_json(args.resume_file) if args.resume_file else {}
 
@@ -159,6 +166,38 @@ def main():
                 measured(rule, "native", d, f,
                          lambda: time_fn(call, host_sync, max(3, args.reps // 4)))
 
+    scale_rows = []
+    if args.scale_ns:
+        d = args.scale_d
+        for n in (int(x) for x in args.scale_ns.split(",")):
+            f = max(1, (n - 3) // 4)  # the largest f Bulyan admits at n
+            g = None  # lazily built: a fully-cached n costs no fixture
+            for rule in ("krum", "bulyan"):
+                key = "scale|%s|%d|%d|%d" % (rule, n, d, args.reps)
+                cached = resume.get(key)
+                if cached is not None:
+                    compile_s, ms = cached
+                else:
+                    if g is None:
+                        g = jax.device_put(np.random.default_rng(n).normal(
+                            size=(n, d)).astype(np.float32))
+                    agg = jax.jit(gars.instantiate(rule, n, f).aggregate)
+                    # PURE trace+compile time (the flatness claim): AOT
+                    # lower+compile, no execution or host fetch mixed in.
+                    t0 = time.perf_counter()
+                    compiled = agg.lower(g).compile()
+                    compile_s = time.perf_counter() - t0
+                    ms = time_fn(lambda: compiled(g), dev_sync, max(3, args.reps // 2))
+                    if args.resume_file:
+                        resume[key] = [compile_s, ms]
+                        save_json_atomic(args.resume_file, resume)
+                scale_rows.append({
+                    "metric": "gar_scale_n", "rule": rule,
+                    "tier": "jnp:" + platform, "n": n, "f": f, "d": d,
+                    "compile_s": round(compile_s, 2),
+                    "value": round(ms, 4), "unit": "ms",
+                })
+
     print("%-18s %-12s %12s %12s" % ("rule", "tier", "d", "ms"))
     for rule, tier, d, ms, f in rows:
         print("%-18s %-12s %12d %12.3f" % (rule, tier, d, ms))
@@ -177,6 +216,8 @@ def main():
                 }
             )
         )
+    for row in scale_rows:
+        print(json.dumps(row))
 
 
 if __name__ == "__main__":

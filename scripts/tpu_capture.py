@@ -161,6 +161,32 @@ def _stages(py):
            "--experiment-args-extra", "augment:device",
            "--runner-args", "--unroll 10 --input-source device",
            "--resume-file", "benchmarks/resume_robustness.json"), 8400),
+        # VERDICT r4 task 4: kernel ms at reference-plausible worker counts
+        # (compile time is the claim; it is stated per-cell as compile_s).
+        ("scale_n",
+         b("benchmarks/gar_kernels.py", "--rules", "", "--dims", "",
+           "--scale-ns", "128,512,1024", "--scale-d", "65536", "--reps", "10",
+           "--resume-file", "benchmarks/resume_scale_n.json"), 2400),
+        # VERDICT r4 task 3 (conv-scale REAL-data robustness): the cnnet
+        # topology on real digits32 (docs/robustness.md "Why not real
+        # CIFAR-10"), device-sampled so 600-step cells fit the window.
+        ("digits_conv_robustness",
+         b("benchmarks/robustness.py", "--experiment", "digits-conv",
+           "--steps", "600", "--batch", "32", "--rules", "average,krum,median",
+           "--attacks", "none,little,empire",
+           "--platform", "tpu", "--timeout", "600",
+           "--runner-args", "--unroll 10 --input-source device",
+           "--resume-file", "benchmarks/resume_digits_conv.json"), 6000),
+        # VERDICT r4 task 6: zoo accuracy-parity spot check — ResNet-50
+        # (GroupNorm variant) on REAL data (digits32) through the real CLI,
+        # clean + Krum, device-sampled input.
+        ("zoo_parity",
+         b("benchmarks/robustness.py", "--experiment", "slim-resnet_v1_50-digits32",
+           "--steps", "2000", "--batch", "32", "--rules", "average,krum",
+           "--attacks", "none", "--platform", "tpu", "--timeout", "1500",
+           "--experiment-args-extra", "preprocessing:none augment:device",
+           "--runner-args", "--unroll 10 --input-source device",
+           "--resume-file", "benchmarks/resume_zoo_parity.json"), 3600),
     ]
 
 
