@@ -22,8 +22,11 @@ verification requirement. The C++ implementation (ops/native/auth.cpp)
 exists for native-tier parity with the reference's C++/libsodium signing
 layer and for hosts whose Python lacks an accelerated hashlib; the stdlib
 fallback keeps the API identical where the library cannot build. For the
-control plane, JAX's multi-host runtime rides gRPC — enabling TLS there is
-deployment configuration, documented in docs/transport.md.
+control plane: the runtime's own coordination channel exposes no TLS knob to
+guest code (docs/transport.md "In-flight closure"), so every payload THIS
+framework puts on the wire is encrypted-then-MACed under the session secret
+(``authenticate_processes``) — channel security for the runtime's internal
+traffic remains deployment configuration.
 """
 
 import hashlib
@@ -165,6 +168,16 @@ def authenticate_processes(session_secret, params, step=0, verify_equal=True):
     correct for replicated layouts (the flat engine); sharded layouts hold
     different bytes per host and skip it.
 
+    In-flight confidentiality: the exchanged payload is the digest
+    ENCRYPTED under a context-separated key from the same secret
+    (encrypt-then-MAC — the tag covers the ciphertext), so the framework's
+    own cross-host control material is confidential and authenticated
+    end-to-end regardless of the underlying channel's security.  The
+    runtime's OWN coordination channel cannot be TLS'd from guest code
+    (docs/transport.md "In-flight closure"); this covers every byte this
+    framework chooses to put on the wire — the reference's TLS patch
+    protected the same class of payloads (grpc_channel.patch:70-85).
+
     Raises ``UserException`` naming the offending ranks.
     """
     import jax
@@ -174,20 +187,35 @@ def authenticate_processes(session_secret, params, step=0, verify_equal=True):
 
     nb, pid = jax.process_count(), jax.process_index()
     auth = GradientAuthenticator(session_secret, nb, context=b"handshake")
+    from .crypto import SnapshotCipher
+
+    cipher = SnapshotCipher(session_secret, context=b"handshake-enc")
     digest = state_digest(params)
-    tag = auth.sign(pid, step, digest)
-    mine = np.frombuffer(digest + tag, np.uint8)
+    ct = cipher.encrypt(step, digest)
+    ct_len = len(ct)  # deterministic: MAGIC + nonce + SENTINEL + 32
+    tag = auth.sign(pid, step, ct)
+    mine = np.frombuffer(ct + tag, np.uint8)
     if nb == 1:
         gathered = mine[None]
     else:
         from jax.experimental import multihost_utils
 
         gathered = np.asarray(multihost_utils.process_allgather(mine))
-    bad = [
-        rank for rank in range(nb)
-        if not auth.verify(rank, step, gathered[rank, :32].tobytes(),
-                           gathered[rank, 32:].tobytes())
-    ]
+
+    def _digest_of(rank):
+        """Rank's digest if its payload authenticates AND decrypts; None
+        otherwise (wrong secret fails the tag already; a tag-valid payload
+        that will not decrypt is equally unauthenticated)."""
+        row_ct = gathered[rank, :ct_len].tobytes()
+        if not auth.verify(rank, step, row_ct, gathered[rank, ct_len:].tobytes()):
+            return None
+        try:
+            return cipher.decrypt(step, row_ct)
+        except UserException:
+            return None
+
+    digests = {rank: _digest_of(rank) for rank in range(nb)}
+    bad = [rank for rank in range(nb) if digests[rank] is None]
     if bad:
         raise UserException(
             "Host authentication FAILED for process(es) %s: payload tampered or "
@@ -198,7 +226,7 @@ def authenticate_processes(session_secret, params, step=0, verify_equal=True):
     if verify_equal:
         mismatched = [
             rank for rank in range(nb)
-            if gathered[rank, :32].tobytes() != digest
+            if digests[rank] != digest
         ]
         if mismatched:
             raise UserException(

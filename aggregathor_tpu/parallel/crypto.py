@@ -1,15 +1,17 @@
-"""At-rest encryption for persisted state (off the hot path).
+"""Payload encryption under the session secret (off the hot path).
 
 The reference ships working TLS channels for its control plane
 (tf_patches/patches/grpc_channel.patch:70-85, ``SECURE_GRPC=1``): gradient
 and state bytes crossing its open network are encrypted in flight.  Under
-single-controller SPMD the in-flight surface is the TPU interconnect
-(not addressable by guest code — docs/transport.md) and the multi-host
-control plane (gRPC, TLS-configurable at deployment); what the *framework*
-still persists in the clear is the checkpoint: full model state on shared
-disk.  This module closes that surface with an executable confidentiality
-story: snapshots are encrypted under a key derived from the same session
-secret that already authenticates them.
+single-controller SPMD the in-flight surface is the TPU interconnect (not
+addressable by guest code) and the multi-host control plane, whose
+runtime-internal channel exposes no TLS knob to guest code
+(docs/transport.md "In-flight closure") — so this module encrypts the
+BYTES the framework itself owns, wherever they travel: checkpoint
+snapshots persisted to shared disk (``--encrypt-checkpoints``) and the
+bring-up handshake payloads exchanged across hosts
+(``auth.authenticate_processes``, context ``b"handshake-enc"``), each
+under a key derived from the same session secret that authenticates them.
 
 Construction (stdlib-only — the environment has no AEAD library, and the
 box's pip is sealed):
@@ -58,13 +60,17 @@ def _xor(data, stream):
 
 
 class SnapshotCipher:
-    """Encrypts/decrypts snapshot byte blobs under a session-secret key.
+    """Encrypts/decrypts byte blobs under a session-secret key.
 
     Step binding: the step number seasons the keystream, so two snapshots
-    at different steps never share a keystream even under nonce reuse."""
+    at different steps never share a keystream even under nonce reuse.
 
-    def __init__(self, session_secret):
-        self.key = derive_worker_key(session_secret, 0, context=b"ckpt-enc")
+    ``context`` selects the key family (default: checkpoint encryption);
+    the bring-up handshake passes ``b"handshake-enc"`` so control-plane
+    ciphertext and checkpoint ciphertext never share keys."""
+
+    def __init__(self, session_secret, context=b"ckpt-enc"):
+        self.key = derive_worker_key(session_secret, 0, context=context)
 
     def encrypt(self, step, data):
         nonce = os.urandom(_NONCE_BYTES)

@@ -258,3 +258,68 @@ def test_checkpoint_legacy_tag_migration(tmp_path, backend):
     strict = Checkpoints(str(tmp_path), authenticator=auth, allow_legacy_tags=False)
     with pytest.raises(UserException):
         strict.restore(S(step=jnp.int32(0), value=jnp.zeros(4)))
+
+
+def test_handshake_payload_encrypted_in_flight(monkeypatch):
+    """In-flight confidentiality of the bring-up handshake (transport.md
+    "In-flight closure"): the bytes a process puts on the cross-host wire
+    are ciphertext (the plaintext state digest never appears), the tag
+    covers the ciphertext, and a peer with a different secret — or a
+    payload tampered in flight — is named and rejected."""
+    import jax
+    import jax.numpy as jnp
+
+    from aggregathor_tpu.utils import UserException
+
+    params = {"w": jnp.arange(8, dtype=jnp.float32)}
+    digest = auth_mod.state_digest(params)
+    wire = {}
+
+    def fake_allgather(mine):
+        wire["mine"] = bytes(np.asarray(mine).tobytes())
+        rows = [wire["mine"], wire.get("peer", wire["mine"])]
+        return np.stack([np.frombuffer(r, np.uint8) for r in rows])
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+
+    from aggregathor_tpu.parallel.crypto import SnapshotCipher
+
+    peer_auth = GradientAuthenticator(b"s3cret", 2, context=b"handshake")
+    peer_cipher = SnapshotCipher(b"s3cret", context=b"handshake-enc")
+
+    # Honest peer (same secret, same params, signing as rank 1): succeeds,
+    # and the wire bytes never contain the plaintext digest.
+    ct = peer_cipher.encrypt(0, digest)
+    wire["peer"] = ct + peer_auth.sign(1, 0, ct)
+    assert auth_mod.authenticate_processes(b"s3cret", params) == 2
+    assert digest not in wire["mine"] and digest not in wire["peer"]
+
+    # A peer that knows the secret but holds different parameter bytes:
+    # rejected by the digest-equality check (not the auth check), which
+    # requires the verifier to successfully DECRYPT the peer's payload.
+    other = auth_mod.state_digest({"w": jnp.ones(8, dtype=jnp.float32)})
+    ct = peer_cipher.encrypt(0, other)
+    wire["peer"] = ct + peer_auth.sign(1, 0, ct)
+    with pytest.raises(UserException, match="DIVERGED.*1"):
+        auth_mod.authenticate_processes(b"s3cret", params)
+
+    # Wrong-secret peer: its tag cannot verify -> named as unauthenticated.
+    bad_auth = GradientAuthenticator(b"wrong", 2, context=b"handshake")
+    bad_cipher = SnapshotCipher(b"wrong", context=b"handshake-enc")
+    ct = bad_cipher.encrypt(0, digest)
+    wire["peer"] = ct + bad_auth.sign(1, 0, ct)
+    with pytest.raises(UserException, match="FAILED.*1"):
+        auth_mod.authenticate_processes(b"s3cret", params)
+
+    # In-flight tampering: flip one ciphertext byte of an honest, correctly
+    # rank-1-signed payload — encrypt-then-MAC rejects before decrypting.
+    ct = peer_cipher.encrypt(0, digest)
+    honest = bytearray(ct + peer_auth.sign(1, 0, ct))
+    honest[30] ^= 0x01
+    wire["peer"] = bytes(honest)
+    with pytest.raises(UserException, match="FAILED.*1"):
+        auth_mod.authenticate_processes(b"s3cret", params)
