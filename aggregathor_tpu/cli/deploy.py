@@ -55,6 +55,12 @@ def build_parser():
         "--local-simulate", type=int, default=0, metavar="K",
         help="fork K local CPU processes forming a cluster on localhost (single-machine parity)",
     )
+    parser.add_argument("--devices-per-process", type=int, default=1,
+                        help="(--local-simulate only) virtual CPU devices "
+                             "per forked process, so a K-process x D-device "
+                             "cluster — the reference's multi-node multi-GPU "
+                             "shape (deploy.py:244-309) — is testable on one "
+                             "machine")
     parser.add_argument("--port", type=int, default=None,
                         help="coordinator port when the spec names none (default 7000, "
                              "the reference's fixed port, tools/cluster.py:60)")
@@ -66,13 +72,16 @@ def _strip_separator(rest):
     return rest[1:] if rest and rest[0] == "--" else rest
 
 
-def local_simulate(nb_processes, port, runner_args):
+def local_simulate(nb_processes, port, runner_args, devices_per_process=1):
     """Fork a K-process localhost cluster (CPU devices) running the runner."""
     procs = []
     for rank in range(nb_processes):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
-        env.pop("XLA_FLAGS", None)  # one device per process: the cluster IS the mesh
+        env.pop("XLA_FLAGS", None)  # default: the cluster IS the mesh
+        if devices_per_process > 1:
+            env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=%d" % devices_per_process)
         cmd = [
             sys.executable, "-m", "aggregathor_tpu.cli.deploy",
             "--coordinator-address", "127.0.0.1:%d" % port,
@@ -90,10 +99,20 @@ def local_simulate(nb_processes, port, runner_args):
 def main(argv=None):
     args = build_parser().parse_args(argv)
     runner_args = _strip_separator(args.runner_args)
+    if args.devices_per_process != 1 and args.local_simulate <= 0:
+        from ..utils import UserException
+
+        raise UserException(
+            "--devices-per-process shapes the forked --local-simulate "
+            "cluster only; for a real cluster set XLA_FLAGS="
+            "--xla_force_host_platform_device_count (or run on real chips) "
+            "in each process' environment"
+        )
     if args.local_simulate > 0:
         from ..utils.cluster import DEFAULT_PORT
 
-        return local_simulate(args.local_simulate, args.port or DEFAULT_PORT, runner_args)
+        return local_simulate(args.local_simulate, args.port or DEFAULT_PORT,
+                              runner_args, args.devices_per_process)
     if args.cluster is not None:
         if (
             args.coordinator_address is not None

@@ -176,6 +176,11 @@ def build_parser():
     parser.add_argument("--UDP-args", nargs="*", default=[], dest="udp_args", help="key:value lossy-link arguments")
     parser.add_argument("--trace", action="store_true", help="capture a jax.profiler trace of a few steps")
     parser.add_argument("--trace-dir", default="trace", help="profiler trace output directory")
+    parser.add_argument("--trace-ops", action="store_true",
+                        help="per-op terminal narrative: print a marker after "
+                             "each phase of the step body (gradients, "
+                             "aggregate, apply) — the reference's op-bracket "
+                             "trace (tools/tf.py:41-58); debug cadence only")
     # Mesh (replaces cluster/job flags, reference: runner.py:81-93, 220-231)
     parser.add_argument("--nb-devices", type=int, default=None, help="devices on the worker mesh axis")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
@@ -407,6 +412,11 @@ def main(argv=None):
                     "--leaf-bucketing applies to the flat engine's leaf path "
                     "only; the sharded engine always aggregates per bucket"
                 )
+            if args.trace_ops:
+                warning(
+                    "--trace-ops narrates the flat engine's step body only; "
+                    "ignored under --mesh (use --trace for a profiler window)"
+                )
             # ``vector`` (the flat default) means whole-vector selection,
             # which the sharded engine spells ``global`` (one global (n, n)
             # distance matrix accumulated across shards).
@@ -456,6 +466,7 @@ def main(argv=None):
                 quarantine_threshold=args.quarantine_threshold,
                 granularity=args.granularity,
                 leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
+                trace_ops=args.trace_ops,
             )
 
             # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)

@@ -14,7 +14,7 @@ corpus and the input shape differ.
 """
 
 from . import register
-from .datasets import load_digits8x8
+from .datasets import load_digits8x8, load_digits_upscaled
 from .mnist import MNISTExperiment
 
 
@@ -23,4 +23,25 @@ class DigitsExperiment(MNISTExperiment):
     load_dataset = staticmethod(load_digits8x8)
 
 
+class DigitsConvExperiment(DigitsExperiment):
+    """The reference's flagship conv topology on REAL data.
+
+    The reference's headline experiment is cnnet on CIFAR-10
+    (experiments/cnnet.py:115-146); real CIFAR bytes are unobtainable on
+    this box, so the SAME conv stack (models/cnnet.CNNet: 2x conv5x5-64 +
+    3x3/2 max-pools, dense 384/192 — experiments/cnnet.py:137-146) trains
+    on the real digits corpus upscaled to 32x32 — the conv-scale
+    real-data accuracy anchor (docs/robustness.md)."""
+
+    sample_shape = (32, 32, 1)
+    load_dataset = staticmethod(load_digits_upscaled)
+
+    def __init__(self, args):
+        super().__init__(args)
+        from .cnnet import CNNet
+
+        self.model = CNNet(classes=self.dataset.nb_classes)
+
+
 register("digits", DigitsExperiment)
+register("digits-conv", DigitsConvExperiment)

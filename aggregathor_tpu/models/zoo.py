@@ -20,7 +20,13 @@ import optax
 from ..utils import parse_keyval
 from . import Experiment, register
 from .classic import AlexNetV2, CifarNet, LeNet, OverFeat
-from .datasets import WorkerBatchIterator, eval_batches, load_cifar10, load_imagenet
+from .datasets import (
+    WorkerBatchIterator,
+    eval_batches,
+    load_cifar10,
+    load_digits_upscaled,
+    load_imagenet,
+)
 from .inception import InceptionResNetV2, InceptionV1, InceptionV2, InceptionV3, InceptionV4
 from .mobilenet import (
     MOBILENET_MULTIPLIERS,
@@ -103,6 +109,11 @@ AUX_CAPABLE = {"inception_v1", "inception_v3", "inception_v4", "inception_resnet
 DATASETS = {
     "cifar10": lambda kv: load_cifar10(),
     "imagenet": lambda kv: load_imagenet(image_size=kv["image-size"]),
+    # REAL data on a zero-egress box (datasets.load_digits_upscaled): the
+    # zoo's accuracy-parity anchor — cifar10/imagenet above fall back to
+    # synthetic stand-ins when no local shards exist, so committed zoo
+    # accuracies that must mean something (VERDICT r4 task 6) train here.
+    "digits32": lambda kv: load_digits_upscaled(32),
 }
 
 

@@ -131,7 +131,7 @@ class RobustEngine:
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
-                 granularity="vector", leaf_bucketing="auto"):
+                 granularity="vector", leaf_bucketing="auto", trace_ops=False):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -145,6 +145,13 @@ class RobustEngine:
         # independent of nb_workers/device placement — the same discipline
         # as the host tier (models/preprocessing.py).
         self.batch_transform = batch_transform
+        # Per-op terminal narrative (the reference's --trace brackets every
+        # loss/gradient/aggregate op with begin/end prints, tools/tf.py:41-58;
+        # its graph-level equivalent here is a runtime jax.debug.print after
+        # each phase of the step body, value-anchored so the callback sits at
+        # the phase boundary in the compiled program).  Debug-cadence only —
+        # each device narrates, and the host callback costs real time.
+        self.trace_ops = bool(trace_ops)
         # Opt-in per-worker suspicion diagnostics (worker_sq_dist / worker_
         # participation metrics); off by default — the extra O(n·d) pass is
         # a measurable HBM tax at scale.
@@ -521,6 +528,15 @@ class RobustEngine:
         W = self.nb_devices
 
         def body(state, batch):
+            def mark(fmt, **kw):
+                # Anchored on the values it prints, so the callback cannot be
+                # hoisted across the phase it brackets (XLA preserves the
+                # data dependency; pure prints could reorder freely).
+                if self.trace_ops:
+                    jax.debug.print(
+                        "TRACE step {step} dev {dev} " + fmt,
+                        step=state.step, dev=jax.lax.axis_index(worker_axis), **kw)
+
             key = jax.random.fold_in(state.rng, state.step)
             if self.batch_transform is not None:
                 k = self.workers_per_device
@@ -534,6 +550,7 @@ class RobustEngine:
 
                 batch = jax.vmap(aug_one)(batch, jnp.arange(k))
             losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn)
+            mark("losses+gradients done: local loss sum {l}", l=jnp.sum(losses))
             new_momentum, new_momentum_steps = None, None
             if self.worker_momentum is not None:
                 # Honest workers send momenta (computed BEFORE the attack:
@@ -594,9 +611,12 @@ class RobustEngine:
                 ).astype(jnp.float32) * jnp.isfinite(rep_dist).astype(jnp.float32)
                 beta = self.reputation_decay
                 new_reputation = beta * state.reputation + (1.0 - beta) * signal
+            mark("aggregate done: |agg| {g}", g=jnp.linalg.norm(agg))
             agg_tree = flatmap.inflate(agg)
             updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            mark("apply done: |p0| {p}",
+                 p=jnp.linalg.norm(jax.tree_util.tree_leaves(params)[0]))
             total_loss = jax.lax.psum(jnp.sum(losses), worker_axis) if W > 1 else jnp.sum(losses)
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state,

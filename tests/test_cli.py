@@ -357,6 +357,44 @@ def test_deploy_session_secret_mismatch_rejected():
     assert any("authentication FAILED" in out for out in outs), outs
 
 
+def test_deploy_multidevice_restore_mid_run(tmp_path):
+    """VERDICT r4 task 7: the deploy path's claims under PROCESS separation,
+    not only threads — a 2-process x 4-device jax.distributed cluster (the
+    reference's multi-node multi-GPU shape, deploy.py:244-309) runs the FULL
+    runner with checkpointing to step 6, then a second 2-process launch
+    RESTORES mid-campaign (process 0's latest-step choice broadcast, the
+    post-restore encrypted digest handshake agreeing across processes) and
+    continues to step 12.  Only process 0 writes artifacts."""
+    port = _free_port()
+    ckpt_dir = str(tmp_path / "ckpt")
+    eval_file = tmp_path / "eval.tsv"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    common = [
+        sys.executable, "-m", "aggregathor_tpu.cli.deploy",
+        "--local-simulate", "2", "--devices-per-process", "4",
+        "--port", str(port), "--",
+        "--experiment", "mnist", "--experiment-args", "batch-size:8",
+        "--aggregator", "krum", "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--learning-rate-args", "initial-rate:0.05",
+        "--session-secret", "launch-secret",
+        "--checkpoint-dir", ckpt_dir, "--checkpoint-delta", "3",
+        "--evaluation-file", str(eval_file), "--evaluation-delta", "6",
+    ]
+    for max_step in ("6", "12"):
+        proc = subprocess.run(
+            common + ["--max-step", max_step],
+            capture_output=True, text=True, timeout=420, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:] or proc.stdout[-2000:]
+    steps = sorted(int(n.split("-")[1].split(".")[0]) for n in os.listdir(ckpt_dir))
+    assert 6 in steps and 12 in steps, steps  # second launch RESUMED from 6
+    lines = eval_file.read_text().strip().splitlines()
+    eval_steps = [int(line.split("\t")[1]) for line in lines]
+    assert eval_steps == sorted(set(eval_steps)), (
+        "duplicate eval rows: several processes wrote the file")
+    assert eval_steps[-1] == 12
+
+
 def test_deploy_cluster_spec_two_process():
     """--cluster resolves the bring-up triple from a spec (the reference's
     tools/cluster.py input forms): a 2-process localhost cluster trains to
@@ -532,3 +570,23 @@ def test_runner_digits_real_data_device_sampled(tmp_path):
     lines = [l.split("\t") for l in open(eval_file).read().strip().splitlines()]
     metrics = dict(kv.split(":", 1) for kv in lines[-1][2:])
     assert float(metrics["accuracy"]) > 0.6, metrics
+
+
+def test_runner_trace_ops_narrative(tmp_path):
+    """--trace-ops reproduces the reference's per-op terminal narrative
+    (tools/tf.py:41-58): each step prints value-anchored markers for the
+    gradient, aggregate, and apply phases."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "aggregathor_tpu.cli.runner",
+         "--platform", "cpu",
+         "--experiment", "mnist", "--experiment-args", "batch-size:8",
+         "--aggregator", "krum", "--nb-workers", "4", "--nb-decl-byz-workers", "1",
+         "--max-step", "2", "--trace-ops",
+         "--evaluation-delta", "-1", "--evaluation-period", "-1"],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout + proc.stderr
+    for phase in ("losses+gradients done", "aggregate done", "apply done"):
+        assert out.count(phase) >= 2, (phase, out[-1500:])
