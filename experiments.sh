@@ -86,6 +86,10 @@ run mnist average 2 0 50 10000
 #   REAL data with zero egress — sklearn digits to ~96% under Multi-Krum
 #   (docs/robustness.md "Measured on REAL data"):
 # run digits krum 8 2 32 4000
+#   the cnnet conv topology on the same REAL corpus at 32x32 (~0.975 under
+#   Multi-Krum; the conv-scale anchor — docs/robustness.md "Why not real
+#   CIFAR-10"):
+# run digits-conv krum 8 2 16 400
 #   per-layer Krum on the dp x pp x tp transformer (BASELINE config 5):
 # run_sharded transformer krum 4 2 1 1 16 1000
 #   accuracy-under-attack sweep (docs/robustness.md):
