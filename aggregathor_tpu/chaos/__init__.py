@@ -1,0 +1,31 @@
+"""Chaos engineering for Byzantine-resilient training.
+
+The reference (and the base engines) model adversity as *static whole-run
+knobs*: one ``--attack`` for every step, one ``--UDP`` loss rate forever
+(reference: runner.py:145-155, deploy.py:119-122).  Real Byzantine/tail
+behavior is bursty and time-varying — transient packet-loss storms and
+stragglers dominate cloud training tails (OptiReduce, arXiv:2310.06993;
+"Efficient AllReduce with Stragglers", arXiv:2505.23523).  This package
+makes adversity *schedulable* and turns the attack/lossy/GAR machinery into
+a systematic resilience-evaluation product:
+
+- ``schedule``:   a deterministic piecewise fault-regime DSL
+  (``0:calm 500:drop=0.3 1000:attack=empire``) compiled to step-indexed
+  arrays, so regime switches happen INSIDE the jitted step (array indexing
+  + ``lax.switch``) with zero recompilation;
+- ``stragglers``: the per-worker straggler/stale-gradient failure mode the
+  base engines lack — a "late" worker's row is either NaN-dropped (absorbed
+  by the NaN-aware GARs, like ``parallel/lossy.py``) or replaced by its
+  previous-step gradient (reusing the worker-sharded ``TrainState.carry``
+  CLEVER machinery, ``parallel/engine.py``);
+- ``campaign``:   a resilience-campaign harness sweeping attack x GAR x
+  schedule grids through the real engine, emitting a machine-readable
+  resilience matrix (JSON) plus a markdown report, including an empirical
+  check of the f-breakdown-point boundary.
+
+Both engines accept a ``ChaosSchedule`` (``RobustEngine(..., chaos=...)``);
+the CLI spells it ``--chaos "<schedule>" --chaos-args key:value...``.
+"""
+
+from .schedule import ChaosSchedule  # noqa: F401
+from .stragglers import StragglerModel  # noqa: F401

@@ -1,0 +1,340 @@
+"""Resilience-campaign harness: sweep attack x GAR x schedule grids.
+
+Turns the engine's robustness machinery into a measurement product: every
+cell of the (GAR x chaos scenario) grid trains the SAME experiment through
+the real :class:`RobustEngine` under a :class:`ChaosSchedule`, and the
+campaign emits
+
+- a machine-readable **resilience matrix** (JSON, schema
+  ``aggregathor.chaos.resilience-matrix.v1``) with per-cell loss
+  trajectories and converged/diverged verdicts — the contract
+  ``scripts/run_campaign_smoke.sh`` and tests/test_chaos.py assert;
+- a **markdown report** with the verdict grid and, under ``--breakdown``,
+  an empirical check of each rule's f-breakdown boundary: the same attack
+  scenario re-run with ``r = f`` real attackers (the declared budget —
+  expect convergence) and with ``r`` beyond the rule's breakdown point
+  (a strict majority, n//2 + 1 — expect failure).
+
+Scenario sources: ``--attacks NAME[,k=v...]`` is shorthand for the
+single-regime schedule ``0:attack=NAME[,k=v...]``; ``--schedules
+NAME=SPEC`` passes any schedule DSL string (see chaos/schedule.py for the
+grammar).  A ``calm`` scenario (no adversity) is always prepended as the
+baseline row.
+
+Example (the smoke campaign, CPU, <60 s)::
+
+  python -m aggregathor_tpu.chaos.campaign \
+      --experiment mnist --experiment-args batch-size:16 \
+      --nb-workers 8 --nb-decl-byz-workers 2 --nb-real-byz-workers 2 \
+      --gars average median krum --attacks empire,epsilon=4.0 \
+      --schedules storm="0:calm 10:drop=0.3" \
+      --nb-steps 25 --output matrix.json --report report.md
+"""
+
+import argparse
+import json
+import sys
+
+SCHEMA = "aggregathor.chaos.resilience-matrix.v1"
+
+#: matrix keys every cell must carry (the smoke script asserts these)
+CELL_KEYS = (
+    "gar", "scenario", "schedule", "nb_real_byz", "declared_byz",
+    "first_loss", "final_loss", "min_loss", "converged", "diverged", "losses",
+)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="aggregathor-tpu campaign",
+        description="Resilience campaign: attack x GAR x schedule grid through the robust engine",
+    )
+    parser.add_argument("--experiment", default="mnist", help="experiment name (models registry)")
+    parser.add_argument("--experiment-args", nargs="*", default=[], help="key:value experiment arguments")
+    parser.add_argument("--nb-workers", type=int, default=8, help="number n of logical workers")
+    parser.add_argument("--nb-decl-byz-workers", type=int, default=2, help="declared Byzantine count f")
+    parser.add_argument("--nb-real-byz-workers", type=int, default=2,
+                        help="actual attacker count r for attack scenarios")
+    parser.add_argument("--gars", nargs="+", default=["average", "median", "krum"],
+                        help="GAR names to sweep (gars registry)")
+    parser.add_argument("--gar-args", nargs="*", default=[], help="key:value arguments for every GAR")
+    parser.add_argument("--attacks", nargs="*", default=[],
+                        help="attack scenarios NAME[,k=v...] (single-regime schedules)")
+    parser.add_argument("--schedules", nargs="*", default=[],
+                        help="named schedule scenarios NAME=SPEC (full chaos DSL)")
+    parser.add_argument("--chaos-args", nargs="*", default=[],
+                        help="key:value schedule-wide options (packet-coords, straggle-workers, ...)")
+    parser.add_argument("--nb-steps", type=int, default=25, help="train steps per cell")
+    parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--nb-devices", type=int, default=1,
+                        help="devices on the worker mesh axis (1 = fastest on CPU)")
+    parser.add_argument("--breakdown", action="store_true",
+                        help="empirically probe each robust rule's f-breakdown boundary "
+                             "(re-runs the first attack scenario at r=f and r=n//2+1)")
+    parser.add_argument("--output", default=None, metavar="JSON", help="resilience matrix output path")
+    parser.add_argument("--report", default=None, metavar="MD", help="markdown report output path")
+    parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
+    return parser
+
+
+def _scenarios(args):
+    """[(name, schedule spec or None)] — calm baseline first.  Names must be
+    unique: they key the matrix cells and the report grid (two variants of
+    one attack need distinct --schedules names)."""
+    from ..utils import UserException
+
+    out = [("calm", None)]
+    for item in args.attacks:
+        name = item.split(",", 1)[0]
+        out.append((name, "0:attack=%s" % item))
+    for item in args.schedules:
+        if "=" not in item:
+            raise UserException("--schedules wants NAME=SPEC (got %r)" % (item,))
+        name, spec = item.split("=", 1)
+        out.append((name, spec))
+    names = [name for name, _ in out]
+    duplicates = sorted({name for name in names if names.count(name) > 1})
+    if duplicates:
+        raise UserException(
+            "Duplicate scenario name(s) %s would collide in the matrix/report; "
+            "give variants distinct names via --schedules NAME=SPEC"
+            % ", ".join(duplicates)
+        )
+    return out
+
+
+def _declares_attack(spec, nb_workers):
+    """Does this schedule spec activate any attack regime?  (Probed with a
+    1-member coalition; the main grid has already surfaced parse errors.)"""
+    from ..utils import UserException
+    from .schedule import ChaosSchedule
+
+    try:
+        return ChaosSchedule(spec, nb_workers, nb_real_byz=1).has_attacks
+    except UserException:
+        return False
+
+
+def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
+             chaos_args, nb_steps, lr, seed, nb_devices=1):
+    """Train one grid cell; returns the cell record (see CELL_KEYS)."""
+    import jax
+    import numpy as np
+
+    from .. import gars, models
+    from ..core import build_optimizer, build_schedule
+    from ..parallel import RobustEngine, make_mesh
+    from .schedule import ChaosSchedule
+
+    experiment = models.instantiate(exp_name, exp_args)
+    gar = gars.instantiate(gar_name, n, f, gar_args)
+    chaos = (
+        ChaosSchedule(schedule_spec, n, nb_real_byz=r, args=chaos_args)
+        if schedule_spec else None
+    )
+    nb_real = r if (chaos is not None and chaos.has_attacks) else 0
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:%s" % lr]))
+    engine = RobustEngine(
+        make_mesh(nb_workers=nb_devices), gar, n, nb_real_byz=nb_real, chaos=chaos,
+    )
+    step = engine.build_step(experiment.loss, tx)
+    state = engine.init_state(experiment.init(jax.random.PRNGKey(seed)), tx, seed=seed + 1)
+    it = experiment.make_train_iterator(n, seed=seed + 2)
+    losses = []
+    diverged = False
+    for _ in range(nb_steps):
+        state, metrics = step(state, engine.shard_batch(next(it)))
+        loss = float(jax.device_get(metrics["total_loss"]))
+        losses.append(loss)
+        if not np.isfinite(loss):
+            # params are poisoned; every later loss is NaN too — stop paying
+            # for steps that can no longer change the verdict
+            diverged = True
+            break
+    finite = [x for x in losses if np.isfinite(x)]
+    first = losses[0] if losses else float("nan")
+    final = losses[-1] if losses else float("nan")
+    return {
+        "gar": gar_name,
+        "nb_real_byz": nb_real,
+        "declared_byz": f,
+        "first_loss": first,
+        "final_loss": final,
+        "min_loss": min(finite) if finite else float("nan"),
+        "converged": bool(
+            not diverged and np.isfinite(first) and np.isfinite(final) and final < first
+        ),
+        "diverged": diverged,
+        "losses": losses,
+    }
+
+
+def run_campaign(args):
+    """Run the full grid; returns the resilience-matrix dict."""
+    from ..utils import UserException, info, warning
+
+    n, f, r = args.nb_workers, args.nb_decl_byz_workers, args.nb_real_byz_workers
+    if r > n:
+        raise UserException("More real Byzantine workers (%d) than workers (%d)" % (r, n))
+    scenarios = _scenarios(args)
+    cells = []
+    for gar_name in args.gars:
+        for scenario, spec in scenarios:
+            info("campaign cell: gar=%s scenario=%s" % (gar_name, scenario))
+            cell = run_cell(
+                args.experiment, args.experiment_args, gar_name, args.gar_args,
+                n, f, r, spec, args.chaos_args, args.nb_steps,
+                args.learning_rate, args.seed, nb_devices=args.nb_devices,
+            )
+            cell["scenario"] = scenario
+            cell["schedule"] = spec
+            cells.append(cell)
+            info(
+                "  -> %s (first %.4f final %.4f)"
+                % ("DIVERGED" if cell["diverged"]
+                   else ("converged" if cell["converged"] else "degraded"),
+                   cell["first_loss"], cell["final_loss"])
+            )
+    breakdown = []
+    if args.breakdown:
+        # only ATTACK scenarios can probe the Byzantine boundary — a
+        # drop/straggler-only schedule has no coalition to size, and probing
+        # it would compare two identical attacker-free runs
+        attack_specs = [
+            (name, spec) for name, spec in scenarios
+            if spec is not None and _declares_attack(spec, n)
+        ]
+        if not attack_specs:
+            raise UserException(
+                "--breakdown needs at least one attack scenario (--attacks "
+                "NAME or a --schedules spec with an attack= regime)"
+            )
+        probe_name, probe_spec = attack_specs[0]
+        r_beyond = n // 2 + 1  # strict Byzantine majority: beyond EVERY rule's bound
+        for gar_name in args.gars:
+            if gar_name.startswith("average"):
+                continue  # no declared bound to probe
+            entry = {"gar": gar_name, "scenario": probe_name, "declared_byz": f,
+                     "r_within": f, "r_beyond": r_beyond}
+            for tag, rr in (("within", f), ("beyond", r_beyond)):
+                try:
+                    cell = run_cell(
+                        args.experiment, args.experiment_args, gar_name, args.gar_args,
+                        n, f, rr, probe_spec, args.chaos_args, args.nb_steps,
+                        args.learning_rate, args.seed, nb_devices=args.nb_devices,
+                    )
+                except UserException as exc:
+                    warning("breakdown %s/%s skipped: %s" % (gar_name, tag, exc))
+                    entry["%s_error" % tag] = str(exc)
+                    continue
+                entry["%s_converged" % tag] = cell["converged"]
+                entry["%s_final_loss" % tag] = cell["final_loss"]
+            if "within_converged" in entry and "beyond_converged" in entry:
+                # the empirical boundary: the declared budget holds, a
+                # Byzantine majority does not
+                entry["bound_holds"] = bool(
+                    entry["within_converged"] and not entry["beyond_converged"]
+                )
+            breakdown.append(entry)
+    return {
+        "schema": SCHEMA,
+        "experiment": args.experiment,
+        "experiment_args": list(args.experiment_args),
+        "nb_workers": n,
+        "declared_byz": f,
+        "nb_real_byz": r,
+        "nb_steps": args.nb_steps,
+        "learning_rate": args.learning_rate,
+        "seed": args.seed,
+        "cells": cells,
+        "breakdown": breakdown,
+    }
+
+
+def render_report(matrix):
+    """Markdown verdict grid + breakdown table for a resilience matrix."""
+    scenarios = []
+    for cell in matrix["cells"]:
+        if cell["scenario"] not in scenarios:
+            scenarios.append(cell["scenario"])
+    by_key = {(c["gar"], c["scenario"]): c for c in matrix["cells"]}
+    lines = [
+        "# Resilience matrix — %s, n=%d, f=%d declared, %d steps"
+        % (matrix["experiment"], matrix["nb_workers"], matrix["declared_byz"],
+           matrix["nb_steps"]),
+        "",
+        "Verdicts: `ok` loss decreased (first -> final), `degraded` finite but",
+        "not decreasing, `DIVERGED` non-finite loss (params poisoned).",
+        "",
+        "| GAR | " + " | ".join(scenarios) + " |",
+        "|---|" + "---|" * len(scenarios),
+    ]
+    for gar_name in dict.fromkeys(c["gar"] for c in matrix["cells"]):
+        row = ["| %s" % gar_name]
+        for scenario in scenarios:
+            cell = by_key.get((gar_name, scenario))
+            if cell is None:
+                row.append("—")
+            elif cell["diverged"]:
+                row.append("DIVERGED")
+            elif cell["converged"]:
+                row.append("ok (%.3f→%.3f)" % (cell["first_loss"], cell["final_loss"]))
+            else:
+                row.append("degraded (%.3f→%.3f)" % (cell["first_loss"], cell["final_loss"]))
+        lines.append(" | ".join(row) + " |")
+    if matrix["breakdown"]:
+        lines += [
+            "",
+            "## Empirical f-breakdown boundary",
+            "",
+            "Same attack scenario at `r = f` (inside the declared budget) and",
+            "`r = n//2 + 1` (Byzantine majority — beyond every rule's bound).",
+            "",
+            "| GAR | scenario | r=f converged | r=majority converged | bound holds |",
+            "|---|---|---|---|---|",
+        ]
+        for entry in matrix["breakdown"]:
+            lines.append("| %s | %s | %s | %s | %s |" % (
+                entry["gar"], entry["scenario"],
+                entry.get("within_converged", entry.get("within_error", "?")),
+                entry.get("beyond_converged", entry.get("beyond_error", "?")),
+                entry.get("bound_holds", "?"),
+            ))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from ..utils import info
+
+    matrix = run_campaign(args)
+    text = json.dumps(matrix, indent=1)
+    if args.output:
+        with open(args.output, "w") as fd:
+            fd.write(text + "\n")
+        info("resilience matrix -> %s" % args.output)
+    else:
+        print(text)
+    if args.report:
+        with open(args.report, "w") as fd:
+            fd.write(render_report(matrix))
+        info("markdown report -> %s" % args.report)
+    return 0
+
+
+def cli():
+    from ..cli import console_entry
+
+    return console_entry(main)
+
+
+if __name__ == "__main__":
+    sys.exit(cli())

@@ -1,0 +1,297 @@
+"""Piecewise fault-regime schedule DSL, compiled for in-step dispatch.
+
+Grammar (whitespace-separated segments, ``parse_keyval``-style values)::
+
+  SCHEDULE := SEGMENT (" " SEGMENT)*
+  SEGMENT  := STEP ":" REGIME            # STEP is a non-negative integer
+  REGIME   := "calm" | SETTING ("," SETTING)*
+  SETTING  := KEY "=" VALUE
+
+Known keys:
+
+- ``attack=NAME``          activate a registered gradient attack
+  (``parallel/attacks.py``) for this regime; any UNKNOWN key in the same
+  regime is forwarded to the attack as a ``key:value`` sub-argument
+  (``attack=empire,epsilon=4.0``);
+- ``drop=RATE``            i.i.d. per-packet datagram loss in [0, 1] on
+  EVERY worker's gradient (a network loss storm — unlike the static
+  ``--UDP k`` first-k-workers knob), NaN infill like the reference's UDP
+  transport (mpi_rendezvous_mgr.patch:833-841);
+- ``straggle=RATE``        per-step probability in [0, 1] that a worker is
+  "late" this step (i.i.d. per worker, see ``stragglers.py``);
+- ``straggle-mode=MODE``   what a late worker's row becomes: ``drop``
+  (whole row NaN — the NaN-aware GARs exclude it) or ``stale`` (the
+  previous-step submission, via the CLEVER ``TrainState.carry``).
+
+A regime named ``calm`` (or any segment's unset keys) means: no attack,
+no loss, no stragglers.  Segments sort by step; the regime starting at
+step ``s`` governs every step ``t`` with ``s <= t < next_start`` — the
+switch lands at EXACTLY step ``s``.  If no segment starts at 0, an
+implicit ``0:calm`` is prepended.
+
+Compiled form: the per-regime scalar knobs live in step-indexed arrays and
+the active regime is ``searchsorted(starts, step) - 1`` on the TRACED step
+counter, so one compiled program covers the whole schedule — regime
+switches cost an array index and a ``lax.switch``, never a retrace
+(asserted by tests/test_chaos.py).
+
+Schedule-wide options (the CLI's ``--chaos-args``):
+
+- ``packet-coords:N``     datagram size of the ``drop`` link (default: the
+  UDP 65000-byte datagram, ``parallel/lossy.py``);
+- ``min-coords:N``        minimum gradient size for ``drop`` to engage
+  (default 0: chaos storms hit every tensor, unlike the reference's ~1 MB
+  UDP threshold);
+- ``straggle-workers:K``  only the first K global workers ever straggle
+  (default 0 = all workers are eligible).
+"""
+
+import numpy as np
+
+from ..utils import UserException, parse_keyval
+
+#: regime keys the DSL itself consumes; anything else must ride an ``attack=``
+_REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode")
+
+_CALM = "calm"
+
+
+class Regime:
+    """One parsed schedule segment (static Python config, no arrays)."""
+
+    __slots__ = ("start", "spec", "attack", "drop_rate", "straggler_rate", "straggler_stale")
+
+    def __init__(self, start, spec, attack=None, drop_rate=0.0,
+                 straggler_rate=0.0, straggler_stale=False):
+        self.start = int(start)
+        self.spec = spec
+        self.attack = attack
+        self.drop_rate = float(drop_rate)
+        self.straggler_rate = float(straggler_rate)
+        self.straggler_stale = bool(straggler_stale)
+
+
+def _parse_rate(key, value):
+    try:
+        rate = float(value)
+    except ValueError:
+        raise UserException("Chaos %s=%r is not a number" % (key, value))
+    if not 0.0 <= rate <= 1.0:
+        raise UserException("Chaos %s=%r must lie in [0, 1]" % (key, value))
+    return rate
+
+
+def _parse_regime(start, text, nb_workers, nb_real_byz):
+    """Parse one REGIME body into a :class:`Regime`."""
+    from ..parallel import attacks as attack_registry
+
+    if text == _CALM:
+        return Regime(start, _CALM)
+    attack_name = None
+    attack_args = []
+    drop_rate = 0.0
+    straggler_rate = None
+    straggler_stale = None
+    seen = set()
+    for setting in text.split(","):
+        if "=" not in setting:
+            raise UserException(
+                "Chaos regime setting %r at step %d: expected KEY=VALUE (or the "
+                "bare regime name 'calm')" % (setting, start)
+            )
+        key, value = setting.split("=", 1)
+        if key in seen:
+            raise UserException("Chaos regime at step %d sets %r twice" % (start, key))
+        seen.add(key)
+        if key == "attack":
+            if value not in attack_registry.itemize():
+                raise UserException(
+                    "Unknown chaos attack %r (registered: %s)"
+                    % (value, ", ".join(sorted(attack_registry.itemize())))
+                )
+            attack_name = value
+        elif key == "drop":
+            drop_rate = _parse_rate(key, value)
+        elif key == "straggle":
+            straggler_rate = _parse_rate(key, value)
+        elif key == "straggle-mode":
+            if value not in ("drop", "stale"):
+                raise UserException(
+                    "Chaos straggle-mode=%r must be 'drop' or 'stale'" % (value,)
+                )
+            straggler_stale = value == "stale"
+        else:
+            attack_args.append("%s:%s" % (key, value))
+    if attack_args and attack_name is None:
+        raise UserException(
+            "Chaos regime at step %d passes attack arguments (%s) without "
+            "attack=NAME" % (start, ", ".join(attack_args))
+        )
+    if straggler_stale is not None and straggler_rate is None:
+        raise UserException(
+            "Chaos regime at step %d sets straggle-mode without straggle=RATE" % start
+        )
+    attack = None
+    if attack_name is not None:
+        if nb_real_byz < 1:
+            raise UserException(
+                "Chaos schedule declares attack regimes (step %d: attack=%s) but "
+                "nb_real_byz is 0; pass --nb-real-byz-workers > 0 so the "
+                "coalition has members" % (start, attack_name)
+            )
+        attack = attack_registry.instantiate(attack_name, nb_workers, nb_real_byz, attack_args)
+    return Regime(
+        start, text, attack=attack, drop_rate=drop_rate,
+        straggler_rate=straggler_rate or 0.0,
+        straggler_stale=bool(straggler_stale),
+    )
+
+
+class ChaosSchedule:
+    """A parsed + compiled fault-regime schedule both engines consume.
+
+    The compiled arrays (``_starts`` and the per-regime knob vectors) are
+    tiny host constants; ``regime_index``/``drop_rate``/... index them with
+    the traced step so the whole schedule lives inside ONE compiled step
+    program.  Attack dispatch is a ``lax.switch`` over per-regime branches
+    (identity for attack-free regimes) — every branch is traced once at
+    compile time, and regime transitions never retrace.
+    """
+
+    def __init__(self, spec, nb_workers, nb_real_byz=0, args=None):
+        from ..parallel.lossy import PACKET_COORDS, LossyLink
+
+        kv = parse_keyval(args or [], {
+            "packet-coords": PACKET_COORDS,
+            "min-coords": 0,
+            "straggle-workers": 0,
+        }, strict=True)
+        self.spec = str(spec)
+        self.nb_workers = int(nb_workers)
+        self.nb_real_byz = int(nb_real_byz)
+        segments = self.spec.split()
+        if not segments:
+            raise UserException("Empty chaos schedule (expected e.g. '0:calm 500:drop=0.3')")
+        regimes = []
+        for segment in segments:
+            if ":" not in segment:
+                raise UserException(
+                    "Chaos segment %r: expected STEP:REGIME (e.g. '500:drop=0.3')" % (segment,)
+                )
+            step_text, regime_text = segment.split(":", 1)
+            try:
+                start = int(step_text)
+            except ValueError:
+                raise UserException("Chaos segment %r: step %r is not an integer" % (segment, step_text))
+            if start < 0:
+                raise UserException("Chaos segment %r: negative start step" % (segment,))
+            regimes.append(_parse_regime(start, regime_text, self.nb_workers, self.nb_real_byz))
+        starts = [r.start for r in regimes]
+        if len(set(starts)) != len(starts):
+            dup = sorted(s for s in set(starts) if starts.count(s) > 1)
+            raise UserException("Chaos schedule has duplicate start steps: %s" % dup)
+        regimes.sort(key=lambda r: r.start)
+        if regimes[0].start != 0:
+            regimes.insert(0, Regime(0, _CALM))
+        self.regimes = regimes
+        self._starts = np.asarray([r.start for r in regimes], np.int32)
+        self._drop_rates = np.asarray([r.drop_rate for r in regimes], np.float32)
+        self._straggler_rates = np.asarray([r.straggler_rate for r in regimes], np.float32)
+        self._straggler_stale = np.asarray([r.straggler_stale for r in regimes], np.bool_)
+        self.has_drop = bool((self._drop_rates > 0).any())
+        self.has_stragglers = bool((self._straggler_rates > 0).any())
+        #: stale stragglers re-send the previous submission, so the engine
+        #: must thread the CLEVER carry through the step
+        self.needs_carry = bool(
+            ((self._straggler_rates > 0) & self._straggler_stale).any()
+        )
+        self.has_local_attacks = any(
+            r.attack is not None and not r.attack.omniscient for r in regimes
+        )
+        self.has_omniscient_attacks = any(
+            r.attack is not None and r.attack.omniscient for r in regimes
+        )
+        self.has_attacks = self.has_local_attacks or self.has_omniscient_attacks
+        self.link = None
+        if self.has_drop:
+            self.link = LossyLink(self.nb_workers, [
+                "drop-rate:0.0",  # always overridden per step by drop_rate()
+                "packet-coords:%d" % int(kv["packet-coords"]),
+                "min-coords:%d" % int(kv["min-coords"]),
+            ])
+        from .stragglers import StragglerModel
+
+        self.stragglers = StragglerModel(self.nb_workers, nb_eligible=int(kv["straggle-workers"]))
+
+    # ------------------------------------------------------------------ #
+    # traced accessors (used inside the jitted step)
+
+    def regime_index(self, step):
+        """(traced) int32 index of the regime governing ``step``."""
+        import jax.numpy as jnp
+
+        idx = jnp.searchsorted(jnp.asarray(self._starts), step, side="right") - 1
+        return jnp.maximum(idx, 0).astype(jnp.int32)
+
+    def drop_rate(self, ridx):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._drop_rates)[ridx]
+
+    def straggler_rate(self, ridx):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._straggler_rates)[ridx]
+
+    def straggler_stale(self, ridx):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._straggler_stale)[ridx]
+
+    def apply_local_attacks(self, ridx, grad, key):
+        """lax.switch dispatch of the active regime's LOCAL attack (identity
+        for regimes without one).  The caller gates by Byzantine worker
+        index, exactly like the static-attack path (engine._perturb_local)."""
+        import jax
+
+        branches = []
+        for regime in self.regimes:
+            attack = regime.attack
+            if attack is not None and not attack.omniscient:
+                branches.append(lambda g, k, a=attack: a.apply_local(g, k))
+            else:
+                branches.append(lambda g, k: g)
+        return jax.lax.switch(ridx, branches, grad, key)
+
+    def apply_omniscient_attacks(self, ridx, matrix, byz_mask, key):
+        """lax.switch dispatch of the active regime's OMNISCIENT attack on
+        the gathered (n, d_block) rows (identity for regimes without one)."""
+        import jax
+
+        branches = []
+        for regime in self.regimes:
+            attack = regime.attack
+            if attack is not None and attack.omniscient:
+                branches.append(lambda m, b, k, a=attack: a.apply_matrix(m, b, k))
+            else:
+                branches.append(lambda m, b, k: m)
+        return jax.lax.switch(ridx, branches, matrix, byz_mask, key)
+
+    # ------------------------------------------------------------------ #
+    # host-side helpers (logging, campaign reports)
+
+    def regime_at(self, step):
+        """Python int index of the regime governing host-side ``step``."""
+        return max(int(np.searchsorted(self._starts, int(step), side="right")) - 1, 0)
+
+    def describe(self, index):
+        """Human-readable ``start:spec`` for regime ``index``."""
+        regime = self.regimes[index]
+        return "%d:%s" % (regime.start, regime.spec)
+
+    def transitions(self):
+        """[(start_step, spec), ...] for every regime, in order."""
+        return [(r.start, r.spec) for r in self.regimes]
+
+    def __len__(self):
+        return len(self.regimes)

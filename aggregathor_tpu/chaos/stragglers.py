@@ -1,0 +1,79 @@
+"""Per-worker straggler simulation: late workers drop out or go stale.
+
+The failure mode the base engines lack: in real clusters the tail is not
+Byzantine, it is LATE — a worker whose gradient misses the aggregation
+deadline (straggler/tail literature: "Efficient AllReduce with Stragglers",
+arXiv:2505.23523; OptiReduce's tail-latency motivation, arXiv:2310.06993).
+Under a synchronous parameter server there are exactly two things the
+aggregator can do with a late worker's slot, and both already have
+machinery here:
+
+- **drop** — the row simply is not there this round.  Modeled as a whole
+  row of NaN, the same convention as a fully-lossy link
+  (``parallel/lossy.py``): NaN-aware rules (average-nan, median,
+  Krum/Bulyan's +inf-distance convention) exclude it, plain ``average`` is
+  poisoned — faithfully reproducing why you must size ``f`` to cover
+  stragglers (docs/robustness.md "Choosing f");
+- **stale** — the aggregator reuses the worker's PREVIOUS submission (the
+  asynchronous/stale-gradient model).  Implemented on the worker-sharded
+  ``TrainState.carry`` the CLEVER infill already threads through both
+  engines (``parallel/engine.py``): a worker late for k consecutive steps
+  keeps re-submitting the same gradient, exactly like a CLEVER reassembly
+  buffer that received nothing — at drop-rate 1.0 the two paths are
+  bit-identical (asserted by tests/test_chaos.py).
+
+Lateness is i.i.d. per (worker, step) with the schedule's regime-indexed
+rate, drawn from a per-(step, worker) key the engines keep disjoint from
+every other stream: the flat engine folds tag 5 onto the per-worker key
+(disjoint from attack (1) / lossy (2) / augment (3) / sampling (4)); the
+sharded engine derives the per-worker key in its 30_000+ offset namespace
+first, because there the plain per-worker key is the PARENT of the
+per-leaf streams.  Either way a chaotic run is deterministic in
+(seed, step, global worker index) and device-layout invariant, like every
+other perturbation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+#: fold_in tag of the straggler lateness stream (see module docstring)
+STRAGGLER_KEY_TAG = 5
+
+
+class StragglerModel:
+    """Static straggler config; per-step rate/mode come from the schedule."""
+
+    def __init__(self, nb_workers, nb_eligible=0):
+        self.nb_workers = int(nb_workers)
+        # 0 means every worker is eligible; K > 0 restricts lateness to the
+        # first K global workers (the --UDP first-k convention)
+        self.nb_eligible = int(nb_eligible)
+        if self.nb_eligible < 0 or self.nb_eligible > self.nb_workers:
+            from ..utils import UserException
+
+            raise UserException(
+                "straggle-workers must lie in [0, nb_workers]=%d (got %d)"
+                % (self.nb_workers, self.nb_eligible)
+            )
+
+    def is_late(self, worker_key, worker_index, rate):
+        """(traced) bool: is this worker late this step?  ``worker_key`` is
+        the per-(step, worker) key; ``rate`` the regime's traced rate."""
+        late = jax.random.bernoulli(jax.random.fold_in(worker_key, STRAGGLER_KEY_TAG), rate)
+        if self.nb_eligible:
+            late = late & (worker_index < self.nb_eligible)
+        return late
+
+    def apply(self, grad, late, stale, previous=None):
+        """Replace a late worker's (d,) gradient with its regime's infill.
+
+        ``stale`` is the regime's traced mode flag; ``previous`` the
+        worker's carried previous submission (None when no regime in the
+        schedule needs the carry — then every late row NaN-drops).
+        """
+        nan_row = jnp.full_like(grad, jnp.nan)
+        if previous is None:
+            infill = nan_row
+        else:
+            infill = jnp.where(stale, previous, nan_row)
+        return jnp.where(late, infill, grad)

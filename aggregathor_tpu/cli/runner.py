@@ -174,6 +174,18 @@ def build_parser():
     # Transport simulation + tracing (reference: deploy.py:119-122, runner.py:216-219)
     parser.add_argument("--UDP", type=int, default=0, dest="udp", help="first k workers use the lossy link")
     parser.add_argument("--UDP-args", nargs="*", default=[], dest="udp_args", help="key:value lossy-link arguments")
+    parser.add_argument(
+        "--chaos", default=None, metavar="SCHEDULE",
+        help="time-varying fault-regime schedule (chaos/ DSL, e.g. "
+             "'0:calm 500:drop=0.3 1000:attack=empire'): regime switches "
+             "happen inside the jitted step with zero recompilation; "
+             "subsumes the static --attack/--UDP knobs",
+    )
+    parser.add_argument(
+        "--chaos-args", nargs="*", default=[],
+        help="key:value schedule-wide chaos options (packet-coords:N, "
+             "min-coords:N, straggle-workers:K)",
+    )
     parser.add_argument("--trace", action="store_true", help="capture a jax.profiler trace of a few steps")
     parser.add_argument("--trace-dir", default="trace", help="profiler trace output directory")
     parser.add_argument("--trace-ops", action="store_true",
@@ -384,6 +396,14 @@ def main(argv=None):
         gar = gars.instantiate(args.aggregator, n, f, args.aggregator_args)
         attack = attacks.instantiate(args.attack, n, r, args.attack_args) if args.attack else None
         lossy = LossyLink(args.udp, args.udp_args) if args.udp > 0 else None
+        chaos = None
+        if args.chaos:
+            from ..chaos import ChaosSchedule
+
+            chaos = ChaosSchedule(args.chaos, n, nb_real_byz=r, args=args.chaos_args)
+            info("Chaos schedule: %d regime(s): %s" % (
+                len(chaos), "  ".join("%d:%s" % t for t in chaos.transitions())
+            ))
 
         schedule = build_schedule(args.learning_rate, args.learning_rate_args)
         tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
@@ -433,6 +453,7 @@ def main(argv=None):
                 # gradients instead of wrapping the loss (see sharded_engine)
                 l1_regularize=args.l1_regularize,
                 l2_regularize=args.l2_regularize,
+                chaos=chaos,
             )
             loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
             state = engine.init_state(
@@ -467,6 +488,7 @@ def main(argv=None):
                 granularity=args.granularity,
                 leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
                 trace_ops=args.trace_ops,
+                chaos=chaos,
             )
 
             # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
@@ -740,6 +762,13 @@ def main(argv=None):
                     sums, jax.device_get(eval_fn(state, engine.shard_batch(batch)))
                 )
             metrics = normalize_metric_sums(sums)
+        if chaos is not None:
+            # the regime column: the regime that governed the LAST COMPLETED
+            # training step (``step`` counts completed steps, so the final
+            # step's in-graph index is step - 1 — an eval landing exactly on
+            # a switch step reports the regime its metrics were trained
+            # under, not the one about to start)
+            metrics["chaos_regime"] = chaos.regime_at(max(step - 1, 0))
         info("Evaluation at step %d: %s" % (step, "  ".join("%s=%.4f" % kv for kv in sorted(metrics.items()))))
         eval_file.append(step, metrics)
         return metrics
@@ -793,6 +822,8 @@ def main(argv=None):
                 )
             if "nb_quarantined" in metrics:
                 scalars["nb_quarantined"] = int(jax.device_get(metrics["nb_quarantined"]))
+            if "chaos_regime" in metrics:
+                scalars["chaos_regime"] = int(jax.device_get(metrics["chaos_regime"]))
             return scalars
 
         def check_divergence():
@@ -806,6 +837,13 @@ def main(argv=None):
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
 
         tail_warned = False
+        # Chaos regime transition logging: host-side tracking of the regime
+        # governing the NEXT step to dispatch (under --unroll, transitions
+        # inside a chunk surface at the chunk boundary).
+        chaos_regime_seen = None
+        if chaos is not None:
+            chaos_regime_seen = chaos.regime_at(step)
+            info("Chaos regime at step %d: %s" % (step, chaos.describe(chaos_regime_seen)))
         try:
             while step < max_step and not stop["requested"]:
                 if args.trace and step == offstep + 2:  # skip compile + warmup step
@@ -860,6 +898,16 @@ def main(argv=None):
                     perf.step_end()
                     pending_loss = metrics["total_loss"]
                 step += chunk
+                if chaos is not None:
+                    regime_now = chaos.regime_at(step)
+                    if regime_now != chaos_regime_seen:
+                        chaos_regime_seen = regime_now
+                        info("Chaos regime switch at step %d: now %s"
+                             % (step, chaos.describe(regime_now)))
+                        summaries.event(step, "chaos_regime_switch", {
+                            "regime": regime_now,
+                            "spec": chaos.describe(regime_now),
+                        })
                 if trace_ctx is not None and step >= offstep + 5:
                     trace_ctx.__exit__(None, None, None)
                     trace_ctx = None

@@ -17,7 +17,14 @@ class EvalFile:
         if self._fd is None:
             return
         fields = ["%.6f" % (time.time() - self._start), str(int(step))]
-        fields += ["%s:%s" % (name, float(value)) for name, value in sorted(metrics.items())]
+        # Integral metrics (e.g. the chaos_regime index column) keep their
+        # int spelling so downstream `cut`/`awk` filters can match exactly;
+        # everything else stays the reference's float repr.
+        fields += [
+            "%s:%s" % (name, int(value) if isinstance(value, int) and not isinstance(value, bool)
+                       else float(value))
+            for name, value in sorted(metrics.items())
+        ]
         self._fd.write("\t".join(fields) + "\n")
         self._fd.flush()
 

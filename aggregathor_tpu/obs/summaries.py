@@ -60,6 +60,19 @@ class SummaryWriter:
         self._fd.write(json.dumps(event) + "\n")
         self._fd.flush()
 
+    def event(self, step, tag, payload=None):
+        """Write one TAGGED event line (``{"event": tag, ...}``) — discrete
+        occurrences like chaos regime transitions, as opposed to the cadenced
+        scalar stream.  ``payload`` values must be JSON-serializable; the
+        reserved ``wall``/``step``/``event`` fields always win over payload
+        keys of the same name (stream consumers filter on them)."""
+        if self._fd is None:
+            return
+        record = dict(payload) if payload else {}
+        record.update({"wall": time.time(), "step": int(step), "event": str(tag)})
+        self._fd.write(json.dumps(record) + "\n")
+        self._fd.flush()
+
     def close(self):
         if self._fd is not None:
             self._fd.close()

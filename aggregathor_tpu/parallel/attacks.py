@@ -46,10 +46,16 @@ class Attack:
     """Base attack. ``omniscient`` selects which hook the engine calls."""
 
     omniscient = False
+    #: typed key:value argument defaults accepted by this attack — parsed
+    #: STRICTLY (an unknown key raises instead of silently vanishing; same
+    #: contract as the GARs), which is what lets the chaos DSL forward
+    #: regime settings to attacks without swallowing typos
+    ARG_DEFAULTS = {}
 
     def __init__(self, nb_workers, nb_byz_workers, args):
         self.nb_workers = int(nb_workers)
         self.nb_byz_workers = int(nb_byz_workers)
+        self.args = parse_keyval(args, self.ARG_DEFAULTS, strict=True)
 
     def apply_local(self, grad, key):
         """Transform one Byzantine worker's own (d,) gradient."""
@@ -64,9 +70,11 @@ class Attack:
 class SignFlipAttack(Attack):
     """Submit -scale times the true gradient (classic reversed-gradient attacker)."""
 
+    ARG_DEFAULTS = {"scale": 1.0}
+
     def __init__(self, nb_workers, nb_byz_workers, args):
         super().__init__(nb_workers, nb_byz_workers, args)
-        self.scale = parse_keyval(args, {"scale": 1.0})["scale"]
+        self.scale = self.args["scale"]
 
     def apply_local(self, grad, key):
         return -self.scale * grad
@@ -82,9 +90,11 @@ class ZeroAttack(Attack):
 class GaussianAttack(Attack):
     """Submit pure Gaussian noise of tunable deviation."""
 
+    ARG_DEFAULTS = {"deviation": 100.0}
+
     def __init__(self, nb_workers, nb_byz_workers, args):
         super().__init__(nb_workers, nb_byz_workers, args)
-        self.deviation = parse_keyval(args, {"deviation": 100.0})["deviation"]
+        self.deviation = self.args["deviation"]
 
     def apply_local(self, grad, key):
         return self.deviation * jax.random.normal(key, grad.shape, grad.dtype)
@@ -104,10 +114,11 @@ class EmpireAttack(Attack):
     while staying inside the honest cloud for small epsilon."""
 
     omniscient = True
+    ARG_DEFAULTS = {"epsilon": 1.1}
 
     def __init__(self, nb_workers, nb_byz_workers, args):
         super().__init__(nb_workers, nb_byz_workers, args)
-        self.epsilon = parse_keyval(args, {"epsilon": 1.1})["epsilon"]
+        self.epsilon = self.args["epsilon"]
 
     def apply_matrix(self, matrix, byz_mask, key):
         honest = ~byz_mask
@@ -124,10 +135,11 @@ class LittleAttack(Attack):
     ``z`` defaults to the paper's quantile formula from (n, f)."""
 
     omniscient = True
+    ARG_DEFAULTS = {"z": 0.0, "negative": True}
 
     def __init__(self, nb_workers, nb_byz_workers, args):
         super().__init__(nb_workers, nb_byz_workers, args)
-        kv = parse_keyval(args, {"z": 0.0, "negative": True})
+        kv = self.args
         if kv["z"] > 0.0:
             self.z = kv["z"]
         else:

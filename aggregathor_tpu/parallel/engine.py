@@ -44,6 +44,7 @@ from ..core.flatten import FlatMap
 from ..core.train_state import TrainState
 from ..gars.common import centered_gram_sq_distances
 from ..utils import UserException
+from ..utils import compat
 from .mesh import worker_axis
 
 
@@ -90,6 +91,37 @@ def validate_reputation_args(gar, reputation_decay, quarantine_threshold):
     return decay, threshold
 
 
+def validate_chaos_args(chaos, attack, lossy_link, nb_workers, nb_real_byz):
+    """Shared validation of a ChaosSchedule against the engine's own
+    configuration (both engines).  Returns ``chaos`` unchanged."""
+    if chaos is None:
+        return None
+    if attack is not None or lossy_link is not None:
+        raise UserException(
+            "--chaos subsumes the static --attack/--UDP knobs: encode them as "
+            "schedule regimes instead (e.g. '0:attack=empire' / '0:drop=0.3')"
+        )
+    if chaos.nb_workers != nb_workers:
+        raise UserException(
+            "ChaosSchedule was built for n=%d workers but the engine has %d"
+            % (chaos.nb_workers, nb_workers)
+        )
+    if chaos.has_attacks:
+        if nb_real_byz == 0:
+            raise UserException(
+                "The chaos schedule declares attack regimes; they need "
+                "--nb-real-byz-workers > 0 to have anyone to run them"
+            )
+        if chaos.nb_real_byz != nb_real_byz:
+            # the schedule sized its attacks (e.g. little's z formula) for a
+            # different coalition than the engine will gate
+            raise UserException(
+                "ChaosSchedule was built for %d real Byzantine workers but "
+                "the engine declares %d" % (chaos.nb_real_byz, nb_real_byz)
+            )
+    return chaos
+
+
 def quarantine_mask(reputation, threshold, nb_byz):
     """(n,) bool: below-threshold AND among the ``nb_byz`` lowest
     reputations — the cap keeps the masked count within the NaN budget the
@@ -131,13 +163,19 @@ class RobustEngine:
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
-                 granularity="vector", leaf_bucketing="auto", trace_ops=False):
+                 granularity="vector", leaf_bucketing="auto", trace_ops=False, chaos=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
+        # Time-varying fault regimes (chaos/schedule.py): the schedule's
+        # regime index is computed from the TRACED step counter each step, so
+        # attack/loss/straggler knobs switch inside the one compiled program.
+        # Chaos SUBSUMES the static whole-run knobs — mixing both would give
+        # two transport simulations with colliding PRNG streams.
+        self.chaos = validate_chaos_args(chaos, attack, lossy_link, self.nb_workers, self.nb_real_byz)
         # Device-side augmentation: ``batch_transform(worker_batch, key) ->
         # worker_batch`` runs INSIDE the jitted step, per worker, train-only
         # (eval paths never apply it).  Keys are a function of (run seed,
@@ -221,8 +259,11 @@ class RobustEngine:
         if attack is not None and self.nb_real_byz == 0:
             raise UserException("An attack needs --nb-real-byz-workers > 0 to have anyone to run it")
         # CLEVER stale infill needs the previously-received gradients carried
-        # across steps (mpi_rendezvous_mgr.patch:833-835).
-        self.carries_gradients = lossy_link is not None and lossy_link.clever
+        # across steps (mpi_rendezvous_mgr.patch:833-835); stale-mode chaos
+        # stragglers reuse the exact same carry (chaos/stragglers.py).
+        self.carries_gradients = (lossy_link is not None and lossy_link.clever) or (
+            self.chaos is not None and self.chaos.needs_carry
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -240,12 +281,15 @@ class RobustEngine:
         flatmap = FlatMap(jax.tree_util.tree_map(lambda g: g[0], grads))
         return losses, gvecs, flatmap
 
-    def _perturb_local(self, gvecs, key, carry=None):
-        """Apply local attack + lossy link to each local worker's own slot.
+    def _perturb_local(self, gvecs, key, carry=None, ridx=None):
+        """Apply local attack + lossy link + chaos regime to each local
+        worker's own slot.
 
         Returns (perturbed (k, d), new_carry) — ``new_carry`` is the
-        post-link gradients, i.e. what "the PS received" this step, which is
-        exactly the stale value a lost packet keeps under CLEVER infill.
+        post-transport gradients, i.e. what "the PS received" this step:
+        exactly the stale value a lost packet keeps under CLEVER infill, and
+        the value a stale-mode straggler keeps re-submitting (a worker late
+        k steps in a row re-sends the same gradient k times).
         """
         k = self.workers_per_device
         didx = jax.lax.axis_index(worker_axis)
@@ -254,12 +298,30 @@ class RobustEngine:
             gidx = didx * k + j
             g = gvecs[j]
             wkey = jax.random.fold_in(key, gidx)
+            previous = carry[j] if carry is not None else None
             if self.attack is not None and not self.attack.omniscient:
                 forged = self.attack.apply_local(g, jax.random.fold_in(wkey, 1))
                 g = jnp.where(gidx < self.nb_real_byz, forged, g)
+            if self.chaos is not None and self.chaos.has_local_attacks:
+                forged = self.chaos.apply_local_attacks(ridx, g, jax.random.fold_in(wkey, 1))
+                g = jnp.where(gidx < self.nb_real_byz, forged, g)
             if self.lossy_link is not None:
-                previous = carry[j] if carry is not None else None
                 g = self.lossy_link.apply(g, jax.random.fold_in(wkey, 2), gidx, previous=previous)
+            if self.chaos is not None:
+                if self.chaos.has_drop:
+                    # chaos loss storms hit EVERY worker (link sized n); the
+                    # rate is the regime's traced scalar — no recompilation
+                    g = self.chaos.link.apply(
+                        g, jax.random.fold_in(wkey, 2), gidx,
+                        drop_rate=self.chaos.drop_rate(ridx),
+                    )
+                if self.chaos.has_stragglers:
+                    late = self.chaos.stragglers.is_late(
+                        wkey, gidx, self.chaos.straggler_rate(ridx)
+                    )
+                    g = self.chaos.stragglers.apply(
+                        g, late, self.chaos.straggler_stale(ridx), previous=previous
+                    )
             out.append(g)
         stacked = jnp.stack(out, axis=0)
         return stacked, (stacked if self.carries_gradients else None)
@@ -279,7 +341,7 @@ class RobustEngine:
             gathered = gathered.reshape(W, k, blk)
         return gathered.reshape(self.nb_workers, blk)
 
-    def _prepare_rows(self, rows, attack_key, reputation):
+    def _prepare_rows(self, rows, attack_key, reputation, ridx=None):
         """The ORDER-SENSITIVE shared front of both aggregation paths:
         omniscient attack -> requantize forged rows -> quarantine mask.
 
@@ -290,11 +352,18 @@ class RobustEngine:
         earlier would measure the attacker's honest gradient and never
         suspect it); forged rows are squeezed through the exchange dtype
         because they crossed the same wire as honest ones."""
+        forged = False
         if self.attack is not None and self.attack.omniscient:
             byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
             rows = self.attack.apply_matrix(rows, byz_mask, attack_key)
-            if self.exchange_dtype is not None:
-                rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+            forged = True
+        if self.chaos is not None and self.chaos.has_omniscient_attacks:
+            byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
+            rows = self.chaos.apply_omniscient_attacks(ridx, rows, byz_mask, attack_key)
+            forged = True
+        if forged and self.exchange_dtype is not None:
+            # forged rows crossed the same quantized wire as honest ones
+            rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
         raw_rows = rows
         if self.quarantine_threshold:
             qmask = quarantine_mask(
@@ -303,7 +372,7 @@ class RobustEngine:
             rows = jnp.where(qmask[:, None], jnp.nan, rows)
         return rows, raw_rows
 
-    def _aggregate_block(self, block, key, reputation=None):
+    def _aggregate_block(self, block, key, reputation=None, ridx=None):
         """Omniscient attack, quarantine gate, distances (psum), blockwise GAR.
 
         Returns ``(agg_block, participation, block, raw_block)`` — the (n,)
@@ -311,7 +380,7 @@ class RobustEngine:
         ``worker_metrics``), the post-quarantine ``block`` the rule actually
         consumed, and the post-attack PRE-quarantine ``raw_block`` the
         reputation signal measures."""
-        block, raw_block = self._prepare_rows(block, key, reputation)
+        block, raw_block = self._prepare_rows(block, key, reputation, ridx=ridx)
         dist2 = None
         if self.gar.needs_distances:
             partial = _partial_pairwise_sq_distances(block)
@@ -332,7 +401,7 @@ class RobustEngine:
         agg = self.gar._call_aggregate(block, dist2, axis_name=axis, key=gar_key)
         return agg, None, block, raw_block
 
-    def _aggregate_per_leaf(self, gvecs, flatmap, key, reputation):
+    def _aggregate_per_leaf(self, gvecs, flatmap, key, reputation, ridx=None):
         """granularity:leaf dispatch — bucketed on TPU, unrolled elsewhere
         (numerically equivalent; see ``leaf_bucketing`` in __init__)."""
         on_tpu = self.mesh.devices.flat[0].platform == "tpu"  # where THIS mesh runs
@@ -341,9 +410,9 @@ class RobustEngine:
             or (self.leaf_bucketing == "auto" and on_tpu)
         )
         impl = self._aggregate_per_leaf_bucketed if bucketed else self._aggregate_per_leaf_unrolled
-        return impl(gvecs, flatmap, key, reputation)
+        return impl(gvecs, flatmap, key, reputation, ridx=ridx)
 
-    def _aggregate_per_leaf_bucketed(self, gvecs, flatmap, key, reputation):
+    def _aggregate_per_leaf_bucketed(self, gvecs, flatmap, key, reputation, ridx=None):
         """granularity:leaf — gather and reduce each leaf's (n, d_leaf) rows
         independently (per-layer selection), BUCKETED by leaf size.
 
@@ -404,7 +473,7 @@ class RobustEngine:
 
             def per_leaf(leaf_rows, leaf_index):
                 prep_key = jax.random.fold_in(key, 20_000 + leaf_index)
-                leaf_rows, raw_rows = self._prepare_rows(leaf_rows, prep_key, reputation)
+                leaf_rows, raw_rows = self._prepare_rows(leaf_rows, prep_key, reputation, ridx=ridx)
                 dist2 = (
                     jnp.maximum(pairwise_sq_distances(leaf_rows), 0.0)
                     if self.gar.needs_distances else None
@@ -449,7 +518,7 @@ class RobustEngine:
         )
         return agg, participation, wdist, rep_dist
 
-    def _aggregate_per_leaf_unrolled(self, gvecs, flatmap, key, reputation):
+    def _aggregate_per_leaf_unrolled(self, gvecs, flatmap, key, reputation, ridx=None):
         """The plain per-leaf loop (one all_gather + one rule call per
         leaf).  Semantically the definition of granularity:leaf — and the
         DEFAULT path off-TPU (``leaf_bucketing="auto"``; measured faster
@@ -478,7 +547,7 @@ class RobustEngine:
                 rows = local
             rows = rows.astype(jnp.float32)
             rows, raw_rows = self._prepare_rows(
-                rows, jax.random.fold_in(key, 20_000 + i), reputation
+                rows, jax.random.fold_in(key, 20_000 + i), reputation, ridx=ridx
             )
             dist2 = (
                 jnp.maximum(pairwise_sq_distances(rows), 0.0)
@@ -538,6 +607,10 @@ class RobustEngine:
                         step=state.step, dev=jax.lax.axis_index(worker_axis), **kw)
 
             key = jax.random.fold_in(state.rng, state.step)
+            # Active chaos regime for THIS step: a traced array index into
+            # the schedule's compiled knob vectors, so regime switches land
+            # at exactly their scheduled step with zero recompilation.
+            ridx = self.chaos.regime_index(state.step) if self.chaos is not None else None
             if self.batch_transform is not None:
                 k = self.workers_per_device
                 didx = jax.lax.axis_index(worker_axis)
@@ -563,18 +636,18 @@ class RobustEngine:
                 new_momentum = beta * state.momentum + (1.0 - beta) * gvecs
                 new_momentum_steps = state.momentum_steps + 1
                 gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
-            gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry)
+            gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry, ridx=ridx)
             d = gvecs.shape[-1]
             if self.granularity == "leaf":
                 agg, participation, wdist, rep_dist = self._aggregate_per_leaf(
-                    gvecs, flatmap, key, state.reputation
+                    gvecs, flatmap, key, state.reputation, ridx=ridx
                 )
             else:
                 block = self._reshard_to_blocks(gvecs, d)
                 if self.exchange_dtype is not None:
                     block = block.astype(jnp.float32)  # GAR math always in f32
                 agg_block, participation, seen_block, raw_block = self._aggregate_block(
-                    block, key, reputation=state.reputation
+                    block, key, reputation=state.reputation, ridx=ridx
                 )
                 if self.exchange_dtype is not None:
                     agg_block = agg_block.astype(self.exchange_dtype)  # wire, leg 2
@@ -627,6 +700,10 @@ class RobustEngine:
                 "total_loss": total_loss,
                 "grad_norm": jnp.linalg.norm(agg),
             }
+            if ridx is not None:
+                # replicated scalar (a pure function of the replicated step)
+                # — the observability layer's regime column
+                metrics["chaos_regime"] = ridx
             if self.worker_metrics:
                 # Suspicion diagnostics: squared distance of each worker's
                 # gradient to the aggregate (universal), plus the rule's own
@@ -658,7 +735,7 @@ class RobustEngine:
           leading dimension nb_workers (worker-major), sharded over the mesh.
         """
         body = self._make_body(loss_fn, tx)
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(self._state_spec(), P(worker_axis)),
@@ -699,7 +776,7 @@ class RobustEngine:
 
             batch_spec = P(worker_axis)
 
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             many,
             mesh=self.mesh,
             in_specs=(self._state_spec(), batch_spec),
@@ -762,7 +839,7 @@ class RobustEngine:
 
             return jax.lax.scan(sampled_body, state, None, length=nb_steps)
 
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             many,
             mesh=self.mesh,
             in_specs=(self._state_spec(), P()),
@@ -793,7 +870,7 @@ class RobustEngine:
                 folded = jax.lax.psum(folded, worker_axis)
             return folded
 
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(self._state_spec(), P(worker_axis)),

@@ -46,18 +46,22 @@ class LossyLink:
         self.min_coords = int(kv["min-coords"])
         self.clever = bool(kv["clever"])
 
-    def apply(self, grad, key, worker_index, previous=None):
+    def apply(self, grad, key, worker_index, previous=None, drop_rate=None):
         """Mask lost packets of one worker's (d,) gradient.
 
         Applies only when ``worker_index < nb_lossy`` and the gradient is
         large enough to have used the lossy transport.  ``previous`` supplies
-        the stale infill for clever mode.
+        the stale infill for clever mode.  ``drop_rate`` overrides the
+        static configured rate with a (possibly traced) per-step value —
+        the chaos scheduler's hook for loss storms that vary by regime
+        without recompiling (``chaos/schedule.py``).
         """
         d = grad.shape[0]
         if self.nb_lossy <= 0 or d < self.min_coords:
             return grad
+        rate = self.drop_rate if drop_rate is None else drop_rate
         nb_packets = -(-d // self.packet_coords)
-        drops = jax.random.bernoulli(key, self.drop_rate, (nb_packets,))
+        drops = jax.random.bernoulli(key, rate, (nb_packets,))
         mask = jnp.repeat(drops, self.packet_coords, total_repeat_length=nb_packets * self.packet_coords)[:d]
         if self.clever and previous is None:
             from ..utils import UserException

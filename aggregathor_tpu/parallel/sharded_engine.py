@@ -46,6 +46,7 @@ from .. import config
 from ..core.train_state import TrainState
 from ..gars.common import centered_gram_sq_distances
 from ..utils import UserException
+from ..utils import compat
 from .mesh import model_axis, pipe_axis, worker_axis
 
 _IN_GROUP_AXES = (pipe_axis, model_axis)
@@ -79,7 +80,7 @@ class ShardedRobustEngine:
     def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
                  exchange_dtype=None, worker_momentum=None, worker_metrics=False,
                  reputation_decay=None, quarantine_threshold=0.0,
-                 l1_regularize=None, l2_regularize=None):
+                 l1_regularize=None, l2_regularize=None, chaos=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
@@ -87,6 +88,14 @@ class ShardedRobustEngine:
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
+        # Time-varying fault regimes (chaos/schedule.py), the flat engine's
+        # semantics: regime knobs switch on the traced step, stragglers'
+        # lateness is drawn ONCE per (step, worker) so a late worker is late
+        # for ALL of its shards (a whole logical worker misses the deadline,
+        # not one of its tensors).
+        from .engine import validate_chaos_args
+
+        self.chaos = validate_chaos_args(chaos, attack, lossy_link, self.nb_workers, self.nb_real_byz)
         # Wire precision of the per-bucket worker-axis all_gathers (the
         # engine's dominant collective): bf16 halves the bytes; GAR math
         # stays float32 on upcast rows (see parallel/engine.py for the
@@ -103,7 +112,10 @@ class ShardedRobustEngine:
         # CLEVER stale infill carries the previously-sent values per leaf
         # (the reference's >1 MB UDP threshold is per-tensor too,
         # mpi_rendezvous_mgr.patch:507-513); buffer layout mirrors momentum.
-        self.carries_gradients = lossy_link is not None and lossy_link.clever
+        # Stale-mode chaos stragglers ride the same carry.
+        self.carries_gradients = (lossy_link is not None and lossy_link.clever) or (
+            self.chaos is not None and self.chaos.needs_carry
+        )
         # Opt-in per-worker suspicion diagnostics, the flat engine's
         # worker_metrics: whole-model squared distance to the aggregate and
         # the mean per-bucket participation (see parallel/engine.py).
@@ -162,9 +174,40 @@ class ShardedRobustEngine:
         """
         shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_is_spec)
         params = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(seed))
-        with jax.set_mesh(self.mesh):  # optimizers that allocate (adam, ...) need the mesh
-            opt_state = jax.jit(tx.init)(params)  # shardings propagate from params
         rep = NamedSharding(self.mesh, P())
+        # Optimizer state must come out with EXPLICIT NamedShardings: optax
+        # buffers that mirror the params (adam's mu/nu, momentum's trace —
+        # they share the params' treedef) take the params' layouts, every
+        # other allocation (schedule counts etc.) replicates.  Relying on
+        # ambient-mesh propagation instead is version-fragile: on older JAX
+        # there is no ambient mesh and jit commits fresh outputs to a single
+        # device, which the spec-deriving build_step cannot consume.
+        opt_shapes = jax.eval_shape(tx.init, params)
+        params_treedef = jax.tree_util.tree_structure(params)
+        param_shardings = jax.tree.map(lambda p: p.sharding, params)
+
+        def params_like(node):
+            try:
+                return jax.tree_util.tree_structure(node) == params_treedef
+            except TypeError:
+                return False
+
+        if params_treedef.num_leaves == 1:
+            # a single-leaf treedef would "match" every leaf, so identify
+            # the params-mirroring buffers by shape/dtype identity instead
+            only = jax.tree_util.tree_leaves(params)[0]
+            opt_shardings = jax.tree.map(
+                lambda s: only.sharding
+                if (s.shape, s.dtype) == (only.shape, only.dtype) else rep,
+                opt_shapes,
+            )
+        else:
+            opt_shardings = jax.tree.map(
+                lambda node: param_shardings if params_like(node) else rep,
+                opt_shapes, is_leaf=params_like,
+            )
+        with compat.set_mesh(self.mesh):  # new-JAX path also wants the mesh ambient
+            opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
 
         def per_worker_zeros():
             m_shardings = jax.tree.map(
@@ -220,19 +263,37 @@ class ShardedRobustEngine:
 
     # ------------------------------------------------------------------ #
 
-    def _perturb(self, g, spec, key, widx, previous=None):
-        """Worker-local attack + lossy link on this worker's own shard.
+    def _perturb(self, g, spec, key, widx, previous=None, ridx=None, late=None):
+        """Worker-local attack + lossy link + chaos regime on this worker's
+        own shard.
 
-        Returns (perturbed leaf, post-link leaf) — the latter is what "the
-        receiver saw", the stale value a lost packet keeps under CLEVER.
+        Returns (perturbed leaf, post-transport leaf) — the latter is what
+        "the receiver saw", the stale value a lost packet keeps under CLEVER
+        and a stale-mode straggler keeps re-submitting.  ``late`` is the
+        worker's per-STEP lateness flag (drawn once in the body, shared by
+        every leaf: a late worker misses the deadline for its whole
+        gradient).
         """
         flat = g.reshape(-1)
+        prev_flat = previous.reshape(-1) if previous is not None else None
         if self.attack is not None and not self.attack.omniscient:
             forged = self.attack.apply_local(flat, jax.random.fold_in(key, 1))
             flat = jnp.where(widx < self.nb_real_byz, forged, flat)
+        if self.chaos is not None and self.chaos.has_local_attacks:
+            forged = self.chaos.apply_local_attacks(ridx, flat, jax.random.fold_in(key, 1))
+            flat = jnp.where(widx < self.nb_real_byz, forged, flat)
         if self.lossy_link is not None:
-            prev_flat = previous.reshape(-1) if previous is not None else None
             flat = self.lossy_link.apply(flat, jax.random.fold_in(key, 2), widx, previous=prev_flat)
+        if self.chaos is not None:
+            if self.chaos.has_drop:
+                flat = self.chaos.link.apply(
+                    flat, jax.random.fold_in(key, 2), widx,
+                    drop_rate=self.chaos.drop_rate(ridx),
+                )
+            if late is not None:
+                flat = self.chaos.stragglers.apply(
+                    flat, late, self.chaos.straggler_stale(ridx), previous=prev_flat
+                )
         out = flat.reshape(g.shape)
         return out, out
 
@@ -253,12 +314,18 @@ class ShardedRobustEngine:
             rows = rows.astype(jnp.float32)
         return jnp.swapaxes(rows, 0, 1)
 
-    def _apply_omniscient(self, rows, key):
-        if self.attack is None or not self.attack.omniscient:
-            return rows
+    def _apply_omniscient(self, rows, key, ridx=None):
         byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
-        rows = jax.vmap(lambda m: self.attack.apply_matrix(m, byz_mask, key))(rows)
-        if self.exchange_dtype is not None:
+        forged = False
+        if self.attack is not None and self.attack.omniscient:
+            rows = jax.vmap(lambda m: self.attack.apply_matrix(m, byz_mask, key))(rows)
+            forged = True
+        if self.chaos is not None and self.chaos.has_omniscient_attacks:
+            rows = jax.vmap(
+                lambda m: self.chaos.apply_omniscient_attacks(ridx, m, byz_mask, key)
+            )(rows)
+            forged = True
+        if forged and self.exchange_dtype is not None:
             # forged rows crossed the same quantized wire as honest ones
             rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
         return rows
@@ -288,6 +355,21 @@ class ShardedRobustEngine:
             batch = jax.tree.map(lambda x: x[0], batch)  # strip worker block dim
             key = jax.random.fold_in(state.rng, state.step)
             widx = jax.lax.axis_index(worker_axis)
+            # Active chaos regime + per-STEP worker lateness (one draw per
+            # worker, shared by all its leaves).  The lateness key lives in
+            # the 30_000+ offset namespace — fold_in(key, widx) is the
+            # PARENT of every per-leaf stream (fold i, then tags 1/2), so
+            # folding the straggler tag onto it directly would collide with
+            # leaf index 5's stream (same convention as the 10_000+i /
+            # 20_000+i offsets the engines use elsewhere).
+            ridx = late = None
+            if self.chaos is not None:
+                ridx = self.chaos.regime_index(state.step)
+                if self.chaos.has_stragglers:
+                    late = self.chaos.stragglers.is_late(
+                        jax.random.fold_in(key, 30_000 + widx), widx,
+                        self.chaos.straggler_rate(ridx),
+                    )
             loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
 
             g_leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -338,6 +420,7 @@ class ShardedRobustEngine:
                 self._perturb(
                     g, s, jax.random.fold_in(jax.random.fold_in(key, widx), i), widx,
                     previous=carry_leaves[i] if carry_leaves is not None else None,
+                    ridx=ridx, late=late,
                 )
                 for i, (g, s) in enumerate(zip(g_leaves, s_leaves))
             ]
@@ -352,7 +435,7 @@ class ShardedRobustEngine:
             all_rows = []
             for i, (g, s) in enumerate(zip(g_leaves, s_leaves)):
                 rows = self._gather_rows(self._leaf_buckets(g, s))
-                rows = self._apply_omniscient(rows, jax.random.fold_in(key, 10_000 + i))
+                rows = self._apply_omniscient(rows, jax.random.fold_in(key, 10_000 + i), ridx=ridx)
                 all_rows.append(rows)
 
             # Quarantine BEFORE any distance computation (incl. the global
@@ -488,6 +571,8 @@ class ShardedRobustEngine:
                 "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),
                 "grad_norm": grad_norm,
             }
+            if ridx is not None:
+                metrics["chaos_regime"] = ridx  # replicated function of step
             if self.worker_metrics:
                 metrics["worker_sq_dist"] = jax.lax.psum(wdist, _IN_GROUP_AXES)
                 if part_count:
@@ -526,7 +611,7 @@ class ShardedRobustEngine:
         """
         state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
         body = self._make_body(loss_fn, tx, state_specs)
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(state_specs, P(worker_axis)),
@@ -565,7 +650,7 @@ class ShardedRobustEngine:
 
             batch_spec = P(worker_axis)
 
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             many,
             mesh=self.mesh,
             in_specs=(state_specs, batch_spec),
@@ -587,7 +672,7 @@ class ShardedRobustEngine:
             loss = loss_fn(state.params, batch)  # local partial
             return jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)) / self.nb_workers
 
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(specs, P(worker_axis)),

@@ -1,0 +1,37 @@
+"""JAX cross-version shims for the two engine-facing APIs that moved.
+
+The engines target the current ``jax.shard_map`` / ``jax.set_mesh`` surface;
+older installations (<= 0.4.x) ship the same functionality as
+``jax.experimental.shard_map.shard_map`` (whose replication check is spelled
+``check_rep`` rather than ``check_vma``) and have no ``set_mesh`` — there the
+``Mesh`` object itself is the context manager.  Everything else the engines
+use lowers identically on both surfaces, so these two adapters are the whole
+compatibility story (tier-1 runs them on whichever JAX the box has).
+"""
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma):
+    """``jax.shard_map`` with graceful fallback to the experimental API.
+
+    ``check_vma`` is deliberately REQUIRED: ``jax.shard_map`` defaults it to
+    True and the engines always pass False — a shim default would silently
+    invert one contract or the other for future call sites."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
+def set_mesh(mesh):
+    """Context manager installing ``mesh`` as the ambient mesh."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    # Pre-0.5 JAX: the Mesh object is its own context manager.
+    return mesh

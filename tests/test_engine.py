@@ -37,8 +37,12 @@ def run_steps(exp, engine, step, state, count, seed=3):
 
 @pytest.mark.parametrize(
     "gar_name,f",
-    [("average", 0), ("median", 1), ("krum", 1), ("bulyan", 1),
-     ("trimmed-mean", 1), ("centered-clip", 1)],
+    [("average", 0), ("median", 1), ("krum", 1),
+     # order-statistic-heavy rules compile slowly on the 1-core CPU host;
+     # their convergence is also covered by the oracle property tests
+     pytest.param("bulyan", 1, marks=pytest.mark.slow),
+     pytest.param("trimmed-mean", 1, marks=pytest.mark.slow),
+     pytest.param("centered-clip", 1, marks=pytest.mark.slow)],
 )
 def test_training_decreases_loss(gar_name, f):
     exp, engine, step, state = make_setup(gar_name, n=8, f=f)
@@ -115,6 +119,7 @@ def test_lossy_link_breaks_plain_average():
     assert not np.all(np.isfinite(flat_params(state)))
 
 
+@pytest.mark.slow
 def test_bf16_exchange_converges_and_stays_invariant():
     """bfloat16 wire exchange: training still converges, and the result is
     device-count invariant (the quantization happens identically before the
@@ -626,6 +631,7 @@ def test_leaf_granularity_quarantine():
     assert np.all(np.isfinite(flat_params(state)))
 
 
+@pytest.mark.slow
 def test_leaf_bucketed_matches_unrolled():
     """The bucketed leaf path (stacked same-size leaves, vmapped rule, one
     all_gather per distinct size) reproduces the unrolled per-leaf loop
@@ -722,6 +728,7 @@ def test_sampled_multi_step_differs_from_repeat_batch():
     assert not np.allclose(flat_params(s1), flat_params(s2), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_sampled_multi_step_composes_with_momentum_and_clever():
     """The sampled trainer threads the worker-sharded side buffers exactly
     like the streamed scan: momentum + CLEVER lossy carry + attack compose

@@ -68,9 +68,19 @@ def test_resnet_bfloat16_compute():
 
 @pytest.mark.parametrize(
     "name",
-    ["inception_v1", "inception_v2", "mobilenet_v1_025", "mobilenet_v2_035",
-     "lenet", "cifarnet", "alexnet_v2", "overfeat", "nasnet_cifar",
-     "pnasnet_mobile", "resnet_v2_50"],
+    # the ≥30 s compile-bound giants carry the slow mark so tier-1 stays
+    # inside its wall-clock budget on a 1-core host; tier-1 keeps
+    # mobilenet_v1, the lenet/cifarnet/alexnet/overfeat classics,
+    # resnet_v2_50, and inception coverage via
+    # test_inception_aux_head_trains (which jits an inception_v1 grad)
+    [pytest.param("inception_v1", marks=pytest.mark.slow),
+     pytest.param("inception_v2", marks=pytest.mark.slow),
+     "mobilenet_v1_025",
+     pytest.param("mobilenet_v2_035", marks=pytest.mark.slow),
+     "lenet", "cifarnet", "alexnet_v2", "overfeat",
+     pytest.param("nasnet_cifar", marks=pytest.mark.slow),
+     pytest.param("pnasnet_mobile", marks=pytest.mark.slow),
+     "resnet_v2_50"],
 )
 def test_new_zoo_families_forward(name):
     exp = models.instantiate("slim-%s-cifar10" % name, ["batch-size:2", "eval-batch-size:2"])

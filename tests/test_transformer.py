@@ -12,6 +12,7 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from aggregathor_tpu.utils import compat
 from aggregathor_tpu import config, gars
 from aggregathor_tpu.models import transformer as tfm
 from aggregathor_tpu.parallel.mesh import factor_devices, make_mesh
@@ -61,7 +62,7 @@ def test_ring_attention_matches_dense(rng):
 
     spec = P(None, config.model_axis, None, None)
     ringed = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        compat.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense), rtol=2e-5, atol=2e-5)
 
@@ -78,7 +79,7 @@ def test_pipeline_loss_matches_dense(rng):
         return jax.lax.psum(loss_fn(p, b), (config.pipe_axis, config.model_axis))
 
     sharded = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             body,
             mesh=mesh,
             in_specs=(tfm.param_specs(CFG), P()),
@@ -119,6 +120,7 @@ def test_sharded_engine_average_matches_manual_sgd(rng):
         )
 
 
+@pytest.mark.slow
 def test_sharded_engine_l1_l2_regularization_exact(rng):
     """l1/l2 on the sharded engine is applied analytically to the completed
     gradients (no per-shard double counting): the result matches the dense
@@ -177,6 +179,7 @@ def test_sharded_engine_l1_l2_regularization_exact(rng):
         )
 
 
+@pytest.mark.slow
 def test_sharded_engine_multi_step_matches_per_step(rng):
     """build_multi_step (K batches, one scanned dispatch) reproduces K
     sequential build_step calls and returns per-step metrics (leading K) —
@@ -348,6 +351,7 @@ def test_sharded_engine_clever_lossy(rng):
     assert all(finite)
 
 
+@pytest.mark.slow
 def test_sharded_engine_uses_axis_rules_exact_across_tp(rng):
     """uses_axis rules (geometric-median, centered-clip) psum their row norms
     over the model axis: a tp=2 run must produce the tp=1 params (no
@@ -375,6 +379,7 @@ def test_sharded_engine_uses_axis_rules_exact_across_tp(rng):
             )
 
 
+@pytest.mark.slow
 def test_sharded_engine_worker_metrics(rng):
     """Suspicion diagnostics on the sharded engine: under a deviation-100
     Gaussian attack with per-layer Krum, the attacker's mean participation is
@@ -403,6 +408,7 @@ def test_sharded_engine_worker_metrics(rng):
         assert wdist[0] > wdist[1:].max()
 
 
+@pytest.mark.slow
 def test_sharded_engine_reputation_quarantine(rng):
     """Reputation + quarantine on the sharded engine: a deviation-100
     Gaussian attacker's reputation decays to ~0 and it quarantines, honest
@@ -430,6 +436,7 @@ def test_sharded_engine_reputation_quarantine(rng):
     assert int(jax.device_get(metrics["nb_quarantined"])) == 1
 
 
+@pytest.mark.slow
 def test_code_corpus_real_text_lm():
     """REAL-text LM anchor (the transformer-family analogue of the real
     digits accuracy test): corpus-source:code trains on the Python stdlib's
