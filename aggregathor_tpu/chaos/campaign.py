@@ -72,6 +72,13 @@ def build_parser():
     parser.add_argument("--breakdown", action="store_true",
                         help="empirically probe each robust rule's f-breakdown boundary "
                              "(re-runs the first attack scenario at r=f and r=n//2+1)")
+    parser.add_argument("--guardian", action="store_true",
+                        help="run every cell under the guardian recovery layer "
+                             "(guardian/): cells report diverged-then-recovered "
+                             "instead of stopping at the first non-finite loss")
+    parser.add_argument("--guardian-args", nargs="*", default=[],
+                        help="key:value watchdog options (patience:N, spike:X, "
+                             "retries:N, ladder:..., see docs/guardian.md)")
     parser.add_argument("--output", default=None, metavar="JSON", help="resilience matrix output path")
     parser.add_argument("--report", default=None, metavar="MD", help="markdown report output path")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
@@ -117,45 +124,131 @@ def _declares_attack(spec, nb_workers):
 
 
 def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
-             chaos_args, nb_steps, lr, seed, nb_devices=1):
-    """Train one grid cell; returns the cell record (see CELL_KEYS)."""
+             chaos_args, nb_steps, lr, seed, nb_devices=1, guardian=None):
+    """Train one grid cell; returns the cell record (see CELL_KEYS).
+
+    With ``guardian`` (a :class:`guardian.GuardianConfig`), the cell runs
+    under the recovery layer with IN-MEMORY last-known-good snapshots (no
+    checkpoint directory per cell): on divergence it rolls back, climbs the
+    escalation ladder and replays — the cell then reports
+    ``rollbacks``/``escalations``/``recovered`` instead of stopping at the
+    first non-finite loss, closing the loop where an injected breakdown
+    regime becomes the test harness for the recovery layer."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from .. import gars, models
     from ..core import build_optimizer, build_schedule
     from ..parallel import RobustEngine, make_mesh
+    from ..utils import UserException, warning
     from .schedule import ChaosSchedule
 
     experiment = models.instantiate(exp_name, exp_args)
-    gar = gars.instantiate(gar_name, n, f, gar_args)
     chaos = (
         ChaosSchedule(schedule_spec, n, nb_real_byz=r, args=chaos_args)
         if schedule_spec else None
     )
     nb_real = r if (chaos is not None and chaos.has_attacks) else 0
-    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:%s" % lr]))
-    engine = RobustEngine(
-        make_mesh(nb_workers=nb_devices), gar, n, nb_real_byz=nb_real, chaos=chaos,
-    )
-    step = engine.build_step(experiment.loss, tx)
+    mesh = make_mesh(nb_workers=nb_devices)
+
+    def build(ov):
+        """(engine, tx, step) for an Overrides record — rebuilt per rung."""
+        gar = gars.instantiate(ov.gar_name, n, ov.f, list(ov.gar_args))
+        tx = build_optimizer(
+            "sgd", build_schedule("fixed", ["initial-rate:%s" % (lr * ov.lr_scale)])
+        )
+        engine = RobustEngine(
+            mesh, gar, n, nb_real_byz=nb_real, chaos=chaos,
+            reputation_decay=ov.reputation_decay,
+            quarantine_threshold=ov.quarantine_threshold,
+        )
+        return engine, tx, engine.build_step(experiment.loss, tx)
+
+    from ..guardian import RESEED_STRIDE, RNG_PERTURB_TAG, Overrides, Watchdog
+
+    overrides = Overrides(f, gar_name, tuple(gar_args or []))
+    watchdog = Watchdog(guardian) if guardian is not None else None
+    engine, tx, step = build(overrides)
     state = engine.init_state(experiment.init(jax.random.PRNGKey(seed)), tx, seed=seed + 1)
     it = experiment.make_train_iterator(n, seed=seed + 2)
+
     losses = []
     diverged = False
-    for _ in range(nb_steps):
+    failed = False
+    rollbacks = 0
+    escalations = []
+    recovered = False
+    good = None  # (host serialized fields, len(losses)) at last healthy step
+    snap_every = max(1, nb_steps // 8)
+    s = 0
+    while s < nb_steps:
         state, metrics = step(state, engine.shard_batch(next(it)))
         loss = float(jax.device_get(metrics["total_loss"]))
         losses.append(loss)
-        if not np.isfinite(loss):
-            # params are poisoned; every later loss is NaN too — stop paying
-            # for steps that can no longer change the verdict
-            diverged = True
+        s += 1
+        if watchdog is None:
+            if not np.isfinite(loss):
+                # params are poisoned; every later loss is NaN too — stop
+                # paying for steps that can no longer change the verdict
+                diverged = True
+                break
+            continue
+        probe = metrics["probe"]
+        action = watchdog.observe(
+            s, loss, bool(int(jax.device_get(probe["loss_finite"]))),
+            float(jax.device_get(probe["spike"])),
+        )
+        if action == "recovered":
+            recovered = rollbacks > 0
+            continue
+        if action != "rollback":
+            if watchdog.healthy and s % snap_every == 0:
+                good = ({
+                    name: jax.device_get(getattr(state, name))
+                    for name in ("step", "params", "opt_state", "rng")
+                }, len(losses))
+            continue
+        diverged = True  # the cell DID diverge; recovery may still save it
+        if watchdog.exhausted:
+            failed = True
             break
+        target_len = good[1] if good is not None else 0
+        attempt = watchdog.note_rollback(
+            int(good[0]["step"]) if good is not None else 0
+        )
+        rollbacks += 1
+        rung = guardian.ladder.rung(attempt)
+        if rung is not None:
+            try:
+                new_overrides = rung.apply(overrides)
+                engine, tx, step = build(new_overrides)
+                overrides = new_overrides
+                escalations.append(rung.describe())
+            except UserException as exc:
+                warning("guardian cell: rung %r rejected: %s" % (rung.describe(), exc))
+        fresh = engine.init_state(
+            experiment.init(jax.random.PRNGKey(seed)), tx,
+            seed=seed + 1 + RESEED_STRIDE * (attempt + 1) if good is None else seed + 1,
+        )
+        if good is not None:
+            snap, _ = good
+            host = jax.device_get(fresh.replace(carry=None, momentum=None))
+            host = host.replace(
+                step=snap["step"], params=snap["params"], opt_state=snap["opt_state"],
+                rng=jax.device_get(jax.random.fold_in(
+                    jnp.asarray(snap["rng"]), RNG_PERTURB_TAG + attempt
+                )),
+            )
+            state = engine.put_state(host.replace(carry=fresh.carry, momentum=fresh.momentum))
+        else:
+            state = fresh
+        losses = losses[:target_len]
+        s = target_len
     finite = [x for x in losses if np.isfinite(x)]
     first = losses[0] if losses else float("nan")
     final = losses[-1] if losses else float("nan")
-    return {
+    cell = {
         "gar": gar_name,
         "nb_real_byz": nb_real,
         "declared_byz": f,
@@ -163,11 +256,23 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         "final_loss": final,
         "min_loss": min(finite) if finite else float("nan"),
         "converged": bool(
-            not diverged and np.isfinite(first) and np.isfinite(final) and final < first
+            (watchdog is None or not failed)
+            and np.isfinite(first) and np.isfinite(final) and final < first
         ),
-        "diverged": diverged,
+        "diverged": diverged if watchdog is None else bool(failed or not np.isfinite(final)),
         "losses": losses,
     }
+    if watchdog is not None:
+        cell["guardian"] = True
+        cell["rollbacks"] = rollbacks
+        cell["escalations"] = escalations
+        # diverged-then-recovered: the injected regime broke the configured
+        # rule AND the recovery layer brought the run back to a finite,
+        # improving trajectory
+        cell["recovered"] = bool(
+            rollbacks > 0 and not failed and np.isfinite(final) and recovered
+        )
+    return cell
 
 
 def run_campaign(args):
@@ -177,6 +282,11 @@ def run_campaign(args):
     n, f, r = args.nb_workers, args.nb_decl_byz_workers, args.nb_real_byz_workers
     if r > n:
         raise UserException("More real Byzantine workers (%d) than workers (%d)" % (r, n))
+    guardian = None
+    if getattr(args, "guardian", False):
+        from ..guardian import GuardianConfig
+
+        guardian = GuardianConfig(args.guardian_args)
     scenarios = _scenarios(args)
     cells = []
     for gar_name in args.gars:
@@ -186,16 +296,17 @@ def run_campaign(args):
                 args.experiment, args.experiment_args, gar_name, args.gar_args,
                 n, f, r, spec, args.chaos_args, args.nb_steps,
                 args.learning_rate, args.seed, nb_devices=args.nb_devices,
+                guardian=guardian,
             )
             cell["scenario"] = scenario
             cell["schedule"] = spec
             cells.append(cell)
-            info(
-                "  -> %s (first %.4f final %.4f)"
-                % ("DIVERGED" if cell["diverged"]
-                   else ("converged" if cell["converged"] else "degraded"),
-                   cell["first_loss"], cell["final_loss"])
-            )
+            verdict = ("DIVERGED" if cell["diverged"]
+                       else ("converged" if cell["converged"] else "degraded"))
+            if cell.get("recovered"):
+                verdict = "recovered (%d rollback(s))" % cell["rollbacks"]
+            info("  -> %s (first %.4f final %.4f)"
+                 % (verdict, cell["first_loss"], cell["final_loss"]))
     breakdown = []
     if args.breakdown:
         # only ATTACK scenarios can probe the Byzantine boundary — a
@@ -265,7 +376,8 @@ def render_report(matrix):
            matrix["nb_steps"]),
         "",
         "Verdicts: `ok` loss decreased (first -> final), `degraded` finite but",
-        "not decreasing, `DIVERGED` non-finite loss (params poisoned).",
+        "not decreasing, `DIVERGED` non-finite loss (params poisoned),",
+        "`recovered` diverged then healed by the guardian (rollback count).",
         "",
         "| GAR | " + " | ".join(scenarios) + " |",
         "|---|" + "---|" * len(scenarios),
@@ -276,6 +388,9 @@ def render_report(matrix):
             cell = by_key.get((gar_name, scenario))
             if cell is None:
                 row.append("—")
+            elif cell.get("recovered"):
+                row.append("recovered x%d (%.3f→%.3f)" % (
+                    cell["rollbacks"], cell["first_loss"], cell["final_loss"]))
             elif cell["diverged"]:
                 row.append("DIVERGED")
             elif cell["converged"]:

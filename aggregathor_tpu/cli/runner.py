@@ -186,6 +186,20 @@ def build_parser():
         help="key:value schedule-wide chaos options (packet-coords:N, "
              "min-coords:N, straggle-workers:K)",
     )
+    parser.add_argument(
+        "--guardian", action="store_true",
+        help="in-loop divergence watchdog + rollback-and-escalate recovery "
+             "(guardian/, docs/guardian.md): on sustained divergence, restore "
+             "the last-known-good snapshot, perturb the RNG and climb the "
+             "escalation ladder (raise f -> stronger GAR -> quarantine -> "
+             "damp lr) with bounded retries; needs --checkpoint-dir",
+    )
+    parser.add_argument(
+        "--guardian-args", nargs="*", default=[],
+        help="key:value watchdog options (patience:N, spike:X, retries:N, "
+             "backoff:B, recover:N, ladder:RUNG,RUNG,... — see "
+             "docs/guardian.md for the ladder grammar)",
+    )
     parser.add_argument("--trace", action="store_true", help="capture a jax.profiler trace of a few steps")
     parser.add_argument("--trace-dir", default="trace", help="profiler trace output directory")
     parser.add_argument("--trace-ops", action="store_true",
@@ -391,9 +405,44 @@ def main(argv=None):
                 % (nb_devices, devices[0].platform, n // nb_devices)
             )
 
+    # Guardian recovery layer (guardian/, docs/guardian.md): parsed up front
+    # so a bad ladder/threshold fails before any compilation.
+    from ..guardian import (
+        RESEED_STRIDE,
+        RNG_PERTURB_TAG,
+        GuardianConfig,
+        Overrides,
+        Watchdog,
+    )
+    from ..guardian import probe as health
+
+    guardian = None
+    if args.guardian:
+        guardian = GuardianConfig(args.guardian_args)
+        if not args.checkpoint_dir:
+            raise UserException(
+                "--guardian rolls back to on-disk snapshots; pass --checkpoint-dir"
+            )
+        if jax.process_count() > 1:
+            raise UserException(
+                "--guardian is single-process for now: rollback decisions would "
+                "need a cross-host broadcast to keep the SPMD step counts aligned"
+            )
+    watchdog = Watchdog(guardian) if guardian is not None else None
+
+    # The escalation ladder overrides exactly these knobs; everything else
+    # about the run is immutable.  The training stack is built FROM an
+    # Overrides record so a guardian rollback can rebuild it mid-run (one
+    # recompile per escalation, paid only on the rare recovery path).
+    overrides = Overrides(
+        f, args.aggregator, tuple(args.aggregator_args),
+        reputation_decay=args.reputation_decay,
+        quarantine_threshold=args.quarantine_threshold,
+    )
+    unroll = max(1, args.unroll)
+
     with Context("graph"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
-        gar = gars.instantiate(args.aggregator, n, f, args.aggregator_args)
         attack = attacks.instantiate(args.attack, n, r, args.attack_args) if args.attack else None
         lossy = LossyLink(args.udp, args.udp_args) if args.udp > 0 else None
         chaos = None
@@ -405,14 +454,11 @@ def main(argv=None):
                 len(chaos), "  ".join("%d:%s" % t for t in chaos.transitions())
             ))
 
-        schedule = build_schedule(args.learning_rate, args.learning_rate_args)
-        tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
+        base_schedule = build_schedule(args.learning_rate, args.learning_rate_args)
 
-        device_dataset = None
+        # One-time validations and warnings — outside the (re)builder so an
+        # escalation rebuild never repeats them.
         if mesh_axes is not None:
-            # ---- fully-sharded engine (per-layer GAR on sharded grads) ----
-            from ..parallel.sharded_engine import ShardedRobustEngine
-
             if args.input_source == "device":
                 raise UserException(
                     "--input-source device needs the flat engine (the sharded "
@@ -437,36 +483,6 @@ def main(argv=None):
                     "--trace-ops narrates the flat engine's step body only; "
                     "ignored under --mesh (use --trace for a profiler window)"
                 )
-            # ``vector`` (the flat default) means whole-vector selection,
-            # which the sharded engine spells ``global`` (one global (n, n)
-            # distance matrix accumulated across shards).
-            gran = "global" if args.granularity == "vector" else args.granularity
-            engine = ShardedRobustEngine(
-                mesh, gar, nb_real_byz=r, attack=attack, lossy_link=lossy,
-                granularity=gran, exchange_dtype=args.exchange_dtype,
-                worker_momentum=args.worker_momentum,
-                worker_metrics=args.worker_metrics,
-                reputation_decay=args.reputation_decay,
-                quarantine_threshold=args.quarantine_threshold,
-                # The sharded loss is a LOCAL PARTIAL under shard_map, so
-                # the engine applies l1/l2 analytically on the completed
-                # gradients instead of wrapping the loss (see sharded_engine)
-                l1_regularize=args.l1_regularize,
-                l2_regularize=args.l2_regularize,
-                chaos=chaos,
-            )
-            loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
-            state = engine.init_state(
-                experiment.sharded_init(mesh_axes[1]), experiment.sharded_specs(),
-                tx, seed=args.seed,
-            )
-            step_fn = engine.build_step(loss_fn, tx, state)
-            unroll = max(1, args.unroll)
-            multi_fn = (
-                engine.build_multi_step(loss_fn, tx, state) if unroll > 1 else None
-            )
-            eval_fn = None  # metric sums need a dense replica; eval reports loss
-            eval_loss_fn = engine.build_eval(loss_fn, state)
         else:
             if args.granularity in ("layer", "global"):
                 raise UserException(
@@ -478,35 +494,6 @@ def main(argv=None):
                     "--leaf-bucketing only affects --granularity leaf; ignored "
                     "for granularity %r" % args.granularity
                 )
-            engine = RobustEngine(
-                mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
-                exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
-                batch_transform=experiment.device_transform(),
-                worker_metrics=args.worker_metrics,
-                reputation_decay=args.reputation_decay,
-                quarantine_threshold=args.quarantine_threshold,
-                granularity=args.granularity,
-                leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
-                trace_ops=args.trace_ops,
-                chaos=chaos,
-            )
-
-            # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
-            base_loss, l1, l2 = experiment.loss, args.l1_regularize, args.l2_regularize
-
-            def loss_fn(params, batch):
-                loss = base_loss(params, batch)
-                leaves = jax.tree_util.tree_leaves(params)
-                if l1:
-                    loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
-                if l2:
-                    loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
-                return loss
-
-            params = experiment.init(jax.random.PRNGKey(args.seed))
-            state = engine.init_state(params, tx, seed=args.seed)
-            step_fn = engine.build_step(loss_fn, tx)
-            unroll = max(1, args.unroll)
             if args.input_source == "device":
                 if jax.process_count() > 1:
                     raise UserException(
@@ -514,26 +501,125 @@ def main(argv=None):
                         "replicating the dataset would device_put onto "
                         "non-addressable devices; use --input-source stream"
                     )
-                arrays = experiment.train_arrays()
-                if arrays is None:
+                if experiment.train_arrays() is None:
                     raise UserException(
                         "--input-source device: experiment %r keeps a host-side "
                         "batch transform or a streaming corpus (train_arrays() "
                         "is None), so an in-graph gather cannot reproduce its "
                         "input stream; use --input-source stream" % args.experiment
                     )
-                # The whole train split lives on the accelerator; the
-                # unrolled branch dispatches the in-graph sampling trainer
-                # (one scan per chunk, zero per-step host transfer).
-                device_dataset = engine.replicate(arrays)
-                multi_fn = engine.build_sampled_multi_step(
-                    loss_fn, tx, repeat_steps=unroll,
-                    batch_size=experiment.batch_size,
-                )
+
+        class TrainingStack:
+            """The rebuildable half of the run: engine + jitted step/eval
+            programs + optimizer, derived from an Overrides record.  A
+            guardian escalation builds a new one; everything else (mesh,
+            experiment, chaos schedule, cadences) is immutable."""
+
+        def build_training(ov):
+            ts = TrainingStack()
+            ts.overrides = ov
+            gar = gars.instantiate(ov.gar_name, n, ov.f, list(ov.gar_args))
+            if ov.lr_scale != 1.0:
+                # escalation's lr damping composes with the named schedule
+                def schedule(s, _base=base_schedule, _x=ov.lr_scale):
+                    return _base(s) * _x
             else:
-                multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
-            eval_fn = engine.build_eval_sums(experiment.metrics)
-            eval_loss_fn = None
+                schedule = base_schedule
+            tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
+            ts.gar, ts.schedule, ts.tx = gar, schedule, tx
+            ts.device_dataset = None
+            if mesh_axes is not None:
+                # ---- fully-sharded engine (per-layer GAR on sharded grads) ----
+                from ..parallel.sharded_engine import ShardedRobustEngine
+
+                # ``vector`` (the flat default) means whole-vector selection,
+                # which the sharded engine spells ``global`` (one global (n, n)
+                # distance matrix accumulated across shards).
+                gran = "global" if args.granularity == "vector" else args.granularity
+                engine = ShardedRobustEngine(
+                    mesh, gar, nb_real_byz=r, attack=attack, lossy_link=lossy,
+                    granularity=gran, exchange_dtype=args.exchange_dtype,
+                    worker_momentum=args.worker_momentum,
+                    worker_metrics=args.worker_metrics,
+                    reputation_decay=ov.reputation_decay,
+                    quarantine_threshold=ov.quarantine_threshold,
+                    # The sharded loss is a LOCAL PARTIAL under shard_map, so
+                    # the engine applies l1/l2 analytically on the completed
+                    # gradients instead of wrapping the loss (see sharded_engine)
+                    l1_regularize=args.l1_regularize,
+                    l2_regularize=args.l2_regularize,
+                    chaos=chaos,
+                )
+                loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
+
+                def make_fresh_state(seed=args.seed):
+                    return engine.init_state(
+                        experiment.sharded_init(mesh_axes[1]), experiment.sharded_specs(),
+                        tx, seed=seed,
+                    )
+
+                state0 = make_fresh_state()
+                ts.step_fn = engine.build_step(loss_fn, tx, state0)
+                ts.multi_fn = (
+                    engine.build_multi_step(loss_fn, tx, state0) if unroll > 1 else None
+                )
+                ts.eval_fn = None  # metric sums need a dense replica; eval reports loss
+                ts.eval_loss_fn = engine.build_eval(loss_fn, state0)
+            else:
+                engine = RobustEngine(
+                    mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
+                    exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
+                    batch_transform=experiment.device_transform(),
+                    worker_metrics=args.worker_metrics,
+                    reputation_decay=ov.reputation_decay,
+                    quarantine_threshold=ov.quarantine_threshold,
+                    granularity=args.granularity,
+                    leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
+                    trace_ops=args.trace_ops,
+                    chaos=chaos,
+                )
+
+                # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
+                base_loss, l1, l2 = experiment.loss, args.l1_regularize, args.l2_regularize
+
+                def loss_fn(params, batch):
+                    loss = base_loss(params, batch)
+                    leaves = jax.tree_util.tree_leaves(params)
+                    if l1:
+                        loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
+                    if l2:
+                        loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
+                    return loss
+
+                def make_fresh_state(seed=args.seed):
+                    # params ALWAYS init from the run seed; ``seed`` only moves
+                    # the RNG stream (guardian's from-scratch retry path)
+                    return engine.init_state(
+                        experiment.init(jax.random.PRNGKey(args.seed)), tx, seed=seed
+                    )
+
+                state0 = make_fresh_state()
+                ts.step_fn = engine.build_step(loss_fn, tx)
+                if args.input_source == "device":
+                    # The whole train split lives on the accelerator; the
+                    # unrolled branch dispatches the in-graph sampling trainer
+                    # (one scan per chunk, zero per-step host transfer).
+                    ts.device_dataset = engine.replicate(experiment.train_arrays())
+                    ts.multi_fn = engine.build_sampled_multi_step(
+                        loss_fn, tx, repeat_steps=unroll,
+                        batch_size=experiment.batch_size,
+                    )
+                else:
+                    ts.multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
+                ts.eval_fn = engine.build_eval_sums(experiment.metrics)
+                ts.eval_loss_fn = None
+            ts.engine = engine
+            ts.make_fresh_state = make_fresh_state
+            ts.initial_state = state0
+            return ts
+
+        ts = build_training(overrides)
+        state = ts.initial_state
 
     # Cadences with config.py defaults (reference: config.py:54-61)
     def pick(value, default):
@@ -632,7 +718,23 @@ def main(argv=None):
                 carry, momentum = state.carry, state.momentum
                 template = jax.device_get(state.replace(carry=None, momentum=None))
                 restored, offstep = checkpoints.restore(template, step=target_step)
-                state = engine.put_state(restored.replace(carry=carry, momentum=momentum))
+                state = ts.engine.put_state(restored.replace(carry=carry, momentum=momentum))
+            if lead:
+                # Rows beyond the restored step belong to a timeline this
+                # run is about to overwrite; appending after them would
+                # leave duplicate/interleaved step columns in the TSV.
+                dropped = eval_file.truncate_after(offstep)
+                if dropped:
+                    info(
+                        "Trimmed %d stale eval row(s) beyond restored step %d"
+                        % (dropped, offstep)
+                    )
+            if watchdog is not None and offstep > 0:
+                # The snapshot this run just trusted enough to resume FROM is
+                # the guardian's initial last-known-good: a divergence before
+                # the first healthy in-run save must roll back here, not wipe
+                # the directory and restart from scratch.
+                checkpoints.pin(offstep)
 
     # Multi-host boundary authentication (reference parity: every worker->PS
     # push is signed, mpi_rendezvous_mgr.patch:585-627; here the surface is
@@ -656,7 +758,10 @@ def main(argv=None):
         )
 
     max_step = pick(args.max_step, config.default_max_step)
-    train_iter = experiment.make_train_iterator(n, seed=args.seed + 1)
+    train_iter = None
+    prefetcher = None
+    chunk_prefetcher = None
+
     def next_chunk():
         """K distinct batches as one (K, n, ...) stack for the unrolled path
         (one contiguous gather via next_many when the iterator provides it)."""
@@ -666,41 +771,76 @@ def main(argv=None):
             lambda *xs: np.stack(xs), *[next(train_iter) for _ in range(unroll)]
         )
 
-    prefetcher = None
-    chunk_prefetcher = None
-    if args.prefetch > 0 and nb_processes == 1 and device_dataset is None:
-        # Overlap host batch assembly + host->device transfer with compute
-        # (the reference's fetcher/batcher threads + prefetch queue,
-        # cnnet.py:115-146).  Under --unroll the prefetcher carries whole
-        # K-step chunks.  Disabled in multi-process runs: a background
-        # device_put would interleave differently on each host, breaking the
-        # strict cross-process ordering collectives require.
-        from ..models.datasets import DevicePrefetcher
+    def reset_input(start_step, reseed=0):
+        """(Re)build the input pipeline positioned at ``start_step``.
 
-        if unroll == 1:
-            prefetcher = DevicePrefetcher(train_iter, engine.shard_batch, depth=args.prefetch)
-        elif not args.trace:
-            # FINITE producer: exactly the chunks the loop will consume
-            # ((max_step-offstep) // unroll — the loop's unrolled-branch
-            # count is deterministic).  An infinite producer would over-draw
-            # from the shared train_iter and the tail handoff would discard
-            # a thread-timing-dependent number of draws, skipping the tail's
-            # sample stream ahead nondeterministically.  By the time the
-            # per-step tail starts, all chunks were consumed, so the
-            # producer has exhausted its iterator and exited — the tail's
-            # direct train_iter use cannot race the daemon.  (--trace runs
-            # interleave per-step and unrolled dispatches, breaking the
-            # chunk count: they keep the synchronous path.)
-            chunks_total = max(0, (max_step - offstep)) // unroll
-            if chunks_total > 0:
+        Called at startup (start_step = the auto-restored step) and after a
+        guardian rollback.  The stream is FAST-FORWARDED to ``start_step``
+        so a resumed run consumes exactly the batches the uninterrupted run
+        would have — the last piece of bit-identical resume (the serialized
+        step/params/opt-state/RNG already restore exactly).  A rollback
+        passes ``reseed`` > 0 instead: it draws the replay window's batches
+        from a fresh stream, one more way a retry differs from the
+        deterministic trajectory that just diverged."""
+        nonlocal train_iter, prefetcher, chunk_prefetcher
+        if prefetcher is not None:
+            prefetcher.close()
+            prefetcher = None
+        if chunk_prefetcher is not None:
+            chunk_prefetcher.close()
+            chunk_prefetcher = None
+        train_iter = experiment.make_train_iterator(
+            n, seed=args.seed + 1 + RESEED_STRIDE * reseed
+        )
+        if start_step and not reseed:
+            if hasattr(train_iter, "skip"):
+                train_iter.skip(start_step)
+            else:
+                if start_step > 1000:
+                    warning(
+                        "Resume fast-forward: this iterator has no skip(), so "
+                        "%d batches are drawn and discarded to realign the "
+                        "sample stream — expect a slow startup" % start_step
+                    )
+                for _ in range(start_step):
+                    next(train_iter)
+        if args.prefetch > 0 and nb_processes == 1 and ts.device_dataset is None:
+            # Overlap host batch assembly + host->device transfer with compute
+            # (the reference's fetcher/batcher threads + prefetch queue,
+            # cnnet.py:115-146).  Under --unroll the prefetcher carries whole
+            # K-step chunks.  Disabled in multi-process runs: a background
+            # device_put would interleave differently on each host, breaking the
+            # strict cross-process ordering collectives require.
+            from ..models.datasets import DevicePrefetcher
 
-                def chunk_source():
-                    for _ in range(chunks_total):
-                        yield next_chunk()
-
-                chunk_prefetcher = DevicePrefetcher(
-                    chunk_source(), engine.shard_batches, depth=args.prefetch
+            if unroll == 1:
+                prefetcher = DevicePrefetcher(
+                    train_iter, ts.engine.shard_batch, depth=args.prefetch
                 )
+            elif not args.trace:
+                # FINITE producer: exactly the chunks the loop will consume
+                # ((max_step-start_step) // unroll — the loop's unrolled-branch
+                # count is deterministic).  An infinite producer would over-draw
+                # from the shared train_iter and the tail handoff would discard
+                # a thread-timing-dependent number of draws, skipping the tail's
+                # sample stream ahead nondeterministically.  By the time the
+                # per-step tail starts, all chunks were consumed, so the
+                # producer has exhausted its iterator and exited — the tail's
+                # direct train_iter use cannot race the daemon.  (--trace runs
+                # interleave per-step and unrolled dispatches, breaking the
+                # chunk count: they keep the synchronous path.)
+                chunks_total = max(0, (max_step - start_step)) // unroll
+                if chunks_total > 0:
+
+                    def chunk_source():
+                        for _ in range(chunks_total):
+                            yield next_chunk()
+
+                    chunk_prefetcher = DevicePrefetcher(
+                        chunk_source(), ts.engine.shard_batches, depth=args.prefetch
+                    )
+
+    reset_input(offstep)
 
     stop = {"requested": False}
 
@@ -723,13 +863,13 @@ def main(argv=None):
         return {name: float(total) / max(float(count), 1.0) for name, (total, count) in sums.items()}
 
     dense_metrics_fn = None
-    if eval_fn is None and nb_processes == 1 and hasattr(experiment, "sharded_to_dense_params"):
+    if ts.eval_fn is None and nb_processes == 1 and hasattr(experiment, "sharded_to_dense_params"):
         # Jitted once; the dense replica's params live on device between
         # eval batches instead of re-uploading per batch.
         dense_metrics_fn = jax.jit(experiment.metrics)
 
     def run_eval(step):
-        if eval_fn is None:
+        if ts.eval_fn is None:
             # Sharded engine: the sharded loss is always reported; when the
             # experiment can collapse its stage-stacked params to the dense
             # layout (and this is a single process that can see every
@@ -743,7 +883,7 @@ def main(argv=None):
                 )
             for batch in experiment.make_eval_iterator(n):
                 values.append(
-                    float(jax.device_get(eval_loss_fn(state, engine.shard_batch(batch))))
+                    float(jax.device_get(ts.eval_loss_fn(state, ts.engine.shard_batch(batch))))
                 )
                 if dense_params is not None:
                     flat = jax.tree_util.tree_map(
@@ -759,7 +899,7 @@ def main(argv=None):
             sums = None
             for batch in experiment.make_eval_iterator(n):
                 sums = fold_metric_sums(
-                    sums, jax.device_get(eval_fn(state, engine.shard_batch(batch)))
+                    sums, jax.device_get(ts.eval_fn(state, ts.engine.shard_batch(batch)))
                 )
             metrics = normalize_metric_sums(sums)
         if chaos is not None:
@@ -784,8 +924,12 @@ def main(argv=None):
         # and defeat async dispatch; checking the previous step's (by now
         # materialized) loss keeps one step in flight with the same abort
         # guarantee one step later (the reference checks synchronously only
-        # because sess.run already blocked, runner.py:570-574).
+        # because sess.run already blocked, runner.py:570-574).  The guardian
+        # watchdog rides the same lag: ``pending_metrics`` keeps the whole
+        # previous dispatch so the probe can be observed per sub-step.
         pending_loss = None
+        pending_metrics = None
+        pending_start = 0
 
         def summary_scalars(step, metrics):
             """The summary event payload — shared by the cadence fires and
@@ -794,7 +938,7 @@ def main(argv=None):
             scalars = {
                 "total_loss": float(jax.device_get(metrics["total_loss"])),
                 "grad_norm": float(jax.device_get(metrics["grad_norm"])),
-                "learning_rate": float(schedule(step)),
+                "learning_rate": float(ts.schedule(step)),
                 "steps_per_s": perf.steps_per_s_excl_first(),
             }
             if "worker_sq_dist" in metrics:
@@ -831,10 +975,136 @@ def main(argv=None):
             # ``pending_loss`` is the full per-step loss vector when unrolled,
             # so a mid-chunk divergence is caught at the next chunk boundary
             # rather than up to 2K-1 steps late via the last element only.
+            if pending_loss is None:
+                return
             values = np.asarray(jax.device_get(pending_loss))
             if not np.all(np.isfinite(values)):
+                if watchdog is not None:
+                    return  # the guardian owns divergence: rollback, not abort
                 diverged = True
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
+
+        def probe_clean(dispatch_metrics):
+            """Is the state this dispatch produced healthy by the probe?
+            Gates the last-known-good pin at checkpoint time."""
+            view = health.host_view(dispatch_metrics)
+            if view is None:
+                return True
+            return bool(
+                np.all(view["loss_finite"])
+                and np.all(np.isfinite(view["update_norm"]))
+                and np.all(np.asarray(view["spike"]) <= guardian.spike_factor)
+            )
+
+        def do_rollback(at_step):
+            """Rollback-and-escalate: restore last-known-good, perturb the
+            RNG, climb one ladder rung, discard the abandoned timeline."""
+            nonlocal state, step, ts, overrides, chaos_regime_seen
+            nonlocal pending_loss, pending_metrics, diverged
+            reason = watchdog.last_reason or "divergence"
+            if watchdog.exhausted:
+                diverged = True
+                raise UserException(
+                    "guardian: run failed — %s after %d recovery attempt(s) "
+                    "(ladder %s)" % (reason, watchdog.attempts,
+                                     guardian.ladder.describe())
+                )
+            checkpoints.wait()  # writer queue flushed before reading targets
+            target = checkpoints.pinned_step()
+            rstep = target if target is not None else 0
+            attempt = watchdog.note_rollback(rstep)
+            warning(
+                "guardian: %s — rolling back from step %d to %s (attempt %d/%d)"
+                % (reason, at_step,
+                   "step %d" % rstep if target is not None else "a fresh state",
+                   attempt + 1, guardian.retries)
+            )
+            summaries.event(at_step, "guardian_rollback", {
+                "reason": reason, "from_step": int(at_step), "to_step": int(rstep),
+                "attempt": attempt, "restored_snapshot": target is not None,
+            })
+            rung = guardian.ladder.rung(attempt)
+            if rung is not None:
+                try:
+                    new_overrides = rung.apply(overrides)
+                    with Context("escalate"):
+                        new_ts = build_training(new_overrides)
+                    overrides, ts = new_overrides, new_ts
+                    info("guardian: escalated — %s (now %s)"
+                         % (rung.describe(), overrides.describe()))
+                    summaries.event(rstep, "guardian_escalation", {
+                        "rung": rung.describe(), "attempt": attempt,
+                        "overrides": overrides.describe(),
+                    })
+                except UserException as exc:
+                    warning(
+                        "guardian: escalation rung %r rejected (%s); retrying "
+                        "with the current configuration" % (rung.describe(), exc)
+                    )
+            # RNG perturbation breaks deterministic re-divergence: the same
+            # snapshot + the same streams would replay the exact trajectory
+            # that just failed.  Restored runs fold the attempt into the
+            # restored key; from-scratch retries move the seed.
+            fresh = ts.make_fresh_state(
+                args.seed if target is not None
+                else args.seed + RESEED_STRIDE * (attempt + 1)
+            )
+            if target is not None:
+                carry, momentum = fresh.carry, fresh.momentum
+                template = jax.device_get(fresh.replace(carry=None, momentum=None))
+                restored, rstep = checkpoints.restore(template, step=target)
+                restored = restored.replace(rng=jax.device_get(
+                    jax.random.fold_in(jnp.asarray(restored.rng), RNG_PERTURB_TAG + attempt)
+                ))
+                state = ts.engine.put_state(
+                    restored.replace(carry=carry, momentum=momentum)
+                )
+            else:
+                state = fresh
+            step = rstep
+            pending_loss = pending_metrics = None
+            # the abandoned timeline: snapshots and eval rows beyond the
+            # restore point would otherwise poison a later auto-restore /
+            # interleave with the retry's rows
+            checkpoints.discard_after(rstep)
+            eval_file.truncate_after(rstep)
+            for trigger in (eval_trigger, ckpt_trigger, summary_trigger):
+                if trigger.last_step is not None and trigger.last_step > rstep:
+                    trigger.last_step = rstep
+            reset_input(rstep, reseed=attempt + 1)
+            if chaos is not None:
+                chaos_regime_seen = chaos.regime_at(step)
+
+        def observe_pending():
+            """Feed the watchdog the previous dispatch's probe, one
+            observation per completed step.  Returns True when a rollback
+            happened — the caller discards its in-flight results."""
+            nonlocal pending_loss, pending_metrics
+            if watchdog is None or pending_metrics is None:
+                return False
+            view = health.host_view(pending_metrics)
+            losses = np.atleast_1d(np.asarray(jax.device_get(pending_loss)))
+            start = pending_start
+            pending_loss = pending_metrics = None
+            if view is None:  # engine built without the probe
+                return False
+            finite = np.atleast_1d(view["loss_finite"]).astype(bool)
+            spikes = np.atleast_1d(view["spike"]).astype(np.float64)
+            for i in range(losses.shape[0]):
+                action = watchdog.observe(
+                    start + i + 1, float(losses[i]), bool(finite[i]), float(spikes[i])
+                )
+                if action == "recovered":
+                    info("guardian: recovered — %d healthy step(s) since the "
+                         "last rollback" % guardian.recover_after)
+                    summaries.event(start + i + 1, "guardian_recovered", {
+                        "attempt": watchdog.attempts - 1,
+                        "overrides": overrides.describe(),
+                    })
+                elif action == "rollback":
+                    do_rollback(start + i + 1)
+                    return True
+            return False
 
         tail_warned = False
         # Chaos regime transition logging: host-side tracking of the regime
@@ -845,33 +1115,44 @@ def main(argv=None):
             chaos_regime_seen = chaos.regime_at(step)
             info("Chaos regime at step %d: %s" % (step, chaos.describe(chaos_regime_seen)))
         try:
-            while step < max_step and not stop["requested"]:
+            while True:
+                if step >= max_step or stop["requested"]:
+                    # Exit drains the lagged observation first: a guardian
+                    # rollback here re-enters training from the restored
+                    # step instead of returning with a poisoned tail.
+                    if observe_pending() and step < max_step and not stop["requested"]:
+                        continue
+                    check_divergence()
+                    break
                 if args.trace and step == offstep + 2:  # skip compile + warmup step
                     import jax.profiler
 
                     trace_ctx = jax.profiler.trace(args.trace_dir)
                     trace_ctx.__enter__()
                 chunk = 1
-                if multi_fn is not None and max_step - step >= unroll and trace_ctx is None:
+                if ts.multi_fn is not None and max_step - step >= unroll and trace_ctx is None:
                     # Unrolled dispatch: K distinct batches, one executable
                     # (device-sampled: the resident dataset IS the input and
                     # the trainer draws its own fresh per-step batches)
-                    if device_dataset is not None:
-                        device_chunk = device_dataset
+                    if ts.device_dataset is not None:
+                        device_chunk = ts.device_dataset
                     elif chunk_prefetcher is not None:
                         device_chunk = next(chunk_prefetcher)
                     else:
-                        device_chunk = engine.shard_batches(next_chunk())
+                        device_chunk = ts.engine.shard_batches(next_chunk())
                     perf.step_begin()
-                    state, many = multi_fn(state, device_chunk)
-                    if pending_loss is not None:
-                        check_divergence()
+                    state, many = ts.multi_fn(state, device_chunk)
+                    if observe_pending():
+                        continue  # previous chunk diverged: this one is abandoned
+                    check_divergence()
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], many)
                     perf.step_end(unroll)
                     chunk = unroll
                     pending_loss = many["total_loss"]  # full vector: see check_divergence
+                    pending_metrics = many
+                    pending_start = step
                 else:
-                    if (device_dataset is not None and not tail_warned
+                    if (ts.device_dataset is not None and not tail_warned
                             and not stop["requested"]):
                         # Tail steps (max_step % unroll) and --trace windows
                         # fall back to per-step HOST batches — say so once,
@@ -890,13 +1171,16 @@ def main(argv=None):
                         # numpy Generators are not thread-safe.
                         chunk_prefetcher.close()
                         chunk_prefetcher = None
-                    batch = next(prefetcher) if prefetcher is not None else engine.shard_batch(next(train_iter))
+                    batch = next(prefetcher) if prefetcher is not None else ts.engine.shard_batch(next(train_iter))
                     perf.step_begin()
-                    state, metrics = step_fn(state, batch)
-                    if pending_loss is not None:
-                        check_divergence()
+                    state, metrics = ts.step_fn(state, batch)
+                    if observe_pending():
+                        continue  # previous step diverged: this one is abandoned
+                    check_divergence()
                     perf.step_end()
                     pending_loss = metrics["total_loss"]
+                    pending_metrics = metrics
+                    pending_start = step
                 step += chunk
                 if chaos is not None:
                     regime_now = chaos.regime_at(step)
@@ -920,13 +1204,20 @@ def main(argv=None):
                     check_divergence()
                     checkpoints.wait()  # surface a previous write's failure
                     checkpoints.save(state, step)
+                    if watchdog is not None and watchdog.healthy and probe_clean(
+                        pending_metrics if pending_metrics is not None else metrics
+                    ):
+                        # last-known-good: this snapshot survives pruning and
+                        # is the rollback target (obs/checkpoint.py pin).
+                        # pending_metrics is the WHOLE last dispatch — under
+                        # --unroll every sub-step must read clean, not just
+                        # the chunk's final slice
+                        checkpoints.pin(step)
                     ckpt_trigger.fired(step)
                 if summary_trigger.should_fire(step):
                     check_divergence()
                     summaries.scalars(step, summary_scalars(step, metrics))
                     summary_trigger.fired(step)
-            if pending_loss is not None:
-                check_divergence()
         finally:
             for signum, handler in previous_handlers.items():
                 signal.signal(signum, handler)

@@ -48,6 +48,11 @@ class TrainState:
     #: (parallel/engine.py); a side buffer like carry/momentum — never
     #: serialized, re-warms from 1.0 after restore
     reputation: object = None
+    #: replicated scalar EMA of |loss| for the guardian health probe
+    #: (guardian/probe.py); never serialized — re-warms from the sentinel
+    #: after restore so a rollback never judges recovery against a
+    #: poisoned reference
+    loss_ema: object = None
 
     @classmethod
     def create(cls, params, tx, rng=None, carry=None, momentum=None):

@@ -1,0 +1,38 @@
+"""guardian/ — in-loop divergence watchdog and rollback-and-escalate recovery.
+
+The aggregation rules in ``gars/`` defend each STEP; the guardian defends
+the RUN.  Three layers (docs/guardian.md):
+
+1. **Health probe** (``probe.py``) — finite-loss flag, aggregated-update
+   norm, EMA loss-spike score and per-worker NaN-row flags, computed inside
+   the jitted step of both engines and returned with the step metrics at
+   zero extra compiles;
+2. **Watchdog + escalation** (``watchdog.py``, ``escalate.py``) — a
+   host-side policy that, on sustained divergence, has the runner restore
+   the last-known-good snapshot (``obs/checkpoint.py`` pin policy), perturb
+   the restored RNG, and climb a configurable escalation ladder (raise
+   ``f`` -> stronger GAR -> quarantine -> damp the lr) with bounded retries
+   and exponential backoff;
+3. **Preemption-safe resume** (``cli/runner.py``) — SIGTERM/SIGINT flushes
+   background checkpoint writes and exits restorably; restore is
+   bit-identical on step/params/opt-state/RNG (the input iterator
+   fast-forwards to the restored step).
+"""
+
+from .escalate import (  # noqa: F401
+    DEFAULT_LADDER,
+    RESEED_STRIDE,
+    RNG_PERTURB_TAG,
+    EscalationLadder,
+    Overrides,
+)
+from .probe import (  # noqa: F401
+    EMA_DECAY,
+    EMA_UNSET,
+    PROBE_KEY,
+    host_view,
+    probe_metrics,
+    spike_score,
+    update_loss_ema,
+)
+from .watchdog import GuardianConfig, Watchdog  # noqa: F401

@@ -1,0 +1,87 @@
+"""In-step health probe: the traced fields both engines attach to metrics.
+
+AggregaThor's GARs give *per-step* resilience only while the real Byzantine
+count stays within the declared ``f``; beyond the breakdown point training
+silently diverges (PAPER.md; the empirical boundary is measured by
+``chaos/campaign.py --breakdown``).  The guardian's first layer is a health
+probe computed INSIDE the jitted step — it rides the existing metrics
+dictionary, so collecting it costs zero extra dispatches and zero extra
+compiles (asserted by tests/test_guardian.py):
+
+- ``loss_finite``      int32 0/1 — is this step's total loss finite;
+- ``update_norm``      f32 — L2 norm of the aggregated update the optimizer
+  consumed (the same value as ``grad_norm``, re-exported under the probe
+  contract so watchdog consumers need only one key family);
+- ``spike``            f32 — ratio of this step's |loss| to the EMA of the
+  recent |loss| (``EMA_DECAY``); 1.0 while the EMA is still unset, ``inf``
+  when the loss is non-finite.  A sustained large ratio is the probe's
+  "diverging but not yet NaN" signal;
+- ``worker_nan_rows``  (n,) int32 0/1 — which workers' POST-TRANSPORT
+  submissions contained any non-finite coordinate this step (lossy NaN
+  infill, dropped stragglers, ``inf`` attacks) — distinguishes "the model
+  is sick" from "the network is eating rows".
+
+The EMA lives in ``TrainState.loss_ema`` (a replicated scalar side buffer,
+never serialized — it re-warms from :data:`EMA_UNSET` after any restore, so
+a rollback never compares post-recovery losses against a poisoned EMA).
+"""
+
+import jax.numpy as jnp
+
+#: metrics key under which both engines nest the probe fields
+PROBE_KEY = "probe"
+
+#: EMA decay of the |loss| reference the spike score divides by — smoothed
+#: enough to ride out batch noise, fresh enough that a real regression
+#: dominates it within ~10 steps
+EMA_DECAY = 0.9
+
+#: sentinel for "no EMA accumulated yet" (|loss| is never negative)
+EMA_UNSET = -1.0
+
+
+def update_loss_ema(prev_ema, loss):
+    """(traced) next EMA of |loss|: seeds from the first finite loss, holds
+    its last finite value through non-finite steps (a NaN loss must not
+    poison the reference the recovery will be judged against)."""
+    loss32 = jnp.abs(loss.astype(jnp.float32))
+    seeded = jnp.where(
+        prev_ema < 0.0, loss32, EMA_DECAY * prev_ema + (1.0 - EMA_DECAY) * loss32
+    )
+    return jnp.where(jnp.isfinite(loss32), seeded, prev_ema)
+
+
+def spike_score(loss, prev_ema):
+    """(traced) |loss| / EMA(|loss|) against the PREVIOUS step's EMA — the
+    score must compare against history the current step has not already
+    dragged upward.  1.0 while the EMA is unset; ``inf`` for non-finite
+    loss (so one threshold covers both divergence modes)."""
+    loss32 = jnp.abs(loss.astype(jnp.float32))
+    ref = jnp.maximum(prev_ema, jnp.float32(1e-8))
+    score = jnp.where(prev_ema < 0.0, jnp.float32(1.0), loss32 / ref)
+    return jnp.where(jnp.isfinite(loss32), score, jnp.float32(jnp.inf))
+
+
+def probe_metrics(total_loss, update_norm, spike, worker_nan_rows):
+    """The probe sub-dictionary both engines nest under ``PROBE_KEY``."""
+    return {
+        "loss_finite": jnp.isfinite(total_loss).astype(jnp.int32),
+        "update_norm": update_norm,
+        "spike": spike,
+        "worker_nan_rows": worker_nan_rows.astype(jnp.int32),
+    }
+
+
+def host_view(metrics):
+    """Host-side numpy view of one step dispatch's probe (or ``None`` when
+    the engine ran with ``health_probe=False``).  Under ``--unroll`` the
+    fields carry a leading K dim — exactly one entry per scanned step."""
+    import jax
+    import numpy as np
+
+    if PROBE_KEY not in metrics:
+        return None
+    return {
+        name: np.asarray(jax.device_get(value))
+        for name, value in metrics[PROBE_KEY].items()
+    }

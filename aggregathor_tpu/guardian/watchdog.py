@@ -1,0 +1,138 @@
+"""Host-side divergence watchdog: probe stream in, rollback decisions out.
+
+The watchdog is deliberately PURE POLICY — it never touches engines, state
+or disk.  The runner feeds it one observation per completed training step
+(from the in-step health probe, ``guardian/probe.py``, with the same
+one-step lag the NaN-abort check already uses) and acts on the returned
+decision:
+
+- ``"rollback"``   sustained divergence: restore the last-known-good
+  snapshot, perturb the RNG, climb one escalation rung (``escalate.py``);
+- ``"recovered"``  the run stayed healthy for ``recover`` steps after a
+  rollback: the regression is over, log it and re-arm;
+- ``None``         keep training.
+
+Divergence has two modes with different urgencies: a NON-FINITE loss means
+the parameters are already poisoned (every later step is garbage), so it
+triggers immediately and ignores the cooldown; a finite loss SPIKE
+(``spike`` x the EMA reference, probe.py) must persist for ``patience``
+consecutive steps, and after a rollback the spike trigger backs off
+exponentially (``patience * backoff^attempt`` steps) so each escalated
+configuration gets a growing grace window to prove itself while replaying
+the regime that broke its predecessor.  ``retries`` bounds the total
+rollback count; past it the runner declares the run failed.
+"""
+
+import math
+
+from ..utils import parse_keyval
+from .escalate import DEFAULT_LADDER, EscalationLadder
+
+
+class GuardianConfig:
+    """Parsed ``--guardian-args`` (key:value strings, like every registry).
+
+    Keys: ``patience`` (consecutive spiked steps before rollback, default 3),
+    ``spike`` (loss/EMA ratio counted as a spike, default 25), ``retries``
+    (max rollbacks before the run is declared failed, default 5), ``backoff``
+    (cooldown growth base, default 2), ``recover`` (healthy steps after a
+    rollback before declaring recovery, default 10), ``ladder`` (escalation
+    rungs, comma-separated — see ``escalate.py`` for the grammar)."""
+
+    DEFAULTS = {
+        "patience": 3,
+        "spike": 25.0,
+        "retries": 5,
+        "backoff": 2.0,
+        "recover": 10,
+        "ladder": DEFAULT_LADDER,
+    }
+
+    def __init__(self, args=None):
+        from ..utils import UserException
+
+        kv = parse_keyval(args or [], dict(self.DEFAULTS), strict=True)
+        self.patience = int(kv["patience"])
+        self.spike_factor = float(kv["spike"])
+        self.retries = int(kv["retries"])
+        self.backoff = float(kv["backoff"])
+        self.recover_after = int(kv["recover"])
+        if self.patience < 1:
+            raise UserException("guardian patience must be >= 1 (got %d)" % self.patience)
+        if self.spike_factor <= 1.0:
+            raise UserException(
+                "guardian spike must exceed 1 (a ratio of 1 is a flat loss), got %g"
+                % self.spike_factor
+            )
+        if self.retries < 1:
+            raise UserException("guardian retries must be >= 1 (got %d)" % self.retries)
+        if self.backoff < 1.0:
+            raise UserException("guardian backoff must be >= 1 (got %g)" % self.backoff)
+        if self.recover_after < 1:
+            raise UserException("guardian recover must be >= 1 (got %d)" % self.recover_after)
+        self.ladder = EscalationLadder(kv["ladder"])
+
+
+class Watchdog:
+    """Consumes per-step probe readings, emits rollback/recovered decisions."""
+
+    def __init__(self, config):
+        self.config = config
+        self.attempts = 0          # rollbacks performed so far
+        self.unhealthy_streak = 0  # consecutive spiked/non-finite steps
+        self.healthy_streak = 0    # consecutive clean steps
+        self.recovering = False    # between a rollback and its recovery call
+        self.cooldown_until = -1   # spike triggers suppressed below this step
+        self.last_reason = None    # human-readable cause of the last rollback
+
+    @property
+    def healthy(self):
+        """True when the last observed step was clean — the runner pins a
+        snapshot as last-known-good only when this holds at save time."""
+        return self.unhealthy_streak == 0
+
+    @property
+    def exhausted(self):
+        return self.attempts >= self.config.retries
+
+    def observe(self, step, loss, finite, spike):
+        """One completed step's probe scalars.  Returns ``"rollback"``,
+        ``"recovered"``, or ``None``."""
+        finite = bool(finite)
+        unhealthy = (not finite) or (spike > self.config.spike_factor)
+        if not unhealthy:
+            self.healthy_streak += 1
+            self.unhealthy_streak = 0
+            if self.recovering and self.healthy_streak >= self.config.recover_after:
+                self.recovering = False
+                return "recovered"
+            return None
+        self.unhealthy_streak += 1
+        self.healthy_streak = 0
+        if not finite:
+            # params are poisoned: no cooldown, no patience
+            self.last_reason = "non-finite loss at step %d" % step
+            return "rollback"
+        if step >= self.cooldown_until and self.unhealthy_streak >= self.config.patience:
+            self.last_reason = (
+                "loss spike x%.1f sustained %d steps (threshold x%.1f, patience %d)"
+                % (spike, self.unhealthy_streak, self.config.spike_factor,
+                   self.config.patience)
+            )
+            return "rollback"
+        return None
+
+    def note_rollback(self, restore_step):
+        """Record that the runner executed a rollback landing at
+        ``restore_step``; returns the 0-based attempt index (= the
+        escalation rung to climb).  The spike cooldown grows exponentially
+        with the attempt count — each escalated configuration gets a longer
+        window to replay the hostile regime before being judged."""
+        attempt = self.attempts
+        self.attempts += 1
+        self.unhealthy_streak = 0
+        self.healthy_streak = 0
+        self.recovering = True
+        grace = math.ceil(self.config.patience * self.config.backoff ** self.attempts)
+        self.cooldown_until = restore_step + grace
+        return attempt

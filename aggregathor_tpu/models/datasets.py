@@ -349,6 +349,23 @@ class WorkerBatchIterator:
             bx, by = self.transform(bx, by)
         return {"image": bx, "label": by}
 
+    def skip(self, k):
+        """Advance every worker's sample stream by ``k`` batches without
+        gathering data — the resume fast-forward (cli/runner.py): after
+        restoring step S, the stream must sit exactly where an
+        uninterrupted run's would, so the resumed trajectory is
+        bit-identical.  Stateful host transforms (preprocessing.py per-worker
+        augmentation streams) must advance in lockstep, so with a transform
+        the full draw path is kept."""
+        k = int(k)
+        if self.transform is not None:
+            for _ in range(k):
+                next(self)
+            return
+        for _ in range(k):
+            for rng in self.rngs:
+                rng.integers(0, self.x.shape[0], size=self.batch_size)
+
     def next_many(self, k):
         """K batches in one call: a (k, nb_workers, batch, ...) stack.
 

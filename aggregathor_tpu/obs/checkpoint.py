@@ -5,6 +5,12 @@ files ``<base>-<step>.ckpt`` in a directory, discovery by scanning and
 sorting by step, ``can_restore`` / ``restore`` (latest or a given step) /
 ``save``, auto-restore of the latest at training start (runner.py:514-525).
 
+A last-known-good **pin** (``pin``/``pinned_step``) marks one step as exempt
+from ``max_to_keep`` pruning: the guardian (cli/runner.py) pins the newest
+snapshot saved while the run was healthy, so rollback always has a clean
+restore target even after the cadence wrote ``max_to_keep`` poisoned
+snapshots past it.
+
 Snapshots are the full TrainState pytree (params, optimizer state, step, rng)
 serialized with ``flax.serialization`` (msgpack); restore deserializes into a
 freshly-initialized template state, so shape/dtype mismatches fail loudly.
@@ -57,6 +63,13 @@ class Checkpoints:
         # downgrade path entirely.
         self.allow_legacy_tags = bool(allow_legacy_tags)
         self._pattern = re.compile(re.escape(base_name) + r"-(\d+)\.ckpt$")
+        # Last-known-good pin (guardian rollback): the pinned step is
+        # excluded from max_to_keep pruning, so the snapshot the watchdog
+        # would roll back to survives however many unhealthy snapshots the
+        # cadence writes after it.  Read by the single writer thread and
+        # written by the caller thread — a plain attribute is safe (atomic
+        # reference assignment; staleness only delays one prune).
+        self._pinned = None
         self._pool = None
         self._pending = []
         if background:
@@ -86,6 +99,34 @@ class Checkpoints:
     def can_restore(self, step=None):
         steps = self.steps()
         return bool(steps) if step is None else step in steps
+
+    def pin(self, step):
+        """Pin ``step`` as last-known-good: its snapshot survives
+        ``max_to_keep`` pruning until a newer pin replaces it.  Pinning a
+        new step releases the previous pin (the old snapshot becomes
+        ordinary and prunable again)."""
+        self._pinned = None if step is None else int(step)
+
+    def pinned_step(self):
+        """The pinned step if its snapshot is on disk, else None."""
+        pinned = self._pinned
+        return pinned if pinned is not None and self.can_restore(pinned) else None
+
+    def discard_after(self, step):
+        """Remove every snapshot with step > ``step`` — the abandoned
+        timeline after a guardian rollback.  Without this, a later
+        auto-restore (this run killed, then relaunched) would resurrect the
+        newest — poisoned — snapshot instead of the rolled-back-to one.
+        Call ``wait()`` first when background writes may be pending.
+        Returns the discarded steps."""
+        dropped = [s for s in self.steps() if s > step]
+        for old in dropped:
+            for path in (self._path(old), self._path(old) + ".tag"):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        return dropped
 
     def restore(self, template_state, step=None):
         """Restore into ``template_state``'s structure; latest step if None."""
@@ -235,6 +276,8 @@ class Checkpoints:
         os.replace(tmp, path)
         if self.max_to_keep > 0:
             for old in self.steps()[: -self.max_to_keep]:
+                if old == self._pinned:
+                    continue  # last-known-good survives pruning (see pin)
                 os.remove(self._path(old))
                 tag_path = self._path(old) + ".tag"
                 if os.path.exists(tag_path):

@@ -163,7 +163,8 @@ class RobustEngine:
     def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
-                 granularity="vector", leaf_bucketing="auto", trace_ops=False, chaos=None):
+                 granularity="vector", leaf_bucketing="auto", trace_ops=False, chaos=None,
+                 health_probe=True):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -194,6 +195,12 @@ class RobustEngine:
         # participation metrics); off by default — the extra O(n·d) pass is
         # a measurable HBM tax at scale.
         self.worker_metrics = bool(worker_metrics)
+        # In-step health probe (guardian/probe.py): finite-loss flag, update
+        # norm, EMA loss-spike score, per-worker NaN-row flags, nested under
+        # metrics["probe"].  On by default — it reuses values the step
+        # already computes plus one O(k·d) isfinite pass and an O(n) gather,
+        # and adds no dispatches or compiles (tests/test_guardian.py).
+        self.health_probe = bool(health_probe)
         # Reputation-gated quarantine: an EMA of a per-step rank signal
         # (1 if the worker's RAW gradient is among the n-f closest to the
         # applied aggregate, else 0); workers whose reputation falls below
@@ -590,6 +597,7 @@ class RobustEngine:
             momentum=P(worker_axis) if self.worker_momentum is not None else None,
             momentum_steps=P() if self.worker_momentum is not None else None,
             reputation=P() if self.reputation_decay is not None else None,
+            loss_ema=P() if self.health_probe else None,
         )
 
     def _make_body(self, loss_fn, tx):
@@ -691,15 +699,39 @@ class RobustEngine:
             mark("apply done: |p0| {p}",
                  p=jnp.linalg.norm(jax.tree_util.tree_leaves(params)[0]))
             total_loss = jax.lax.psum(jnp.sum(losses), worker_axis) if W > 1 else jnp.sum(losses)
+            update_norm = jnp.linalg.norm(agg)
+            new_loss_ema = state.loss_ema
+            probe_fields = None
+            if self.health_probe:
+                from ..guardian import probe as health
+
+                # Per-worker NaN-row flags measure the POST-TRANSPORT
+                # submissions (what the aggregation actually received:
+                # lossy NaN infill, dropped stragglers, inf attacks) —
+                # distinct from loss_finite, which measures model health.
+                local_bad = jnp.any(~jnp.isfinite(gvecs), axis=1)  # (k,)
+                if W > 1:
+                    worker_nan = jax.lax.all_gather(local_bad, worker_axis).reshape(
+                        self.nb_workers
+                    )
+                else:
+                    worker_nan = local_bad
+                probe_fields = health.probe_metrics(
+                    total_loss, update_norm,
+                    health.spike_score(total_loss, state.loss_ema), worker_nan,
+                )
+                new_loss_ema = health.update_loss_ema(state.loss_ema, total_loss)
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state,
                 carry=new_carry, momentum=new_momentum, momentum_steps=new_momentum_steps,
-                reputation=new_reputation,
+                reputation=new_reputation, loss_ema=new_loss_ema,
             )
             metrics = {
                 "total_loss": total_loss,
-                "grad_norm": jnp.linalg.norm(agg),
+                "grad_norm": update_norm,
             }
+            if probe_fields is not None:
+                metrics[health.PROBE_KEY] = probe_fields
             if ridx is not None:
                 # replicated scalar (a pure function of the replicated step)
                 # — the observability layer's regime column
@@ -943,5 +975,11 @@ class RobustEngine:
             # everyone starts trusted; quarantine only after evidence accrues
             state = state.replace(
                 reputation=self.replicate(jnp.ones((self.nb_workers,), jnp.float32))
+            )
+        if self.health_probe:
+            from ..guardian.probe import EMA_UNSET
+
+            state = state.replace(
+                loss_ema=self.replicate(jnp.float32(EMA_UNSET))
             )
         return state

@@ -80,7 +80,8 @@ class ShardedRobustEngine:
     def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None, granularity="layer",
                  exchange_dtype=None, worker_momentum=None, worker_metrics=False,
                  reputation_decay=None, quarantine_threshold=0.0,
-                 l1_regularize=None, l2_regularize=None, chaos=None):
+                 l1_regularize=None, l2_regularize=None, chaos=None,
+                 health_probe=True):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
@@ -120,6 +121,9 @@ class ShardedRobustEngine:
         # worker_metrics: whole-model squared distance to the aggregate and
         # the mean per-bucket participation (see parallel/engine.py).
         self.worker_metrics = bool(worker_metrics)
+        # In-step health probe (guardian/probe.py), the flat engine's
+        # semantics: nested under metrics["probe"], zero extra compiles.
+        self.health_probe = bool(health_probe)
         # Reputation EMA + quarantine, the flat engine's semantics
         # (parallel/engine.py): rank signal on the post-attack raw rows'
         # whole-model distance to the aggregate; up to f below-threshold
@@ -221,7 +225,7 @@ class ShardedRobustEngine:
                 out_shardings=m_shardings,
             )()
 
-        momentum = momentum_steps = carry = reputation = None
+        momentum = momentum_steps = carry = reputation = loss_ema = None
         if self.worker_momentum is not None:
             momentum = per_worker_zeros()
             momentum_steps = jax.device_put(jnp.zeros((), jnp.int32), rep)
@@ -229,6 +233,10 @@ class ShardedRobustEngine:
             carry = per_worker_zeros()
         if self.reputation_decay is not None:
             reputation = jax.device_put(jnp.ones((self.nb_workers,), jnp.float32), rep)
+        if self.health_probe:
+            from ..guardian.probe import EMA_UNSET
+
+            loss_ema = jax.device_put(jnp.float32(EMA_UNSET), rep)
         state = TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
@@ -238,6 +246,7 @@ class ShardedRobustEngine:
             momentum=momentum,
             momentum_steps=momentum_steps,
             reputation=reputation,
+            loss_ema=loss_ema,
         )
         # Remember the layout for put_state (checkpoint restore re-sharding).
         self._state_shardings = jax.tree.map(lambda a: a.sharding, state)
@@ -562,15 +571,38 @@ class ShardedRobustEngine:
                 beta = self.reputation_decay
                 new_reputation = beta * state.reputation + (1.0 - beta) * signal
 
+            # loss is a local partial: sum the worker group, then workers
+            total_loss = jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,))
+            new_loss_ema = state.loss_ema
+            probe_fields = None
+            if self.health_probe:
+                from ..guardian import probe as health
+
+                # Per-worker NaN-row flags over the POST-TRANSPORT shards:
+                # count this worker's non-finite coordinates locally,
+                # complete over the worker group, flag, gather workers.
+                bad = jnp.int32(0)
+                for g in g_leaves:
+                    bad = bad + jnp.sum((~jnp.isfinite(g)).astype(jnp.int32))
+                bad = jax.lax.psum(bad, _IN_GROUP_AXES)
+                worker_nan = jax.lax.all_gather(bad > 0, worker_axis).reshape(
+                    self.nb_workers
+                )
+                probe_fields = health.probe_metrics(
+                    total_loss, grad_norm,
+                    health.spike_score(total_loss, state.loss_ema), worker_nan,
+                )
+                new_loss_ema = health.update_loss_ema(state.loss_ema, total_loss)
             new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state,
                                       carry=new_carry, momentum=new_momentum,
                                       momentum_steps=new_momentum_steps,
-                                      reputation=new_reputation)
+                                      reputation=new_reputation, loss_ema=new_loss_ema)
             metrics = {
-                # loss is a local partial: sum the worker group, then workers
-                "total_loss": jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)),
+                "total_loss": total_loss,
                 "grad_norm": grad_norm,
             }
+            if probe_fields is not None:
+                metrics[health.PROBE_KEY] = probe_fields
             if ridx is not None:
                 metrics["chaos_regime"] = ridx  # replicated function of step
             if self.worker_metrics:

@@ -44,3 +44,41 @@ print("resilience matrix OK: %d cells + %d breakdown probes, schema %s"
 EOF
 
 echo "report: $out/report.md"
+
+# ---- guardian smoke (<60 s): injected breakdown regime -> rollback ->
+# recovery, asserted from the tagged summary events (docs/guardian.md).
+# The inf coalition provably breaks plain average (breakdown point 0);
+# the ladder escalates to median, which excludes the inf rows.
+rm -rf "$out/guardian"
+mkdir -p "$out/guardian"
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment mnist --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 8 --nb-decl-byz-workers 2 \
+  --nb-real-byz-workers 2 --chaos "0:calm 8:attack=inf" \
+  --guardian --guardian-args ladder:gar=median recover:5 \
+  --max-step 30 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/guardian/ckpt" --checkpoint-delta 4 --checkpoint-period -1 \
+  --summary-dir "$out/guardian/sum" --summary-delta 5
+
+python - "$out/guardian/sum" <<'EOF'
+import json, math, os, sys
+
+sum_dir = sys.argv[1]
+events = [json.loads(line)
+          for name in os.listdir(sum_dir)
+          for line in open(os.path.join(sum_dir, name))]
+rollbacks = [e for e in events if e.get("event") == "guardian_rollback"]
+escalations = [e for e in events if e.get("event") == "guardian_escalation"]
+recoveries = [e for e in events if e.get("event") == "guardian_recovered"]
+assert rollbacks, "no guardian_rollback event"
+assert escalations, "no guardian_escalation event"
+assert recoveries, "no guardian_recovered event"
+scalars = [e for e in events if "total_loss" in e]
+final = scalars[-1]["total_loss"]
+assert final is not None and math.isfinite(final), final
+first = scalars[0]["total_loss"]
+assert final < first, (first, final)  # recovered AND still learning
+print("guardian smoke OK: %d rollback(s), escalated via %r, final loss %.3f < first %.3f"
+      % (len(rollbacks), escalations[0]["rung"], final, first))
+EOF

@@ -27,6 +27,54 @@ def _free_port():
         return sock.getsockname()[1]
 
 
+_MULTIPROC_CPU = None
+
+
+def _multiprocess_cpu_supported():
+    """Capability probe, cached per session: can THIS jaxlib run a 2-process
+    CPU collective?  Some builds refuse with "Multiprocess computations
+    aren't implemented on the CPU backend" — a property of the wheel, not of
+    the code under test, so the deploy tests skip instead of failing red.
+    The probe forks two tiny processes that broadcast one int32; on a
+    refusing build it fails in a few seconds."""
+    global _MULTIPROC_CPU
+    if _MULTIPROC_CPU is None:
+        port = _free_port()
+        script = (
+            "import sys, jax, numpy as np;"
+            "jax.distributed.initialize('127.0.0.1:%d', 2, int(sys.argv[1]));"
+            "from jax.experimental import multihost_utils;"
+            "multihost_utils.broadcast_one_to_all(np.int32(1))" % port
+        )
+        procs = []
+        for rank in (0, 1):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script, str(rank)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+            ))
+        try:
+            for proc in procs:
+                proc.communicate(timeout=120)
+            _MULTIPROC_CPU = all(proc.returncode == 0 for proc in procs)
+        except subprocess.TimeoutExpired:
+            for proc in procs:
+                proc.kill()
+            _MULTIPROC_CPU = False
+    return _MULTIPROC_CPU
+
+
+def _require_multiprocess_cpu():
+    if not _multiprocess_cpu_supported():
+        pytest.skip(
+            "this jaxlib refuses multiprocess CPU collectives "
+            "(known-environmental; the deploy path needs a build with "
+            "cross-process CPU support)"
+        )
+
+
 def test_runner_end_to_end(tmp_path):
     eval_file = str(tmp_path / "eval.tsv")
     ckpt_dir = str(tmp_path / "ckpt")
@@ -99,6 +147,7 @@ def test_deploy_local_simulate(tmp_path):
     cluster connected via jax.distributed (reference single-machine story,
     deploy.py:190-309 / README.md:141-146), runs mnist+krum over the spanning
     mesh, and only process 0 writes the eval file."""
+    _require_multiprocess_cpu()
     port = _free_port()
     eval_file = tmp_path / "eval.tsv"
     proc = subprocess.run(
@@ -332,6 +381,7 @@ def test_deploy_session_secret_mismatch_rejected():
     handshake (no training step runs with an unauthenticated host) —
     VERDICT r2 next-step 7; reference parity: signed worker->PS pushes
     (mpi_rendezvous_mgr.patch:585-627)."""
+    _require_multiprocess_cpu()
     port = _free_port()
     common = [
         "--experiment", "mnist", "--experiment-args", "batch-size:8",
@@ -365,6 +415,7 @@ def test_deploy_multidevice_restore_mid_run(tmp_path):
     RESTORES mid-campaign (process 0's latest-step choice broadcast, the
     post-restore encrypted digest handshake agreeing across processes) and
     continues to step 12.  Only process 0 writes artifacts."""
+    _require_multiprocess_cpu()
     port = _free_port()
     ckpt_dir = str(tmp_path / "ckpt")
     eval_file = tmp_path / "eval.tsv"
@@ -399,6 +450,7 @@ def test_deploy_cluster_spec_two_process():
     """--cluster resolves the bring-up triple from a spec (the reference's
     tools/cluster.py input forms): a 2-process localhost cluster trains to
     completion with ranks from $AGGREGATHOR_PROCESS_ID."""
+    _require_multiprocess_cpu()
     port = _free_port()
     spec = '["127.0.0.1:%d", "127.0.0.1"]' % port
     common = [

@@ -1,0 +1,417 @@
+"""Guardian tests: in-step health probe, watchdog/ladder policy, checkpoint
+pin policy, eval-TSV truncation, rollback-and-escalate recovery end-to-end,
+and preemption-safe (bit-identical) kill-and-resume."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars, models
+from aggregathor_tpu.chaos import ChaosSchedule
+from aggregathor_tpu.cli import runner
+from aggregathor_tpu.core import build_optimizer, build_schedule
+from aggregathor_tpu.guardian import (
+    DEFAULT_LADDER,
+    EscalationLadder,
+    GuardianConfig,
+    Overrides,
+    Watchdog,
+)
+from aggregathor_tpu.obs import Checkpoints, EvalFile
+from aggregathor_tpu.parallel import RobustEngine, make_mesh
+from aggregathor_tpu.utils import UserException
+
+
+def make_setup(gar_name="average", n=8, f=0, nb_devices=8, chaos=None, nb_real_byz=0,
+               health_probe=True):
+    exp = models.instantiate("mnist", ["batch-size:16"])
+    gar = gars.instantiate(gar_name, n, f)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=nb_devices), gar, nb_workers=n,
+                          nb_real_byz=nb_real_byz, chaos=chaos, health_probe=health_probe)
+    step = engine.build_step(exp.loss, tx)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    return exp, engine, step, state
+
+
+# --------------------------------------------------------------------- #
+# in-step health probe
+
+
+def test_probe_fields_and_zero_extra_compiles():
+    """Acceptance: the probe rides the step metrics — fields present and
+    sane on a healthy run, the EMA warms over steps, and collecting it
+    leaves the jitted step's compile count EXACTLY where the probe-free
+    engine's is (one trace, no retrace across steps)."""
+    cache_sizes = {}
+    for probe_on in (True, False):
+        exp, engine, step, state = make_setup(health_probe=probe_on)
+        it = exp.make_train_iterator(8, seed=3)
+        for i in range(4):
+            state, metrics = step(state, engine.shard_batch(next(it)))
+            if probe_on:
+                probe = metrics["probe"]
+                assert int(probe["loss_finite"]) == 1
+                assert np.isfinite(float(probe["update_norm"]))
+                assert float(probe["update_norm"]) == pytest.approx(
+                    float(metrics["grad_norm"])
+                )
+                spike = float(probe["spike"])
+                if i == 0:
+                    assert spike == 1.0  # EMA unset on the first step
+                else:
+                    assert 0.1 < spike < 10.0
+                assert np.asarray(probe["worker_nan_rows"]).shape == (8,)
+                assert not np.any(np.asarray(probe["worker_nan_rows"]))
+            else:
+                assert "probe" not in metrics
+        cache_sizes[probe_on] = step._cache_size()
+    assert cache_sizes[True] == cache_sizes[False] == 1, cache_sizes
+
+
+def test_probe_flags_nan_submissions_and_nonfinite_loss():
+    """worker_nan_rows marks exactly the workers whose POST-TRANSPORT rows
+    went non-finite (full-rate loss storm -> every row), and once an inf
+    attack poisons the params, loss_finite drops to 0 and spike reads inf."""
+    chaos = ChaosSchedule("0:drop=1.0", 8)
+    exp, engine, step, state = make_setup("average-nan", chaos=chaos)
+    it = exp.make_train_iterator(8, seed=3)
+    state, metrics = step(state, engine.shard_batch(next(it)))
+    assert np.all(np.asarray(metrics["probe"]["worker_nan_rows"]) == 1)
+    assert int(metrics["probe"]["loss_finite"]) == 1  # the MODEL is healthy
+
+    chaos = ChaosSchedule("0:attack=inf", 8, nb_real_byz=2)
+    exp, engine, step, state = make_setup("average", nb_real_byz=2, chaos=chaos)
+    it = exp.make_train_iterator(8, seed=3)
+    state, metrics = step(state, engine.shard_batch(next(it)))
+    nan_rows = np.asarray(metrics["probe"]["worker_nan_rows"])
+    assert np.all(nan_rows[:2] == 1) and np.all(nan_rows[2:] == 0), nan_rows
+    # the poisoned aggregate lands in the params; the NEXT loss is non-finite
+    state, metrics = step(state, engine.shard_batch(next(it)))
+    assert int(metrics["probe"]["loss_finite"]) == 0
+    assert np.isinf(float(metrics["probe"]["spike"]))
+
+
+def test_probe_multi_step_scan_carries_per_step_fields():
+    exp, engine, step, state = make_setup()
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    multi = engine.build_multi_step(exp.loss, tx, repeat_steps=3)
+    it = exp.make_train_iterator(8, seed=3)
+    state, many = multi(state, engine.shard_batch(next(it)))
+    assert np.asarray(many["probe"]["spike"]).shape == (3,)
+    assert np.asarray(many["probe"]["worker_nan_rows"]).shape == (3, 8)
+    assert float(np.asarray(many["probe"]["spike"])[0]) == 1.0
+
+
+# --------------------------------------------------------------------- #
+# watchdog + ladder policy (no jax)
+
+
+def test_watchdog_nonfinite_triggers_immediately():
+    wd = Watchdog(GuardianConfig(["patience:3"]))
+    assert wd.observe(1, 1.0, True, 1.0) is None
+    assert wd.observe(2, float("nan"), False, float("inf")) == "rollback"
+    assert "non-finite" in wd.last_reason
+
+
+def test_watchdog_spike_needs_patience_and_recovery_declares():
+    wd = Watchdog(GuardianConfig(["patience:3", "spike:10.0", "recover:2"]))
+    assert wd.observe(1, 1.0, True, 1.0) is None
+    assert wd.observe(2, 50.0, True, 50.0) is None   # 1 spiked step
+    assert wd.observe(3, 50.0, True, 50.0) is None   # 2
+    assert wd.observe(4, 50.0, True, 50.0) == "rollback"  # patience hit
+    assert wd.note_rollback(0) == 0
+    # cooldown: patience * backoff^1 = 6 steps of grace for spikes
+    assert wd.observe(1, 50.0, True, 50.0) is None
+    assert wd.observe(2, 1.0, True, 1.0) is None
+    assert wd.observe(3, 1.0, True, 1.0) == "recovered"
+    assert not wd.recovering
+
+
+def test_watchdog_bounded_retries():
+    wd = Watchdog(GuardianConfig(["retries:2"]))
+    assert not wd.exhausted
+    wd.note_rollback(0)
+    wd.note_rollback(0)
+    assert wd.exhausted
+
+
+def test_ladder_grammar_and_cumulative_application():
+    ladder = EscalationLadder(DEFAULT_LADDER)
+    assert len(ladder) == 5
+    ov = Overrides(1, "average")
+    ov = ladder.rung(0).apply(ov)            # f+1
+    assert ov.f == 2 and ov.gar_name == "average"
+    ov = ladder.rung(1).apply(ov)            # gar=median
+    assert ov.f == 2 and ov.gar_name == "median"
+    ov = ladder.rung(3).apply(ov)            # quarantine
+    assert ov.reputation_decay is not None and ov.quarantine_threshold > 0
+    ov = ladder.rung(4).apply(ov)            # lr*0.5
+    assert ov.lr_scale == 0.5
+    assert ladder.rung(99) is None           # past the end: keep config
+    custom = EscalationLadder("gar=bucketing/inner:median,quarantine=0.8/0.4,lr*0.25")
+    assert custom.rungs[0].args == ("inner:median",)
+    assert custom.rungs[1].decay == 0.8 and custom.rungs[1].threshold == 0.4
+
+
+@pytest.mark.parametrize("bad", [
+    "f+0", "f+x", "gar=definitely-not-a-gar", "gar=median/no-colon-arg",
+    "lr*0", "lr*1.5", "quarantine=2/0.5", "banana", "",
+])
+def test_ladder_rejects_bad_rungs(bad):
+    with pytest.raises(UserException):
+        EscalationLadder(bad)
+
+
+def test_guardian_config_rejects_bad_args():
+    for bad in (["patience:0"], ["spike:1.0"], ["retries:0"], ["backoff:0.5"],
+                ["no-such-key:1"]):
+        with pytest.raises(UserException):
+            GuardianConfig(bad)
+
+
+# --------------------------------------------------------------------- #
+# checkpoint pin policy + eval truncation
+
+
+def test_checkpoint_pin_survives_pruning(tmp_path):
+    ckpt = Checkpoints(str(tmp_path), "model", max_to_keep=2)
+    for s in range(1, 6):
+        ckpt.save({"x": np.full((3,), float(s))}, step=s)
+        if s == 2:
+            ckpt.pin(2)
+    assert ckpt.steps() == [2, 4, 5]  # 2 pinned; 1 and 3 pruned
+    assert ckpt.pinned_step() == 2
+    restored, step = ckpt.restore({"x": np.zeros(3)}, step=2)
+    assert step == 2 and np.all(restored["x"] == 2.0)
+    # the abandoned-timeline discard: everything beyond the pin goes
+    assert sorted(ckpt.discard_after(2)) == [4, 5]
+    assert ckpt.steps() == [2]
+    # re-pinning releases the old pin
+    ckpt.save({"x": np.zeros(3)}, step=6)
+    ckpt.pin(6)
+    for s in range(7, 10):
+        ckpt.save({"x": np.zeros(3)}, step=s)
+    assert 2 not in ckpt.steps()
+
+
+def test_evalfile_truncate_after(tmp_path):
+    path = str(tmp_path / "eval.tsv")
+    ev = EvalFile(path)
+    for s in (1, 5, 10, 15):
+        ev.append(s, {"loss": 1.0 / s})
+    assert ev.truncate_after(7) == 2  # 10 and 15 dropped
+    ev.append(9, {"loss": 0.5})
+    ev.close()
+    steps = [int(line.split("\t")[1]) for line in open(path).read().strip().splitlines()]
+    assert steps == [1, 5, 9]
+    assert EvalFile(None).truncate_after(3) == 0  # non-lead process: no-op
+
+
+# --------------------------------------------------------------------- #
+# rollback-and-escalate recovery, end-to-end (the acceptance criterion)
+
+
+def test_guardian_recovers_from_breakdown_regime(tmp_path):
+    """A chaos schedule that provably breaks the configured GAR (inf-row
+    coalition vs plain average — breakdown point 0, the r > f regime of the
+    campaign's f-breakdown probe) must end with guardian-reported recovery:
+    >= 1 rollback event and >= 1 escalation event in the summary log, and a
+    final loss inside the healthy-run bar (the same run aggregated with the
+    escalation target from step 0)."""
+    sum_dir = str(tmp_path / "sum")
+    eval_file = str(tmp_path / "eval.tsv")
+    base = [
+        "--experiment", "mnist", "--experiment-args", "batch-size:16",
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--nb-real-byz-workers", "2",
+        "--chaos", "0:calm 8:attack=inf",
+        "--max-step", "30", "--learning-rate-args", "initial-rate:0.05",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1", "--prefetch", "0",
+    ]
+    assert 0 == runner.main(base + [
+        "--aggregator", "average",
+        "--guardian", "--guardian-args", "ladder:gar=median", "recover:5",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--checkpoint-delta", "4", "--checkpoint-period", "-1",
+        "--summary-dir", sum_dir, "--summary-delta", "5",
+    ])
+    events = [json.loads(line)
+              for name in os.listdir(sum_dir)
+              for line in open(os.path.join(sum_dir, name))]
+    rollbacks = [e for e in events if e.get("event") == "guardian_rollback"]
+    escalations = [e for e in events if e.get("event") == "guardian_escalation"]
+    recoveries = [e for e in events if e.get("event") == "guardian_recovered"]
+    assert len(rollbacks) >= 1, events
+    assert len(escalations) >= 1 and "gar=median" in escalations[0]["rung"]
+    assert len(recoveries) >= 1
+    scalars = [e for e in events if "total_loss" in e]
+    final_loss = scalars[-1]["total_loss"]
+    assert np.isfinite(final_loss)
+    # the poisoned timeline's snapshots were discarded: the newest snapshot
+    # on disk restores finite params (a later auto-restore stays clean)
+    ckpts = sorted(os.listdir(str(tmp_path / "ckpt")))
+    assert ckpts, "no snapshot survived"
+
+    # healthy-run bar: same schedule steps under the escalated rule from step 0
+    healthy_sum = str(tmp_path / "hsum")
+    assert 0 == runner.main(base + [
+        "--aggregator", "median",
+        "--summary-dir", healthy_sum, "--summary-delta", "5",
+    ])
+    hevents = [json.loads(line)
+               for name in os.listdir(healthy_sum)
+               for line in open(os.path.join(healthy_sum, name))]
+    healthy_final = [e for e in hevents if "total_loss" in e][-1]["total_loss"]
+    assert final_loss <= healthy_final * 1.10, (final_loss, healthy_final)
+
+
+def test_guardian_rolls_back_to_auto_restored_snapshot(tmp_path):
+    """A run that resumes from a snapshot and diverges BEFORE any in-run
+    checkpoint passes the health gate must roll back to the snapshot it
+    just restored from — not wipe the directory and restart from step 0
+    (the auto-restored snapshot is pinned as initial last-known-good)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    sum_dir = str(tmp_path / "sum")
+    base = [
+        "--experiment", "mnist", "--experiment-args", "batch-size:16",
+        "--nb-workers", "8", "--nb-decl-byz-workers", "2",
+        "--learning-rate-args", "initial-rate:0.05", "--prefetch", "0",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--checkpoint-dir", ckpt_dir,
+    ]
+    # healthy run to step 6
+    assert 0 == runner.main(base + ["--aggregator", "median", "--max-step", "6"])
+    # resume into an immediately-hostile regime: diverges before the first
+    # in-run snapshot can be pinned
+    assert 0 == runner.main(base + [
+        "--aggregator", "average", "--nb-real-byz-workers", "2",
+        "--chaos", "0:attack=inf", "--max-step", "20",
+        "--guardian", "--guardian-args", "ladder:gar=median", "recover:4",
+        "--checkpoint-delta", "100", "--checkpoint-period", "-1",
+        "--summary-dir", sum_dir, "--summary-delta", "5",
+    ])
+    events = [json.loads(line)
+              for name in os.listdir(sum_dir)
+              for line in open(os.path.join(sum_dir, name))]
+    rollbacks = [e for e in events if e.get("event") == "guardian_rollback"]
+    assert rollbacks and rollbacks[0]["to_step"] == 6, rollbacks
+    assert rollbacks[0]["restored_snapshot"] is True
+
+
+def test_guardian_requires_checkpoint_dir():
+    with pytest.raises(UserException):
+        runner.main([
+            "--experiment", "mnist", "--aggregator", "average",
+            "--nb-workers", "4", "--max-step", "2", "--guardian",
+        ])
+
+
+def test_guardian_run_fails_after_bounded_retries(tmp_path):
+    """An unsurvivable regime (inf rows under every ladder config — the
+    ladder here never escalates past average) must exhaust its retries and
+    fail loudly instead of looping forever."""
+    with pytest.raises(UserException, match="guardian: run failed"):
+        runner.main([
+            "--experiment", "mnist", "--experiment-args", "batch-size:16",
+            "--aggregator", "average", "--nb-workers", "8",
+            "--nb-decl-byz-workers", "2", "--nb-real-byz-workers", "2",
+            "--chaos", "0:attack=inf",
+            "--guardian", "--guardian-args", "retries:2", "ladder:lr*0.5",
+            "--max-step", "20", "--prefetch", "0",
+            "--evaluation-delta", "-1", "--evaluation-period", "-1",
+            "--checkpoint-dir", str(tmp_path / "ckpt"),
+            "--checkpoint-delta", "5", "--checkpoint-period", "-1",
+        ])
+
+
+def test_campaign_cell_reports_diverged_then_recovered():
+    """chaos/campaign.py closes the loop: the breakdown regime injected by
+    PR 1's scheduler becomes the recovery harness — the guardian cell
+    reports rollbacks/escalations/recovered while the bare cell diverges."""
+    from aggregathor_tpu.chaos.campaign import run_cell
+
+    config = GuardianConfig(["ladder:gar=median", "recover:4"])
+    cell = run_cell("mnist", ["batch-size:16"], "average", [], 8, 2, 2,
+                    "0:calm 6:attack=inf", [], 25, 0.05, 0, guardian=config)
+    assert cell["guardian"] is True
+    assert cell["rollbacks"] >= 1
+    assert cell["escalations"] and cell["escalations"][0] == "gar=median"
+    assert cell["recovered"] is True
+    assert cell["converged"] is True and cell["diverged"] is False
+    bare = run_cell("mnist", ["batch-size:16"], "average", [], 8, 2, 2,
+                    "0:calm 6:attack=inf", [], 25, 0.05, 0)
+    assert bare["diverged"] is True and "recovered" not in bare
+
+
+# --------------------------------------------------------------------- #
+# preemption-safe resume
+
+
+def _runner_argv(ckpt_dir, max_step):
+    return [
+        sys.executable, "-m", "aggregathor_tpu.cli.runner",
+        "--experiment", "mnist", "--experiment-args", "batch-size:16",
+        "--aggregator", "median", "--nb-workers", "4", "--nb-decl-byz-workers", "1",
+        "--learning-rate-args", "initial-rate:0.05", "--prefetch", "0",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--checkpoint-dir", ckpt_dir, "--checkpoint-delta", "3",
+        "--checkpoint-period", "-1", "--max-step", str(max_step),
+    ]
+
+
+def test_kill_and_resume_bit_identical(tmp_path):
+    """Preemption-safe resume, end to end: SIGTERM a run mid-training (the
+    handler finishes the in-flight step, saves, and flushes the background
+    writer), resume it, and the final checkpoint must be BIT-identical to an
+    uninterrupted run's — step, params, optimizer state and RNG restore
+    exactly, and the input stream fast-forwards to the restored step."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    control = str(tmp_path / "control")
+    killed = str(tmp_path / "killed")
+    max_step = 14
+
+    proc = subprocess.run(_runner_argv(control, max_step), env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    proc = subprocess.Popen(_runner_argv(killed, max_step), env=env, cwd=repo,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+    # SIGTERM as soon as a mid-run snapshot exists (so the run is provably
+    # mid-training, not finished)
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline and proc.poll() is None:
+        has_snapshot = os.path.isdir(killed) and any(
+            name.endswith(".ckpt") for name in os.listdir(killed)
+        )
+        if has_snapshot:
+            proc.send_signal(signal.SIGTERM)
+            break
+        time.sleep(0.1)
+    out = proc.communicate(timeout=240)[0]
+    assert proc.returncode == 0, out[-2000:]
+    interrupted_steps = sorted(
+        int(name.split("-")[1].split(".")[0]) for name in os.listdir(killed)
+    )
+    assert interrupted_steps, "no snapshot written before/at the SIGTERM"
+
+    if interrupted_steps[-1] < max_step:
+        proc = subprocess.run(_runner_argv(killed, max_step), env=env, cwd=repo,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "Restored checkpoint" in proc.stderr + proc.stdout
+
+    final_control = os.path.join(control, "model-%d.ckpt" % max_step)
+    final_killed = os.path.join(killed, "model-%d.ckpt" % max_step)
+    with open(final_control, "rb") as a, open(final_killed, "rb") as b:
+        assert a.read() == b.read(), "resumed trajectory is not bit-identical"
