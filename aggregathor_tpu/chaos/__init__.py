@@ -21,7 +21,11 @@ a systematic resilience-evaluation product:
 - ``campaign``:   a resilience-campaign harness sweeping attack x GAR x
   schedule grids through the real engine, emitting a machine-readable
   resilience matrix (JSON) plus a markdown report, including an empirical
-  check of the f-breakdown-point boundary.
+  check of the f-breakdown-point boundary;
+- ``replica_faults``: the SERVING-side fault regimes — per-replica
+  parameter corruption (nan / scale / zero / noise / stale) driving the
+  replicated robust inference path (``serve/``), swept by the serve
+  campaign the way ``campaign`` sweeps training regimes.
 
 Both engines accept a ``ChaosSchedule`` (``RobustEngine(..., chaos=...)``);
 the CLI spells it ``--chaos "<schedule>" --chaos-args key:value...``.
@@ -29,3 +33,9 @@ the CLI spells it ``--chaos "<schedule>" --chaos-args key:value...``.
 
 from .schedule import ChaosSchedule  # noqa: F401
 from .stragglers import StragglerModel  # noqa: F401
+from .replica_faults import (  # noqa: F401
+    PARAM_FAULTS,
+    REPLICA_FAULTS,
+    corrupt_params,
+    parse_poison,
+)

@@ -1,0 +1,111 @@
+"""Replica-parameter fault modes: chaos/ regimes for the SERVING path.
+
+Training chaos corrupts per-worker *gradients* (schedule.py regimes: drop,
+straggle, attack); serving chaos corrupts per-replica *parameters* — the
+failure modes an inference fleet actually sees:
+
+- ``nan``          a crashed/truncated replica: every parameter reads NaN,
+  so its logits read NaN — absorbed by the NaN-last GAR convention exactly
+  like a dead worker's gradient row (``gars/median.py``);
+- ``scale[=X]``    a corrupted replica (bit-rot, botched quantization, an
+  adversarial substitution): parameters multiplied by X (default 100);
+- ``zero``         a wiped replica: all-zeros parameters (uniform logits);
+- ``noise[=S]``    a perturbed replica: i.i.d. Gaussian noise of scale S
+  times each leaf's std added (default 0.1) — models near-agreeing
+  replicas (distinct fine-tunes), NOT a Byzantine fault;
+- ``stale``        an out-of-date replica — no transform here: the caller
+  restores an EARLIER checkpoint step instead (``cli/serve.py`` resolves
+  ``stale`` to the oldest on-disk snapshot; ``serve/campaign.py`` to an
+  under-trained copy).
+
+Spec grammar (CLI ``--poison-replica``, campaign scenario lists)::
+
+  SPEC := INDEX ":" MODE ("=" VALUE)?     e.g.  1:nan   2:scale=50   0:stale
+
+The serve campaign (``serve/campaign.py``) sweeps these modes x GARs and
+proves the median-of-replicas vote keeps served predictions at the clean bar
+while plain ``average`` degrades — the serving-side breakdown probe.
+"""
+
+import numpy as np
+
+import jax
+
+from ..utils import UserException
+
+#: modes that transform a parameter pytree in place (stale is resolved by
+#: the caller to an earlier checkpoint instead)
+PARAM_FAULTS = ("nan", "scale", "zero", "noise")
+
+#: every accepted mode name
+REPLICA_FAULTS = PARAM_FAULTS + ("stale",)
+
+_DEFAULTS = {"scale": 100.0, "noise": 0.1}
+
+
+def parse_poison(spec):
+    """Parse one ``INDEX:MODE[=VALUE]`` spec -> (index, mode, value).
+
+    ``value`` is None for modes without a knob (nan/zero/stale).
+    """
+    if ":" not in spec:
+        raise UserException(
+            "Poison spec %r: expected INDEX:MODE[=VALUE] (modes: %s)"
+            % (spec, ", ".join(REPLICA_FAULTS))
+        )
+    index_text, mode = spec.split(":", 1)
+    try:
+        index = int(index_text)
+    except ValueError:
+        raise UserException("Poison spec %r: replica index %r is not an integer"
+                            % (spec, index_text))
+    if index < 0:
+        raise UserException("Poison spec %r: replica index must be >= 0" % (spec,))
+    value = None
+    if "=" in mode:
+        mode, value_text = mode.split("=", 1)
+        try:
+            value = float(value_text)
+        except ValueError:
+            raise UserException("Poison spec %r: value %r is not a number"
+                                % (spec, value_text))
+    if mode not in REPLICA_FAULTS:
+        raise UserException(
+            "Unknown replica fault %r (accepted: %s)"
+            % (mode, ", ".join(REPLICA_FAULTS))
+        )
+    if value is not None and mode not in _DEFAULTS:
+        raise UserException("Replica fault %r takes no value (got %r)" % (mode, value))
+    if value is None:
+        value = _DEFAULTS.get(mode)
+    return index, mode, value
+
+
+def corrupt_params(params, mode, value=None, seed=0):
+    """Apply a parameter fault mode to a replica's pytree (host-side numpy;
+    the corrupted copy is device_put by the serving engine like any other
+    replica).  ``stale`` is a restore-time mode and is rejected here."""
+    if mode not in PARAM_FAULTS:
+        raise UserException(
+            "corrupt_params handles %s; %r is resolved at restore time"
+            % ("/".join(PARAM_FAULTS), mode)
+        )
+    if value is None:
+        value = _DEFAULTS.get(mode)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng(seed)
+    out = []
+    for leaf in leaves:
+        leaf = np.asarray(leaf)
+        if mode == "nan":
+            out.append(np.full_like(leaf, np.nan))
+        elif mode == "zero":
+            out.append(np.zeros_like(leaf))
+        elif mode == "scale":
+            out.append(leaf * np.asarray(value, leaf.dtype))
+        else:  # noise
+            sigma = float(np.std(leaf)) or 1.0
+            out.append(leaf + rng.normal(
+                0.0, float(value) * sigma, size=leaf.shape
+            ).astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,286 @@
+"""Serving runner: checkpoint -> Byzantine-robust HTTP inference.
+
+The serving sibling of ``cli/runner.py``: loads a trained checkpoint
+(``obs/checkpoint.py`` restore — the authenticator and at-rest cipher are
+honored via the same ``--session-secret`` flags training uses), builds an
+R-way replicated :class:`serve.engine.InferenceEngine` with a GAR vote over
+replica logits, and serves ``/predict`` / ``/healthz`` / ``/metrics``
+through the deadline micro-batcher (docs/serving.md).
+
+Replica sources:
+
+- one ``--ckpt-dir`` + ``--replicas R``: R copies of the latest snapshot
+  (identical replicas — the vote then masks injected faults exactly);
+- several ``--ckpt-dir`` paths: one replica per directory (distinct
+  checkpoints, e.g. staggered training steps or fine-tunes).
+
+``--poison-replica INDEX:MODE[=VALUE]`` (repeatable) injects the chaos
+replica-fault modes (``chaos/replica_faults.py``: nan / scale / zero /
+noise / stale) — the fault-injection hook the smoke script and the serve
+campaign drive to prove the vote masks a corrupted replica in production
+configuration, not just in unit tests.
+
+Example::
+
+  python -m aggregathor_tpu.cli.serve --experiment digits \
+      --ckpt-dir out/ckpt --replicas 3 --gar median \
+      --port 8000 --max-latency-ms 10 --max-batch 64
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="aggregathor-tpu serve",
+        description="Byzantine-robust batched inference serving",
+    )
+    parser.add_argument("--experiment", required=True, help="experiment name (models registry)")
+    parser.add_argument("--experiment-args", nargs="*", default=[], help="key:value experiment arguments")
+    parser.add_argument("--ckpt-dir", nargs="+", required=True, metavar="DIR",
+                        help="checkpoint directory (one: replicated --replicas times; "
+                             "several: one replica each)")
+    parser.add_argument("--ckpt-step", type=int, default=None,
+                        help="serve this snapshot step (default: latest per directory)")
+    parser.add_argument("--checkpoint-base-name", default=None, help="checkpoint file base name")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="replica count R (default: number of --ckpt-dir paths)")
+    parser.add_argument("--gar", default="median",
+                        help="vote rule over replica logits (gars registry; 'none' disables "
+                             "the vote and serves replica 0)")
+    parser.add_argument("--gar-args", nargs="*", default=[], help="key:value vote-rule arguments")
+    parser.add_argument("--replica-byz", type=int, default=None, metavar="F",
+                        help="declared faulty-replica budget f for the vote rule "
+                             "(default (R-1)//2)")
+    parser.add_argument("--poison-replica", action="append", default=[], metavar="IDX:MODE[=V]",
+                        help="chaos tie-in: corrupt replica IDX with a replica fault "
+                             "(nan|scale=X|zero|noise=S|stale); repeatable")
+    # Restore template: must match the optimizer the snapshot was trained with
+    parser.add_argument("--optimizer", default="sgd", help="optimizer the checkpoint was trained with")
+    parser.add_argument("--optimizer-args", nargs="*", default=[], help="key:value optimizer arguments")
+    parser.add_argument("--session-secret", default=None, metavar="SECRET",
+                        help="verify checkpoint HMAC tags under this secret (training's "
+                             "--session-secret; restore fails on tampered snapshots)")
+    parser.add_argument("--no-legacy-checkpoint-tags", action="store_true",
+                        help="refuse snapshots tagged under the legacy key scheme")
+    parser.add_argument("--encrypt-checkpoints", action="store_true",
+                        help="snapshots are encrypted at rest (requires --session-secret)")
+    # Batching / shedding
+    parser.add_argument("--max-batch", type=int, default=64, help="bucket ladder top / batch cap")
+    parser.add_argument("--buckets", default=None, metavar="B1,B2,...",
+                        help="explicit bucket ladder (default: powers of two up to --max-batch)")
+    parser.add_argument("--max-latency-ms", type=float, default=10.0,
+                        help="micro-batch dispatch deadline from the oldest queued request")
+    parser.add_argument("--queue-bound", type=int, default=256,
+                        help="queued-row bound beyond which requests are shed (HTTP 429)")
+    parser.add_argument("--flag-threshold", type=float, default=None,
+                        help="flag a replica suspect when its disagreement exceeds this "
+                             "(non-finite always flags)")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip compiling the bucket ladder up front (first requests "
+                             "then pay the compiles)")
+    # HTTP / observability
+    parser.add_argument("--host", default="127.0.0.1", help="bind address")
+    parser.add_argument("--port", type=int, default=8000, help="bind port (0 = ephemeral)")
+    parser.add_argument("--ready-file", default=None, metavar="PATH",
+                        help="write 'host port pid' here once serving (harness handshake)")
+    parser.add_argument("--summary-dir", default=None,
+                        help="JSONL serve_batch/serve_shed event directory (obs/summaries)")
+    parser.add_argument("--request-timeout", type=float, default=60.0,
+                        help="seconds a /predict handler waits on its batch")
+    parser.add_argument("--seed", type=int, default=0, help="base PRNG seed (template init)")
+    parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
+    return parser
+
+
+def load_replicas(args, experiment):
+    """Resolve the replica parameter sets: checkpoint restores + poison specs.
+
+    Returns ``(replicas, sources)`` — ``sources`` is the human-readable
+    per-replica provenance logged at startup and reported by /healthz's
+    operator story ("which checkpoint is replica 2, and is it poisoned?").
+    """
+    from .. import config
+    from ..chaos.replica_faults import corrupt_params, parse_poison
+    from ..core import build_optimizer, build_schedule
+    from ..obs import Checkpoints
+    from ..serve.engine import restore_params
+    from ..utils import UserException
+
+    tx = build_optimizer(
+        args.optimizer, build_schedule("fixed", ["initial-rate:0.01"]), args.optimizer_args
+    )
+    authenticator = None
+    cipher = None
+    if args.encrypt_checkpoints and not args.session_secret:
+        raise UserException("--encrypt-checkpoints derives its key from --session-secret; pass both")
+    if args.session_secret:
+        from ..parallel.auth import GradientAuthenticator
+
+        authenticator = GradientAuthenticator(args.session_secret.encode(), 1, context=b"ckpt")
+        if args.encrypt_checkpoints:
+            from ..parallel.crypto import SnapshotCipher
+
+            cipher = SnapshotCipher(args.session_secret.encode())
+
+    def restore(directory, step=None):
+        return restore_params(
+            experiment, directory, tx, step=step, seed=args.seed,
+            base_name=args.checkpoint_base_name,
+            authenticator=authenticator, cipher=cipher,
+            allow_legacy_tags=not args.no_legacy_checkpoint_tags,
+        )
+
+    dirs = list(args.ckpt_dir)
+    nb_replicas = args.replicas if args.replicas is not None else len(dirs)
+    if nb_replicas < 1:
+        raise UserException("--replicas must be >= 1")
+    if len(dirs) == 1:
+        dirs = dirs * nb_replicas
+    elif len(dirs) != nb_replicas:
+        raise UserException(
+            "%d --ckpt-dir paths but --replicas %d: give one directory, or one per replica"
+            % (len(dirs), nb_replicas)
+        )
+
+    poisons = {}
+    for spec in args.poison_replica:
+        index, mode, value = parse_poison(spec)
+        if index >= nb_replicas:
+            raise UserException(
+                "--poison-replica %r: replica %d does not exist (R=%d)"
+                % (spec, index, nb_replicas)
+            )
+        if index in poisons:
+            raise UserException("--poison-replica: replica %d poisoned twice" % index)
+        poisons[index] = (mode, value)
+
+    replicas, sources = [], []
+    cache = {}
+    for index, directory in enumerate(dirs):
+        poison = poisons.get(index)
+        if poison is not None and poison[0] == "stale":
+            on_disk = Checkpoints(
+                directory,
+                args.checkpoint_base_name if args.checkpoint_base_name is not None
+                else config.default_checkpoint_base_name,
+            ).steps()
+            if len(on_disk) < 2:
+                raise UserException(
+                    "--poison-replica %d:stale needs at least two snapshots in %r"
+                    % (index, directory)
+                )
+            params, step = restore(directory, step=on_disk[0])
+            sources.append("%s@%d (stale)" % (directory, step))
+        else:
+            key = (directory, args.ckpt_step)
+            if key not in cache:
+                cache[key] = restore(directory, step=args.ckpt_step)
+            params, step = cache[key]
+            if poison is not None:
+                mode, value = poison
+                params = corrupt_params(params, mode, value, seed=args.seed + 31 * index)
+                sources.append("%s@%d (poisoned: %s)" % (directory, step, mode))
+            else:
+                sources.append("%s@%d" % (directory, step))
+        replicas.append(params)
+    return replicas, sources
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from .. import gars, models
+    from ..obs import SummaryWriter
+    from ..serve import InferenceEngine, InferenceServer
+    from ..utils import Context, UserException, info
+
+    with Context("load"):
+        experiment = models.instantiate(args.experiment, args.experiment_args)
+        replicas, sources = load_replicas(args, experiment)
+        nb_replicas = len(replicas)
+        for index, source in enumerate(sources):
+            info("replica %d: %s" % (index, source))
+        vote = None
+        if args.gar != "none" and nb_replicas > 1:
+            f = args.replica_byz if args.replica_byz is not None else (nb_replicas - 1) // 2
+            vote = gars.instantiate(args.gar, nb_replicas, f, list(args.gar_args))
+        elif args.gar != "none" and args.poison_replica:
+            raise UserException(
+                "Poisoned single-replica serving has no vote to mask the fault; "
+                "use --replicas >= 2 (R >= 2f+1 for median)"
+            )
+        buckets = None
+        if args.buckets:
+            buckets = [int(b) for b in args.buckets.split(",")]
+
+    with Context("compile"):
+        engine = InferenceEngine(
+            experiment, replicas, gar=vote, max_batch=args.max_batch,
+            buckets=buckets, seed=args.seed,
+        )
+        if not args.no_warmup:
+            engine.warmup()
+
+    summaries = SummaryWriter(args.summary_dir, run_name="serve")
+    server = InferenceServer(
+        engine, host=args.host, port=args.port,
+        max_latency_s=args.max_latency_ms / 1e3,
+        queue_bound=args.queue_bound,
+        summaries=summaries,
+        request_timeout_s=args.request_timeout,
+        flag_threshold=args.flag_threshold,
+    )
+    host, port = server.server_address[:2]
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as fd:
+            fd.write("%s %d %d\n" % (host, port, os.getpid()))
+        os.replace(tmp, args.ready_file)  # atomic: readers never see a torn line
+
+    def on_signal(signum, frame):
+        # serve_forever runs on THIS (main) thread and shutdown() blocks
+        # until its loop acknowledges — called inline here it would deadlock
+        # (the loop cannot advance while the handler blocks), so it runs on
+        # a helper thread and the handler returns immediately.
+        import threading
+
+        info("Signal %d: draining and shutting down" % signum)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    previous = {
+        signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
+        signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
+    }
+    try:
+        info("Serving %s on http://%s:%d (%d replica(s), vote=%s)"
+             % (args.experiment, host, port, nb_replicas,
+                type(vote).__name__ if vote else "none"))
+        server.serve_forever()
+    finally:
+        for signum, handler in previous.items():
+            signal.signal(signum, handler)
+        server.server_close()
+        server.batcher.close()
+        summaries.close()
+    return 0
+
+
+def cli():
+    from . import console_entry
+
+    return console_entry(main)
+
+
+if __name__ == "__main__":
+    sys.exit(cli())

@@ -65,6 +65,23 @@ class Experiment:
     def metrics(self, params, batch):
         raise NotImplementedError
 
+    def predict_logits(self, params, x):
+        """The inference apply path: ``(params, (B, *sample_shape)) -> (B,
+        classes)`` logits.  This is the single hook ``serve/engine.py`` jits —
+        the training-only heads (aux logits, label smoothing, weight decay)
+        never enter the serving graph.  Default: the bare ``model.apply``,
+        which is the logits path for every bundled experiment family (mnist/
+        digits MLPs, cnnet, the zoo); experiments whose apply signature
+        differs override this.
+        """
+        model = getattr(self, "model", None)
+        if model is None:
+            raise NotImplementedError(
+                "Experiment %r keeps no .model; override predict_logits()"
+                % type(self).__name__
+            )
+        return model.apply(params, x)
+
     def make_train_iterator(self, nb_workers, seed=0):
         raise NotImplementedError
 

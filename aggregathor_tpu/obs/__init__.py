@@ -11,10 +11,12 @@ the same step-delta / wall-period policies, plus a final fire at shutdown.
 - ``EvalFile``        the reference's TSV evaluation log format
 - ``SummaryWriter``   JSONL scalar event log (summary-file parity)
 - ``PerfReport``      steps/s report, first (compilation) step excluded
+- ``LatencyHistogram``  bounded-reservoir p50/p95/p99 tail latency (shared by
+  ``PerfReport`` and the serving ``/metrics`` endpoint)
 """
 
 from .cadence import CadenceTrigger  # noqa: F401
 from .checkpoint import Checkpoints  # noqa: F401
 from .evalfile import EvalFile  # noqa: F401
 from .summaries import SummaryWriter  # noqa: F401
-from .perf import PerfReport  # noqa: F401
+from .perf import LatencyHistogram, PerfReport  # noqa: F401

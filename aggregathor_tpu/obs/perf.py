@@ -3,11 +3,75 @@
 Reproduces runner.py:504-506, 561-569, 586-598: wall time split into
 "in-graph" (blocking on the device step) vs "off-graph" (host-side work
 between steps), steps/s including and excluding the first (compilation) step.
+
+``LatencyHistogram`` is the shared tail-latency accumulator: a bounded
+reservoir of samples with p50/p95/p99 readout, used both by ``PerfReport``
+(per-dispatch step latency spread) and by the serving stack's ``/metrics``
+endpoint (request latency, ``serve/server.py``).
 """
 
+import random
+import threading
 import time
 
 from ..utils import info
+
+
+class LatencyHistogram:
+    """p50/p95/p99 percentiles over a bounded sample reservoir.
+
+    Uniform reservoir sampling (Vitter's algorithm R) over everything ever
+    recorded, so a long-lived server keeps a representative — not merely
+    recent — tail picture in O(capacity) memory.  Thread-safe: the serving
+    path records from handler threads while ``/metrics`` reads concurrently.
+    """
+
+    #: the percentiles ``percentiles()`` reports, as (name, fraction)
+    POINTS = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+    def __init__(self, capacity=4096, seed=0):
+        if capacity < 1:
+            raise ValueError("LatencyHistogram capacity must be >= 1 (got %d)" % capacity)
+        self.capacity = int(capacity)
+        self._samples = []
+        self._count = 0
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def record(self, seconds):
+        """Add one latency sample (seconds; any nonnegative float works)."""
+        value = float(seconds)
+        with self._lock:
+            self._count += 1
+            if len(self._samples) < self.capacity:
+                self._samples.append(value)
+            else:
+                slot = self._rng.randrange(self._count)
+                if slot < self.capacity:
+                    self._samples[slot] = value
+
+    @property
+    def count(self):
+        """Total samples ever recorded (not just the retained reservoir)."""
+        with self._lock:
+            return self._count
+
+    def percentiles(self):
+        """{"p50": s, "p95": s, "p99": s} (seconds), or None when empty.
+
+        Nearest-rank on the sorted reservoir — with fewer samples than the
+        1/(1-q) run length the top percentiles degrade to the maximum, which
+        is the honest small-sample answer for a tail estimate.
+        """
+        with self._lock:
+            if not self._samples:
+                return None
+            ordered = sorted(self._samples)
+        last = len(ordered) - 1
+        return {
+            name: ordered[min(last, int(q * len(ordered)))]
+            for name, q in self.POINTS
+        }
 
 
 class PerfReport:
@@ -17,6 +81,9 @@ class PerfReport:
         self.in_graph_s = 0.0
         self.start = time.monotonic()
         self._step_start = None
+        # Per-dispatch latency spread (first/compile dispatch excluded so the
+        # percentiles describe the steady state, like steps/s excl. 1st).
+        self.latency = LatencyHistogram()
 
     def step_begin(self):
         self._step_start = time.monotonic()
@@ -26,6 +93,8 @@ class PerfReport:
         elapsed = time.monotonic() - self._step_start
         if self.nb_steps == 0:
             self.first_step_s = elapsed
+        else:
+            self.latency.record(elapsed / max(int(nb_steps), 1))
         self.in_graph_s += elapsed
         self.nb_steps += int(nb_steps)
 
@@ -38,6 +107,10 @@ class PerfReport:
         info("  in-graph time         %.3f s (%.1f%%)" % (self.in_graph_s, 100.0 * self.in_graph_s / max(total, 1e-9)))
         info("  off-graph time        %.3f s (%.1f%%)" % (off_graph, 100.0 * off_graph / max(total, 1e-9)))
         info("  first (compile) step  %.3f s" % self.first_step_s)
+        tail = self.latency.percentiles()
+        if tail is not None:
+            info("  step latency p50/p95/p99  %.1f / %.1f / %.1f ms"
+                 % tuple(tail[name] * 1e3 for name, _ in LatencyHistogram.POINTS))
         if self.nb_steps > 0:
             info("  steps/s (all steps)   %.3f" % (self.nb_steps / max(total, 1e-9)))
         if self.nb_steps > 1:

@@ -1,0 +1,37 @@
+"""Byzantine-robust batched inference serving.
+
+The inference layer the ROADMAP's "serve heavy traffic" north star asks for:
+trained checkpoints (``obs/checkpoint.py`` restore, authenticator honored)
+answer prediction requests through ONE compiled apply path.
+
+- ``engine``:  :class:`InferenceEngine` — a fixed power-of-two **bucket
+  ladder** of padded batch shapes (zero steady-state recompiles, the chaos
+  scheduler's compile discipline applied to serving) and R-way **replicated
+  robust inference**: replica logits stacked ``(R, batch, classes)`` and
+  reduced by the training GARs (``gars/``) with the NaN-last convention, so
+  a crashed/corrupted replica is absorbed exactly like a Byzantine worker's
+  gradient row; per-replica disagreement scores feed quarantine-style
+  flagging.
+- ``batcher``: :class:`MicroBatcher` — deadline micro-batching (dispatch at
+  ``max_latency`` OR a full bucket), bounded queue with explicit
+  **load-shedding** (:class:`LoadShed` -> HTTP 429).
+- ``server``:  :class:`InferenceServer` — stdlib ``ThreadingHTTPServer``
+  exposing ``/predict``, ``/healthz`` and ``/metrics`` (queue depth, batch
+  occupancy, p50/p95/p99, shed count, per-replica disagreement), metrics
+  mirrored as ``obs/summaries`` JSONL events.
+- ``campaign``: the replica-fault resilience harness (fault modes from
+  ``chaos/replica_faults.py``) proving median-of-replicas serves at the
+  clean bar while plain averaging degrades.
+
+CLI: ``python -m aggregathor_tpu.cli.serve --ckpt-dir ... --experiment ...
+--replicas R --gar median`` (see ``cli/serve.py``; docs/serving.md).
+"""
+
+from .batcher import LoadShed, MicroBatcher, Ticket  # noqa: F401
+from .engine import (  # noqa: F401
+    InferenceEngine,
+    bucket_ladder,
+    choose_bucket,
+    restore_params,
+)
+from .server import InferenceServer  # noqa: F401

@@ -1,0 +1,243 @@
+"""Deadline micro-batching with bounded backpressure and load-shedding.
+
+The serving input pipeline: request rows accumulate in a queue and are
+dispatched as one bucket-shaped batch when EITHER (a) the oldest request has
+waited ``max_latency_s`` (the deadline — the tail-latency contract, the
+OptiReduce framing: bound the tail rather than wait for the last straggler)
+OR (b) ``max_batch`` rows are ready (the occupancy cap — a full bucket gains
+nothing by waiting).  The deadline-vs-straggler tradeoff of AllReduce maps
+onto serving verbatim: a late request is the straggler, and the deadline
+bounds how long everyone else's latency is hostage to it.
+
+Backpressure is explicit: once the queued row count would pass
+``queue_bound``, ``submit`` fails IMMEDIATELY with :class:`LoadShed` (the
+429 path) instead of growing the queue — under overload, shedding keeps the
+served requests' latency bounded instead of letting every request time out
+(load-shedding is the serving counterpart of the lossy link's
+drop-don't-block transport).  The bound caps *waiting* work only: a request
+arriving to an empty queue is always admitted, so any request of up to
+``max_batch`` rows is servable by an idle server regardless of the bound.
+
+The batcher is engine-agnostic: ``runner`` is any callable taking a
+``(k, *sample)`` row block and returning a dict of leading-``k`` arrays
+(plus optional scalar extras, broadcast to every request in the batch).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+
+class LoadShed(Exception):
+    """Raised by ``submit`` when the queue is over ``queue_bound`` rows —
+    map to HTTP 429 (``serve/server.py``)."""
+
+
+class _Pending:
+    __slots__ = ("rows", "event", "result", "error", "enqueued_at")
+
+    def __init__(self, rows, now):
+        self.rows = rows
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.enqueued_at = now
+
+
+class Ticket:
+    """Handle for one submitted request: ``wait()`` blocks for the batch
+    carrying it and returns the per-request result dict.  A timed-out wait
+    CANCELS the request: if it is still queued it is removed (the engine
+    never runs dead work for a caller that already gave up — under
+    saturation that capacity goes to still-live requests); if its batch is
+    already in flight, the result is simply dropped."""
+
+    def __init__(self, batcher, pending):
+        self._batcher = batcher
+        self._pending = pending
+
+    def wait(self, timeout=None):
+        if not self._pending.event.wait(timeout):
+            self._batcher._cancel(self._pending)
+            raise TimeoutError("inference batch did not complete in time")
+        if self._pending.error is not None:
+            raise self._pending.error
+        return self._pending.result
+
+
+class MicroBatcher:
+    """Queue + dispatcher thread in front of an inference runner.
+
+    Args:
+      runner: ``(rows) -> dict`` — typically ``InferenceEngine.predict``.
+        Leading-axis-``k`` values are split per request; other values
+        (disagreement vectors, bucket scalars) are shared by every request
+        in the batch.
+      max_latency_s: dispatch deadline measured from the OLDEST queued
+        request's arrival.
+      max_batch: row cap per dispatched batch (the ladder top).
+      queue_bound: queued-row limit beyond which ``submit`` sheds.
+      clock: injectable monotonic clock (tests).
+    """
+
+    #: result keys never split per request even when their leading dimension
+    #: happens to equal the batch's row count (e.g. R replicas == k rows)
+    SHARED_KEYS = ("disagreement", "bucket")
+
+    def __init__(self, runner, max_latency_s=0.010, max_batch=64,
+                 queue_bound=256, clock=time.monotonic, on_batch=None,
+                 shared_keys=SHARED_KEYS):
+        if max_batch < 1 or queue_bound < 1 or max_latency_s < 0:
+            raise ValueError(
+                "MicroBatcher wants max_batch>=1, queue_bound>=1, max_latency_s>=0"
+            )
+        self.runner = runner
+        self.max_latency_s = float(max_latency_s)
+        self.max_batch = int(max_batch)
+        self.queue_bound = int(queue_bound)
+        self.clock = clock
+        self.on_batch = on_batch
+        self.shared_keys = frozenset(shared_keys)
+        self._queue = []
+        self._queued_rows = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self.shed_count = 0
+        self.batch_count = 0
+        self.served_rows = 0
+        #: occupancy of the last dispatched batch: (rows, cap)
+        self.last_occupancy = (0, self.max_batch)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="micro-batcher"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # producer side
+
+    def submit(self, rows):
+        """Enqueue ``rows`` ((k, *sample) array, k >= 1); returns a
+        :class:`Ticket`.  Sheds with :class:`LoadShed` when the queue is
+        over bound, full requests only (a request never splits across
+        batches: ``k`` must fit ``max_batch``)."""
+        rows = np.asarray(rows)
+        k = rows.shape[0]
+        if k < 1:
+            raise ValueError("Empty request")
+        if k > self.max_batch:
+            raise ValueError(
+                "Request of %d rows exceeds max_batch=%d; split it client-side"
+                % (k, self.max_batch)
+            )
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            # The bound caps WAITING work: a request arriving to an empty
+            # queue is always admitted (it dispatches next and delays
+            # nobody) — otherwise a request larger than the bound could
+            # never be served, even by an idle server.
+            if self._queued_rows and self._queued_rows + k > self.queue_bound:
+                self.shed_count += 1
+                raise LoadShed(
+                    "queue at %d/%d rows; request of %d rows shed"
+                    % (self._queued_rows, self.queue_bound, k)
+                )
+            pending = _Pending(rows, self.clock())
+            self._queue.append(pending)
+            self._queued_rows += k
+            self._wake.notify()
+        return Ticket(self, pending)
+
+    def _cancel(self, pending):
+        """Drop a still-queued request (timed-out Ticket.wait); no-op when
+        its batch was already taken by the dispatcher."""
+        with self._lock:
+            if pending in self._queue:
+                self._queue.remove(pending)
+                self._queued_rows -= pending.rows.shape[0]
+        pending.error = TimeoutError("request cancelled after wait timeout")
+        pending.event.set()
+
+    @property
+    def queue_depth(self):
+        """Queued rows awaiting dispatch (the backpressure signal)."""
+        with self._lock:
+            return self._queued_rows
+
+    def close(self, timeout=5.0):
+        """Stop the dispatcher; queued requests are failed, not served."""
+        with self._lock:
+            self._closed = True
+            leftovers, self._queue = self._queue, []
+            self._queued_rows = 0
+            self._wake.notify()
+        for pending in leftovers:
+            pending.error = RuntimeError("MicroBatcher closed")
+            pending.event.set()
+        self._thread.join(timeout)
+
+    # ------------------------------------------------------------------ #
+    # dispatcher side
+
+    def _take_batch(self):
+        """Block until a batch is due (deadline or cap), then pop it.
+        Returns None when closed."""
+        with self._lock:
+            while True:
+                if self._closed:
+                    return None
+                if self._queue:
+                    oldest = self._queue[0].enqueued_at
+                    due_at = oldest + self.max_latency_s
+                    rows_ready = sum(p.rows.shape[0] for p in self._queue)
+                    now = self.clock()
+                    if rows_ready >= self.max_batch or now >= due_at:
+                        break
+                    self._wake.wait(due_at - now)
+                else:
+                    self._wake.wait()
+            batch, used = [], 0
+            while self._queue and used + self._queue[0].rows.shape[0] <= self.max_batch:
+                pending = self._queue.pop(0)
+                used += pending.rows.shape[0]
+                batch.append(pending)
+            self._queued_rows -= used
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            rows = np.concatenate([p.rows for p in batch]) if len(batch) > 1 else batch[0].rows
+            started = self.clock()
+            try:
+                out = self.runner(rows)
+            except Exception as exc:  # surfaced per ticket, batcher survives
+                for pending in batch:
+                    pending.error = exc
+                    pending.event.set()
+                continue
+            k = rows.shape[0]
+            offset = 0
+            for pending in batch:
+                span = pending.rows.shape[0]
+                result = {}
+                for name, value in out.items():
+                    if (name not in self.shared_keys
+                            and isinstance(value, np.ndarray)
+                            and value.ndim >= 1 and value.shape[0] == k):
+                        result[name] = value[offset:offset + span]
+                    else:
+                        result[name] = value  # batch-shared extras
+                pending.result = result
+                offset += span
+                pending.event.set()
+            self.batch_count += 1
+            self.served_rows += k
+            self.last_occupancy = (k, self.max_batch)
+            if self.on_batch is not None:
+                self.on_batch(rows=k, requests=len(batch),
+                              latency_s=self.clock() - started, output=out)

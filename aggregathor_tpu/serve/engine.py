@@ -1,0 +1,266 @@
+"""Compiled inference engine: bucket-ladder batching + replicated robust vote.
+
+The serving counterpart of ``parallel/engine.py``: one jitted apply path per
+*bucket shape*, never per request.  Incoming batches are padded up to a fixed
+ladder of power-of-two bucket sizes, so after a warmup pass over the ladder
+steady-state serving triggers **zero recompiles** — the same discipline as
+chaos' zero-recompile regime scheduler, asserted the same way (the jit cache
+size is the compile count, ``compile_count``).
+
+Byzantine robustness transfers from training to serving: with ``R`` replica
+parameter sets (distinct checkpoints, or copies of one), every bucket runs
+through all R replicas (``vmap`` over a stacked leading axis) and the
+``(R, batch, classes)`` replica logits are reduced by a coordinate-wise GAR
+(``gars/``) exactly as the training engine reduces the ``(n, d)`` gradient
+matrix — replicas are workers, logit coordinates are gradient coordinates.
+The NaN-last ordering convention carries over verbatim: a crashed replica
+whose logits read NaN is absorbed by ``median`` (R >= 2f+1 replicas mask f
+faulty ones), while plain ``average`` is poisoned — the serving-side
+restatement of the AggregaThor thesis.  Per-replica **disagreement scores**
+(mean squared deviation from the voted logits over the valid rows; non-finite
+deviations read +inf) are surfaced per batch for quarantine-style flagging.
+"""
+
+import warnings
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import UserException, info
+
+
+def _quiet_dispatch(fn, *args):
+    """Call the jitted forward with the 'donated buffers were not usable'
+    UserWarning silenced: the padded input is donated for the TPU path
+    (where logits can alias its pages); XLA:CPU declines the donation and
+    would otherwise warn once per bucket shape, per process."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        return fn(*args)
+
+
+def bucket_ladder(max_batch, min_bucket=1):
+    """The power-of-two bucket ladder covering batch sizes up to ``max_batch``.
+
+    ``(min_bucket, 2*min_bucket, ..., max_batch)`` — ``max_batch`` is rounded
+    UP to the next power of two so every request size <= max_batch has a
+    bucket.  A fixed ladder bounds the compile count at ``log2(max_batch)``
+    executables while wasting at most half of any bucket's rows on padding.
+    """
+    max_batch, min_bucket = int(max_batch), int(min_bucket)
+    if max_batch < 1 or min_bucket < 1:
+        raise UserException(
+            "bucket ladder wants positive sizes (max_batch=%d, min_bucket=%d)"
+            % (max_batch, min_bucket)
+        )
+    ladder = []
+    size = 1
+    while size < min_bucket:
+        size *= 2
+    while True:
+        ladder.append(size)
+        if size >= max_batch:
+            return tuple(ladder)
+        size *= 2
+
+
+def choose_bucket(nb_rows, buckets):
+    """Smallest bucket holding ``nb_rows`` rows, or None when none fits.
+
+    ``buckets`` must be sorted ascending (``InferenceEngine`` guarantees it).
+    """
+    for bucket in buckets:
+        if bucket >= nb_rows:
+            return bucket
+    return None
+
+
+def restore_params(experiment, directory, tx, step=None, seed=0,
+                   base_name=None, authenticator=None, cipher=None,
+                   allow_legacy_tags=True):
+    """Restore a trained checkpoint's parameters for serving.
+
+    Deserializes into a freshly-initialized host-side :class:`TrainState`
+    template (so shape/dtype mismatches fail loudly, same restore discipline
+    as training) and returns ``(params, step)``.  ``tx`` must match the
+    optimizer the checkpoint was trained with — the snapshot serializes the
+    optimizer state, and a mismatched treedef fails at deserialization
+    instead of silently seeding garbage.  ``authenticator``/``cipher`` honor
+    the training-side checkpoint authentication and at-rest encryption
+    (``obs/checkpoint.py``).
+    """
+    from .. import config
+    from ..core.train_state import TrainState
+    from ..obs import Checkpoints
+
+    params = experiment.init(jax.random.PRNGKey(seed))
+    template = jax.device_get(
+        TrainState.create(params, tx, rng=jax.random.PRNGKey(seed))
+    )
+    checkpoints = Checkpoints(
+        directory,
+        base_name if base_name is not None else config.default_checkpoint_base_name,
+        authenticator=authenticator,
+        cipher=cipher,
+        allow_legacy_tags=allow_legacy_tags,
+    )
+    state, at_step = checkpoints.restore(template, step=step)
+    return state.params, at_step
+
+
+class InferenceEngine:
+    """Checkpoint-to-predictions apply path with R-way robust replication.
+
+    Args:
+      experiment: a ``models`` Experiment instance — ``predict_logits`` is
+        the apply path that gets jitted; ``sample_shape`` validates inputs.
+      replicas: list of R parameter pytrees (R >= 1).  All replicas must
+        share one treedef/shape (copies or same-topology checkpoints).
+      gar: a ``gars`` GAR *instance* over ``nb_workers == R`` (or None for
+        single-replica serving / plain first-replica logits).  Coordinate-
+        wise rules (median, average-nan, trimmed-mean) are the natural fit;
+        any registered rule whose (n, f) check admits R replicas works.
+      max_batch: largest servable batch; also the ladder top when
+        ``buckets`` is not given.
+      buckets: explicit bucket ladder (sorted ascending after normalization);
+        default ``bucket_ladder(max_batch)``.
+      seed: key for randomized meta-rules (``uses_key`` GARs draw a FIXED
+        per-engine key — serving is deterministic, unlike training's
+        per-step re-draw).
+
+    The padded input buffer is donated to the jit — it is rebuilt per call,
+    so the device may reuse its pages for the logits.
+    """
+
+    def __init__(self, experiment, replicas, gar=None, max_batch=64,
+                 buckets=None, seed=0):
+        if not replicas:
+            raise UserException("InferenceEngine needs at least one replica")
+        self.experiment = experiment
+        self.nb_replicas = len(replicas)
+        self.gar = gar
+        if gar is not None and gar.nb_workers != self.nb_replicas:
+            raise UserException(
+                "GAR %s aggregates %d workers but %d replicas are loaded"
+                % (type(gar).__name__, gar.nb_workers, self.nb_replicas)
+            )
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (buckets if buckets else bucket_ladder(max_batch))
+        )))
+        if not self.buckets or self.buckets[0] < 1:
+            raise UserException("Bucket ladder must hold positive sizes: %r" % (self.buckets,))
+        self.sample_shape = tuple(experiment.sample_shape)
+        # One stacked (R, ...) pytree: vmap's in_axes=0 runs every replica
+        # through the same compiled forward — R is a *shape*, not a loop.
+        self._params = jax.device_put(jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *replicas
+        ))
+        self._vote_key = jax.random.PRNGKey(seed)
+        apply_fn = experiment.predict_logits
+
+        def forward(params_stack, x, nb_valid, key):
+            logits = jax.vmap(apply_fn, in_axes=(0, None))(params_stack, x)
+            logits = logits.astype(jnp.float32)  # GAR math in f32, like training
+            nb_r, bucket = logits.shape[0], logits.shape[1]
+            flat = logits.reshape((nb_r, -1))
+            if self.gar is None or nb_r == 1:
+                voted = flat[0]
+            else:
+                voted = self.gar.aggregate(flat, key=key)
+            # Disagreement over the VALID rows only: padding rows are zeros,
+            # whose logits would dilute (never inflate) a faulty replica's
+            # score.  Non-finite deviation = maximal disagreement (+inf), so
+            # a NaN replica is flagged, not averaged away.
+            row_valid = jax.lax.broadcasted_iota(jnp.int32, (bucket,), 0) < nb_valid
+            coord_valid = jnp.repeat(row_valid, flat.shape[1] // bucket)
+            deviation = (flat - voted[None, :]) ** 2
+            deviation = jnp.where(jnp.isfinite(deviation), deviation, jnp.inf)
+            masked = jnp.where(coord_valid[None, :], deviation, 0.0)
+            denom = jnp.maximum(nb_valid * (flat.shape[1] // bucket), 1).astype(jnp.float32)
+            disagreement = jnp.sum(masked, axis=1) / denom
+            voted = voted.reshape(logits.shape[1:])
+            return jnp.argmax(voted, axis=-1), voted, disagreement
+
+        self._fn = jax.jit(forward, donate_argnums=(1,))
+
+    @property
+    def compile_count(self):
+        """Executables compiled so far — one per distinct bucket shape.  The
+        zero-recompile contract: after ``warmup()`` this equals
+        ``len(self.buckets)`` and never grows in steady state (asserted by
+        tests/test_serve.py)."""
+        return int(self._fn._cache_size())
+
+    def warmup(self):
+        """Compile every ladder bucket up front (zeros input), so the first
+        real request never pays a compile.  Returns the compile count."""
+        for bucket in self.buckets:
+            pad = jnp.zeros((bucket,) + self.sample_shape, jnp.float32)
+            jax.block_until_ready(_quiet_dispatch(
+                self._fn, self._params, pad, jnp.int32(bucket), self._vote_key
+            ))
+        info(
+            "Inference warmup: %d bucket(s) %r compiled, %d replica(s), vote=%s"
+            % (len(self.buckets), list(self.buckets), self.nb_replicas,
+               type(self.gar).__name__ if self.gar else "none")
+        )
+        return self.compile_count
+
+    def _run_bucket(self, rows):
+        bucket = choose_bucket(rows.shape[0], self.buckets)
+        # Pad HOST-side: one array and one host->device transfer per call,
+        # instead of a device zeros allocation plus a scatter update — the
+        # padding cost matters at the small buckets where it dominates the
+        # forward.  The transferred buffer is the donated jit argument.
+        pad = np.zeros((bucket,) + self.sample_shape, np.float32)
+        pad[: rows.shape[0]] = rows
+        preds, logits, disagreement = _quiet_dispatch(
+            self._fn, self._params, jnp.asarray(pad), jnp.int32(rows.shape[0]),
+            self._vote_key,
+        )
+        n = rows.shape[0]
+        return (
+            np.asarray(jax.device_get(preds))[:n],
+            np.asarray(jax.device_get(logits))[:n],
+            np.asarray(jax.device_get(disagreement)),
+            bucket,
+        )
+
+    def predict(self, x):
+        """Serve a batch: ``(n, *sample_shape)`` -> dict with ``predictions``
+        (n,) int labels, ``logits`` (n, classes) voted logits,
+        ``disagreement`` (R,) per-replica scores (rows-weighted over chunks),
+        and ``bucket`` (the last bucket used).  Requests beyond the ladder
+        top are chunked at the largest bucket.
+        """
+        x = np.asarray(x, np.float32)
+        if x.ndim == len(self.sample_shape):  # single sample convenience
+            x = x[None]
+        if tuple(x.shape[1:]) != self.sample_shape:
+            raise UserException(
+                "Input shape %r does not match the experiment's sample shape %r"
+                % (tuple(x.shape[1:]), self.sample_shape)
+            )
+        if x.shape[0] == 0:
+            raise UserException("Empty inference batch")
+        top = self.buckets[-1]
+        preds, logits, scores, weights, bucket = [], [], [], [], None
+        for start in range(0, x.shape[0], top):
+            chunk = x[start:start + top]
+            p, l, d, bucket = self._run_bucket(chunk)
+            preds.append(p)
+            logits.append(l)
+            scores.append(d)
+            weights.append(chunk.shape[0])
+        total = float(sum(weights))
+        disagreement = sum(s * (w / total) for s, w in zip(scores, weights))
+        return {
+            "predictions": np.concatenate(preds),
+            "logits": np.concatenate(logits),
+            "disagreement": np.asarray(disagreement),
+            "bucket": bucket,
+        }

@@ -1,0 +1,267 @@
+"""Stdlib HTTP serving front end: ``/predict``, ``/healthz``, ``/metrics``.
+
+A ``ThreadingHTTPServer`` (one handler thread per connection — the handler
+threads only parse/serialize JSON and block on the micro-batcher ticket, so
+the GIL is irrelevant: all compute happens in the batcher's single dispatch
+thread, inside XLA) in front of :class:`serve.batcher.MicroBatcher` in front
+of :class:`serve.engine.InferenceEngine`.
+
+- ``POST /predict``  body ``{"inputs": [[...], ...]}`` (rows shaped like the
+  experiment's ``sample_shape``, or flat row vectors of the same size) ->
+  ``{"predictions": [...], "disagreement": [...], "bucket": B}``;
+  ``429`` + ``{"error": "shed", ...}`` under load-shedding, ``400`` on
+  malformed input.
+- ``GET /healthz``   liveness + replica summary (suspect replicas flagged
+  from the latest disagreement scores).
+- ``GET /metrics``   JSON gauge snapshot: queue depth, batch occupancy,
+  request p50/p95/p99 (``obs.perf.LatencyHistogram``), shed/served counts,
+  per-replica disagreement, compile count.
+
+Observability flows through ``obs/summaries.SummaryWriter`` when a summary
+directory is configured: one tagged ``serve_batch`` event per dispatched
+batch and one ``serve_shed`` event per rejected request — the same JSONL
+stream the training loop writes, so one tail follows both phases.
+"""
+
+import json
+import threading
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..obs import LatencyHistogram
+from ..utils import UserException, info
+from .batcher import LoadShed, MicroBatcher
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "aggregathor-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # the metrics endpoint replaces stderr chatter
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, self.server.health_payload())
+        elif self.path == "/metrics":
+            self._reply(200, self.server.metrics_payload())
+        else:
+            self._reply(404, {"error": "unknown path %r" % self.path})
+
+    def do_POST(self):
+        # Drain the body FIRST, before any reply: under HTTP/1.1 keep-alive
+        # an unread body would be parsed as the next request line, desyncing
+        # the connection for whatever the client sends next.
+        body = self.rfile.read(int(self.headers.get("Content-Length", "0")))
+        if self.path != "/predict":
+            self._reply(404, {"error": "unknown path %r" % self.path})
+            return
+        started = self.server.clock()
+        try:
+            request = json.loads(body or b"{}")
+            rows = self.server.parse_inputs(request)
+        except (ValueError, TypeError, UserException) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        try:
+            ticket = self.server.batcher.submit(rows)
+        except LoadShed as exc:
+            self.server.note_shed(rows.shape[0], str(exc))
+            self._reply(429, {"error": "shed", "detail": str(exc)})
+            return
+        except (ValueError, RuntimeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        try:
+            result = ticket.wait(self.server.request_timeout_s)
+        except TimeoutError as exc:
+            self._reply(504, {"error": str(exc)})
+            return
+        except Exception as exc:  # inference failure: surfaced, server lives
+            self._reply(500, {"error": str(exc)})
+            return
+        self.server.latency.record(self.server.clock() - started)
+        self._reply(200, {
+            "predictions": [int(p) for p in result["predictions"]],
+            "disagreement": [_jsonable(v) for v in np.atleast_1d(result["disagreement"])],
+            "bucket": int(result["bucket"]),
+        })
+
+
+def _jsonable(value):
+    value = float(value)
+    return value if np.isfinite(value) else None  # strict JSON: inf/NaN -> null
+
+
+class InferenceServer(ThreadingHTTPServer):
+    """The serving process: HTTP front end + micro-batcher + engine.
+
+    ``port=0`` binds an ephemeral port (read ``server_address[1]`` after
+    construction — the smoke script's ready-file does).  ``summaries`` is an
+    optional ``SummaryWriter``; ``flag_threshold`` marks a replica suspect
+    when its latest disagreement exceeds it (non-finite scores are always
+    suspect).
+    """
+
+    daemon_threads = True
+
+    def __init__(self, engine, host="127.0.0.1", port=0, max_latency_s=0.010,
+                 queue_bound=256, summaries=None, request_timeout_s=60.0,
+                 flag_threshold=None, clock=None):
+        import time
+
+        super().__init__((host, int(port)), _Handler)
+        self.engine = engine
+        self.clock = clock if clock is not None else time.monotonic
+        self.summaries = summaries
+        self.request_timeout_s = float(request_timeout_s)
+        self.flag_threshold = flag_threshold
+        self.latency = LatencyHistogram()
+        self.shed_rows = 0
+        self._event_lock = threading.Lock()
+        self._last_disagreement = [0.0] * engine.nb_replicas
+        self.batcher = MicroBatcher(
+            engine.predict,
+            max_latency_s=max_latency_s,
+            max_batch=engine.buckets[-1],
+            queue_bound=queue_bound,
+            on_batch=self._on_batch,
+        )
+        self._serve_thread = None
+
+    # ------------------------------------------------------------------ #
+    # request plumbing
+
+    def parse_inputs(self, request):
+        """``{"inputs": [...]}`` -> (k, *sample_shape) float32 rows.  Rows may
+        arrive shaped or flattened; both forms are reshaped and validated
+        against the experiment's sample shape."""
+        inputs = request.get("inputs")
+        if inputs is None:
+            raise UserException('Request body wants {"inputs": [[...], ...]}')
+        rows = np.asarray(inputs, np.float32)
+        shape = self.engine.sample_shape
+        if rows.ndim == 1:  # one flat sample
+            rows = rows[None]
+        if rows.ndim == 2 and rows.shape[1] == int(np.prod(shape)):
+            rows = rows.reshape((rows.shape[0],) + shape)
+        if rows.ndim == len(shape):  # one shaped sample
+            rows = rows[None]
+        if rows.ndim != len(shape) + 1 or tuple(rows.shape[1:]) != shape:
+            raise UserException(
+                "Input rows of shape %r do not match sample shape %r (flat %d also accepted)"
+                % (tuple(rows.shape[1:]), shape, int(np.prod(shape)))
+            )
+        return rows
+
+    def _on_batch(self, rows, requests, latency_s, output):
+        disagreement = np.atleast_1d(np.asarray(output.get("disagreement", [])))
+        with self._event_lock:
+            if disagreement.size == self.engine.nb_replicas:
+                self._last_disagreement = [float(v) for v in disagreement]
+        if self.summaries is not None:
+            self.summaries.event(self.batcher.batch_count, "serve_batch", {
+                "rows": int(rows),
+                "requests": int(requests),
+                "bucket": int(output.get("bucket", 0)),
+                "batch_latency_ms": float(latency_s) * 1e3,
+                "disagreement": [_jsonable(v) for v in disagreement],
+            })
+
+    def note_shed(self, rows, detail):
+        with self._event_lock:
+            self.shed_rows += int(rows)
+        if self.summaries is not None:
+            self.summaries.event(self.batcher.batch_count, "serve_shed", {
+                "rows": int(rows),
+                "queue_depth": self.batcher.queue_depth,
+                "detail": detail,
+            })
+
+    # ------------------------------------------------------------------ #
+    # introspection payloads
+
+    def suspect_replicas(self):
+        """Replica indices whose latest disagreement flags them: non-finite
+        always; above ``flag_threshold`` when one is configured."""
+        with self._event_lock:
+            scores = list(self._last_disagreement)
+        suspects = []
+        for index, score in enumerate(scores):
+            if not np.isfinite(score):
+                suspects.append(index)
+            elif self.flag_threshold is not None and score > self.flag_threshold:
+                suspects.append(index)
+        return suspects
+
+    def health_payload(self):
+        return {
+            "status": "ok",
+            "replicas": self.engine.nb_replicas,
+            "vote": type(self.engine.gar).__name__ if self.engine.gar else None,
+            "buckets": list(self.engine.buckets),
+            "suspect_replicas": self.suspect_replicas(),
+        }
+
+    def metrics_payload(self):
+        tail = self.latency.percentiles()
+        occupancy_rows, occupancy_cap = self.batcher.last_occupancy
+        with self._event_lock:
+            disagreement = [_jsonable(v) for v in self._last_disagreement]
+            shed_rows = self.shed_rows
+        return {
+            "queue_depth": self.batcher.queue_depth,
+            "queue_bound": self.batcher.queue_bound,
+            "batch_count": self.batcher.batch_count,
+            "served_rows": self.batcher.served_rows,
+            "shed_count": self.batcher.shed_count,
+            "shed_rows": shed_rows,
+            "batch_occupancy": {
+                "rows": occupancy_rows, "cap": occupancy_cap,
+                "fill": (occupancy_rows / occupancy_cap) if occupancy_cap else 0.0,
+            },
+            "latency_ms": {
+                name: (tail[name] * 1e3 if tail else None)
+                for name, _ in LatencyHistogram.POINTS
+            },
+            "request_count": self.latency.count,
+            "per_replica_disagreement": disagreement,
+            "suspect_replicas": self.suspect_replicas(),
+            "compile_count": self.engine.compile_count,
+            "nb_buckets": len(self.engine.buckets),
+        }
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+
+    def serve_background(self):
+        """Run ``serve_forever`` on a daemon thread; returns (host, port)."""
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="serve-http"
+        )
+        self._serve_thread.start()
+        host, port = self.server_address[:2]
+        info("Serving on http://%s:%d (replicas=%d, vote=%s, buckets=%r)"
+             % (host, port, self.engine.nb_replicas,
+                type(self.engine.gar).__name__ if self.engine.gar else "none",
+                list(self.engine.buckets)))
+        return host, port
+
+    def shutdown_all(self):
+        """Stop the HTTP loop and the batcher (idempotent)."""
+        self.shutdown()
+        self.server_close()
+        self.batcher.close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5.0)
+            self._serve_thread = None

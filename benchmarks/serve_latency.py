@@ -1,0 +1,123 @@
+"""Serving latency/throughput profile: per bucket size and replica count.
+
+Measures the compiled inference path (``serve/engine.InferenceEngine``)
+exactly as the server drives it: padded bucket-shaped batches through the
+R-way replicated robust vote.  For every (bucket, replicas) cell it reports
+compile time (one-off), p50/p95/p99 per-call latency (obs.perf
+.LatencyHistogram over ``--reps`` timed calls) and rows/s throughput —
+the capacity-planning numbers behind the batcher's deadline/bucket knobs
+(docs/serving.md).
+
+Usage::
+
+    python benchmarks/serve_latency.py [--experiment digits]
+        [--buckets 1,8,64] [--replicas 1,3,5] [--gar median] [--reps 30]
+        [--output profile.json]
+
+Prints one human table row and one machine-readable JSON line per cell
+(schema ``aggregathor.serve.latency-profile.v1``); ``--output`` additionally
+writes the whole profile as one JSON document.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "aggregathor.serve.latency-profile.v1"
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description="serving latency/throughput per bucket x replicas")
+    parser.add_argument("--experiment", default="digits", help="experiment name (models registry)")
+    parser.add_argument("--experiment-args", nargs="*", default=[], help="key:value experiment arguments")
+    parser.add_argument("--buckets", default="1,8,64", help="comma-separated bucket sizes")
+    parser.add_argument("--replicas", default="1,3", help="comma-separated replica counts")
+    parser.add_argument("--gar", default="median", help="vote rule for R > 1 (gars registry)")
+    parser.add_argument("--reps", type=int, default=30, help="timed calls per cell")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--output", default=None, metavar="JSON", help="write the full profile here")
+    parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.obs import LatencyHistogram
+    from aggregathor_tpu.serve import InferenceEngine
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    replica_counts = [int(r) for r in args.replicas.split(",")]
+    experiment = models.instantiate(args.experiment, args.experiment_args)
+    params = jax.device_get(experiment.init(jax.random.PRNGKey(args.seed)))
+    rng = np.random.default_rng(args.seed)
+
+    platform = jax.devices()[0].platform
+    cells = []
+    print("%-8s %-4s %-8s %14s %10s %10s %10s %12s"
+          % ("bucket", "R", "vote", "ladder_comp_s", "p50_ms", "p95_ms", "p99_ms", "rows/s"))
+    for nb_replicas in replica_counts:
+        vote = (
+            gars.instantiate(args.gar, nb_replicas, (nb_replicas - 1) // 2)
+            if nb_replicas > 1 else None
+        )
+        engine = InferenceEngine(
+            experiment, [params] * nb_replicas, gar=vote,
+            buckets=buckets, seed=args.seed,
+        )
+        compile_t0 = time.perf_counter()
+        engine.warmup()
+        compile_s = time.perf_counter() - compile_t0
+        for bucket in buckets:
+            x = rng.random((bucket,) + engine.sample_shape, np.float32)
+            hist = LatencyHistogram()
+            engine.predict(x)  # steady-state: warm cache, warm data path
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                engine.predict(x)
+                hist.record(time.perf_counter() - t0)
+            tail = hist.percentiles()
+            throughput = bucket / max(tail["p50"], 1e-9)
+            cell = {
+                "schema": SCHEMA,
+                "experiment": args.experiment,
+                "platform": platform,
+                "bucket": bucket,
+                "replicas": nb_replicas,
+                "gar": args.gar if nb_replicas > 1 else None,
+                # whole-LADDER warmup time for this replica count (one-off,
+                # shared by every bucket row of the same R — NOT per bucket)
+                "ladder_compile_s": round(compile_s, 4),
+                "p50_ms": round(tail["p50"] * 1e3, 4),
+                "p95_ms": round(tail["p95"] * 1e3, 4),
+                "p99_ms": round(tail["p99"] * 1e3, 4),
+                "rows_per_s": round(throughput, 2),
+                "reps": args.reps,
+            }
+            cells.append(cell)
+            print("%-8d %-4d %-8s %14.3f %10.3f %10.3f %10.3f %12.1f"
+                  % (bucket, nb_replicas, cell["gar"] or "-", compile_s,
+                     cell["p50_ms"], cell["p95_ms"], cell["p99_ms"], throughput))
+            print(json.dumps(cell))
+    if args.output:
+        with open(args.output, "w") as fd:
+            json.dump({"schema": SCHEMA, "cells": cells}, fd, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

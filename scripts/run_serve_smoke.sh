@@ -1,0 +1,132 @@
+#!/usr/bin/env bash
+# Serving smoke on CPU (<60 s): train a tiny digits model through the real
+# CLI runner, serve it with 3 replicas (one NaN-poisoned via the chaos
+# tie-in) under the median vote, fire concurrent clients, and assert
+# /healthz, a finite p95, a nonzero shed count under burst, and
+# fault-masked predictions (served == clean baseline).  CI-sized version of
+# docs/serving.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_serve_smoke}"
+rm -rf "$out"
+mkdir -p "$out"
+
+# ---- 1. train -> checkpoint (the model the server will load)
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 4 --nb-devices 1 \
+  --max-step 40 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/ckpt" --checkpoint-delta 20 --checkpoint-period -1 \
+  --summary-delta -1 --summary-period -1
+
+# ---- 2. serve it: 3 replicas, replica 2 NaN-poisoned, median vote.
+# Tiny queue bound + slow deadline make the burst phase shed deterministically.
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.serve \
+  --experiment digits --experiment-args batch-size:16 \
+  --ckpt-dir "$out/ckpt" --replicas 3 --gar median --poison-replica 2:nan \
+  --port 0 --ready-file "$out/ready" --summary-dir "$out/sum" \
+  --max-batch 8 --max-latency-ms 100 --queue-bound 4 &
+server_pid=$!
+trap 'kill "$server_pid" 2>/dev/null || true' EXIT
+
+for _ in $(seq 1 60); do [ -f "$out/ready" ] && break; sleep 1; done
+[ -f "$out/ready" ] || { echo "server never became ready"; exit 1; }
+
+# ---- 3. concurrent clients: burst (sheds) then calm (fault-masked answers)
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import json, sys, threading, urllib.error, urllib.request
+
+import numpy as np
+
+out = sys.argv[1]
+host, port, _pid = open("%s/ready" % out).read().split()
+base = "http://%s:%s" % (host, port)
+
+def post(payload):
+    req = urllib.request.Request(base + "/predict", data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.loads(r.read())
+
+# the clean baseline the poisoned server must match (median masks the NaN)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from aggregathor_tpu import models
+from aggregathor_tpu.core import build_optimizer, build_schedule
+from aggregathor_tpu.serve import InferenceEngine, restore_params
+
+experiment = models.instantiate("digits", ["batch-size:16"])
+tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.01"]))
+params, step = restore_params(experiment, "%s/ckpt" % out, tx)
+x = np.asarray(experiment.dataset.x_test[:8], np.float32)
+clean = InferenceEngine(experiment, [params], max_batch=8).predict(x)["predictions"]
+
+health = get("/healthz")
+assert health["status"] == "ok", health
+assert health["replicas"] == 3, health
+
+# burst: 24 concurrent single-row posts against queue bound 4 -> sheds
+codes = []
+lock = threading.Lock()
+row = x[0].tolist()
+def fire():
+    code, _ = post({"inputs": [row]})
+    with lock:
+        codes.append(code)
+threads = [threading.Thread(target=fire) for _ in range(24)]
+for t in threads: t.start()
+for t in threads: t.join()
+assert set(codes) <= {200, 429}, sorted(set(codes))
+assert 429 in codes, "burst produced no shed (codes: %r)" % sorted(set(codes))
+
+# calm phase: sequential requests all succeed with FAULT-MASKED predictions
+code, served = post({"inputs": x.tolist()})
+assert code == 200, (code, served)
+assert served["predictions"] == [int(p) for p in clean], (
+    "served predictions diverge from the clean baseline: %r vs %r"
+    % (served["predictions"], list(clean)))
+assert served["disagreement"][2] is None, served  # NaN replica -> null (inf)
+
+metrics = get("/metrics")
+assert metrics["shed_count"] > 0, metrics
+p95 = metrics["latency_ms"]["p95"]
+assert p95 is not None and np.isfinite(p95), metrics
+assert metrics["suspect_replicas"] == [2], metrics
+assert metrics["compile_count"] == metrics["nb_buckets"], metrics  # zero steady-state recompiles
+print("serve smoke OK: step-%s checkpoint, %d sheds under burst, p95=%.1f ms, "
+      "poisoned replica masked + flagged" % (step, metrics["shed_count"], p95))
+EOF
+
+# ---- 4. graceful shutdown (SIGTERM must not wedge the serve loop)
+kill "$server_pid"
+for _ in $(seq 1 20); do kill -0 "$server_pid" 2>/dev/null || break; sleep 0.5; done
+if kill -0 "$server_pid" 2>/dev/null; then
+  echo "server ignored SIGTERM"; kill -9 "$server_pid"; exit 1
+fi
+trap - EXIT
+
+# the summary stream carries the serve events
+python - "$out/sum" <<'EOF'
+import json, os, sys
+sum_dir = sys.argv[1]
+events = [json.loads(line)
+          for name in os.listdir(sum_dir)
+          for line in open(os.path.join(sum_dir, name))]
+batches = [e for e in events if e.get("event") == "serve_batch"]
+sheds = [e for e in events if e.get("event") == "serve_shed"]
+assert batches, "no serve_batch summary events"
+assert sheds, "no serve_shed summary events"
+print("summary stream OK: %d serve_batch + %d serve_shed event(s)"
+      % (len(batches), len(sheds)))
+EOF
+
+echo "serve smoke PASSED"

@@ -1,0 +1,477 @@
+"""serve/ tests: bucket ladder, deadline batching, load-shedding, replica
+vote fault-masking, zero-recompile steady state, and the end-to-end
+train -> checkpoint -> HTTP serve round trip on the digits experiment."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars, models
+from aggregathor_tpu.chaos import corrupt_params, parse_poison
+from aggregathor_tpu.obs import LatencyHistogram
+from aggregathor_tpu.serve import (
+    InferenceEngine,
+    InferenceServer,
+    LoadShed,
+    MicroBatcher,
+    bucket_ladder,
+    choose_bucket,
+)
+from aggregathor_tpu.utils import UserException
+
+
+# --------------------------------------------------------------------- #
+# bucket ladder
+
+
+def test_bucket_ladder_powers_of_two():
+    assert bucket_ladder(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_ladder(1) == (1,)
+    # top rounded UP so every size <= max_batch has a bucket
+    assert bucket_ladder(48) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_ladder(64, min_bucket=8) == (8, 16, 32, 64)
+    with pytest.raises(UserException):
+        bucket_ladder(0)
+
+
+def test_choose_bucket_smallest_fit():
+    buckets = (1, 2, 4, 8)
+    assert choose_bucket(1, buckets) == 1
+    assert choose_bucket(3, buckets) == 4
+    assert choose_bucket(8, buckets) == 8
+    assert choose_bucket(9, buckets) is None  # beyond the ladder: caller chunks
+
+
+# --------------------------------------------------------------------- #
+# latency histogram (obs/perf.py — shared by PerfReport and /metrics)
+
+
+def test_latency_histogram_percentiles_and_bound():
+    hist = LatencyHistogram(capacity=100)
+    assert hist.percentiles() is None
+    for value in range(1, 1001):  # 1..1000 ms
+        hist.record(value / 1e3)
+    tail = hist.percentiles()
+    assert hist.count == 1000
+    assert len(hist._samples) <= 100  # bounded reservoir
+    assert tail["p50"] <= tail["p95"] <= tail["p99"] <= 1.0
+    # uniform 1..1000ms: the reservoir median must land mid-range
+    assert 0.2 < tail["p50"] < 0.8
+    assert tail["p95"] > 0.5
+
+
+def test_latency_histogram_small_sample_degrades_to_max():
+    hist = LatencyHistogram()
+    hist.record(0.010)
+    hist.record(0.020)
+    tail = hist.percentiles()
+    assert tail["p99"] == 0.020
+
+
+# --------------------------------------------------------------------- #
+# micro-batcher (engine-agnostic: fake runners)
+
+
+def _echo_runner(log=None):
+    def run(rows):
+        if log is not None:
+            log.append(rows.shape[0])
+        return {
+            "predictions": np.arange(rows.shape[0]),
+            "disagreement": np.array([0.0, 0.0]),
+            "bucket": 8,
+        }
+    return run
+
+
+def test_batcher_deadline_flushes_partial_batch():
+    """A lone sub-cap request is dispatched at the deadline, not held for a
+    full batch."""
+    sizes = []
+    batcher = MicroBatcher(_echo_runner(sizes), max_latency_s=0.10, max_batch=8,
+                           queue_bound=64)
+    try:
+        started = time.monotonic()
+        ticket = batcher.submit(np.zeros((2, 4)))
+        result = ticket.wait(5.0)
+        waited = time.monotonic() - started
+        assert sizes == [2]
+        assert list(result["predictions"]) == [0, 1]
+        assert waited >= 0.08, "dispatched before the deadline with no cap pressure"
+        assert waited < 2.0
+    finally:
+        batcher.close()
+
+
+def test_batcher_cap_dispatches_before_deadline():
+    """Reaching max_batch dispatches immediately — a full bucket gains
+    nothing by waiting for a distant deadline."""
+    sizes = []
+    batcher = MicroBatcher(_echo_runner(sizes), max_latency_s=30.0, max_batch=4,
+                           queue_bound=64)
+    try:
+        tickets = [batcher.submit(np.zeros((1, 4))) for _ in range(4)]
+        for ticket in tickets:
+            ticket.wait(5.0)  # would TimeoutError if held until the deadline
+        assert sum(sizes) == 4
+    finally:
+        batcher.close()
+
+
+def test_batcher_splits_results_per_request_with_shared_extras():
+    batcher = MicroBatcher(_echo_runner(), max_latency_s=0.02, max_batch=8,
+                           queue_bound=64)
+    try:
+        t1 = batcher.submit(np.zeros((2, 4)))
+        t2 = batcher.submit(np.zeros((1, 4)))
+        r1, r2 = t1.wait(5.0), t2.wait(5.0)
+        # per-row outputs split by request...
+        assert r1["predictions"].shape == (2,) and r2["predictions"].shape == (1,)
+        # ...shared extras broadcast intact, even when their length could
+        # collide with a row count (disagreement has length 2 here)
+        assert r1["disagreement"].shape == (2,) and r2["disagreement"].shape == (2,)
+        assert r1["bucket"] == r2["bucket"] == 8
+    finally:
+        batcher.close()
+
+
+def test_batcher_load_shed_under_overload():
+    """Once queued rows pass the bound, submit fails fast with LoadShed
+    (429), and the queue drains correctly afterwards."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_runner(rows):
+        entered.set()
+        release.wait(10.0)
+        return {"predictions": np.arange(rows.shape[0])}
+
+    batcher = MicroBatcher(slow_runner, max_latency_s=0.0, max_batch=4,
+                           queue_bound=4)
+    try:
+        first = batcher.submit(np.zeros((1, 4)))
+        assert entered.wait(5.0)  # dispatcher is now wedged inside the runner
+        held = [batcher.submit(np.zeros((1, 4))) for _ in range(4)]
+        assert batcher.queue_depth == 4
+        with pytest.raises(LoadShed):
+            batcher.submit(np.zeros((1, 4)))
+        assert batcher.shed_count == 1
+        release.set()
+        for ticket in [first] + held:
+            ticket.wait(10.0)
+        assert batcher.queue_depth == 0
+        assert batcher.served_rows == 5
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_batcher_timeout_cancels_queued_request():
+    """A ticket whose wait times out is REMOVED from the queue: the engine
+    never runs dead work for a caller that already got its 504."""
+    release = threading.Event()
+    entered = threading.Event()
+    sizes = []
+
+    def slow_runner(rows):
+        entered.set()
+        release.wait(10.0)
+        sizes.append(rows.shape[0])
+        return {"predictions": np.arange(rows.shape[0])}
+
+    batcher = MicroBatcher(slow_runner, max_latency_s=0.0, max_batch=4,
+                           queue_bound=8)
+    try:
+        first = batcher.submit(np.zeros((1, 4)))
+        assert entered.wait(5.0)  # dispatcher wedged in the runner
+        doomed = batcher.submit(np.zeros((2, 4)))
+        with pytest.raises(TimeoutError):
+            doomed.wait(0.05)
+        assert batcher.queue_depth == 0  # cancelled rows left the queue
+        survivor = batcher.submit(np.zeros((1, 4)))
+        release.set()
+        first.wait(10.0)
+        survivor.wait(10.0)
+        assert sizes == [1, 1], "cancelled rows were still dispatched"
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_batcher_rejects_oversized_and_closed():
+    batcher = MicroBatcher(_echo_runner(), max_latency_s=0.0, max_batch=4,
+                           queue_bound=64)
+    with pytest.raises(ValueError):
+        batcher.submit(np.zeros((5, 4)))  # request larger than any batch
+    batcher.close()
+    with pytest.raises(RuntimeError):
+        batcher.submit(np.zeros((1, 4)))
+
+
+# --------------------------------------------------------------------- #
+# replica faults (chaos/replica_faults.py)
+
+
+def test_parse_poison_specs():
+    assert parse_poison("1:nan") == (1, "nan", None)
+    assert parse_poison("2:scale=50") == (2, "scale", 50.0)
+    assert parse_poison("0:scale") == (0, "scale", 100.0)  # default knob
+    assert parse_poison("0:stale") == (0, "stale", None)
+    for bad in ("nan", "x:nan", "-1:nan", "0:bogus", "0:nan=3", "0:scale=x"):
+        with pytest.raises(UserException):
+            parse_poison(bad)
+
+
+def test_corrupt_params_modes():
+    params = {"w": np.ones((3, 2), np.float32), "b": np.zeros((2,), np.float32)}
+    nan = corrupt_params(params, "nan")
+    assert np.all(np.isnan(nan["w"])) and np.all(np.isnan(nan["b"]))
+    scaled = corrupt_params(params, "scale", 7.0)
+    assert np.allclose(scaled["w"], 7.0)
+    zero = corrupt_params(params, "zero")
+    assert np.all(zero["w"] == 0.0)
+    with pytest.raises(UserException):
+        corrupt_params(params, "stale")  # restore-time mode, not a transform
+
+
+# --------------------------------------------------------------------- #
+# inference engine: vote + zero recompiles
+
+_DIGITS = None
+
+
+def _digits():
+    """One digits experiment + init params per session (dataset load + init
+    are the slow parts)."""
+    global _DIGITS
+    if _DIGITS is None:
+        exp = models.instantiate("digits", ["batch-size:16"])
+        _DIGITS = (exp, exp.init(jax.random.PRNGKey(0)))
+    return _DIGITS
+
+
+def test_engine_zero_recompile_over_reused_buckets():
+    """Acceptance: after warmup over the ladder, steady-state serving of
+    varied batch sizes triggers ZERO recompiles — the jit cache holds
+    exactly one executable per bucket."""
+    exp, params = _digits()
+    engine = InferenceEngine(exp, [params], max_batch=16)
+    assert engine.buckets == (1, 2, 4, 8, 16)
+    engine.warmup()
+    compiled = engine.compile_count
+    assert compiled == len(engine.buckets)
+    x = np.asarray(exp.dataset.x_test[:16], np.float32)
+    for size in (1, 3, 5, 8, 16, 2, 7, 16, 1, 11):
+        out = engine.predict(x[:size])
+        assert out["predictions"].shape == (size,)
+        assert out["bucket"] == choose_bucket(size, engine.buckets)
+    assert engine.compile_count == compiled, "steady-state serving recompiled"
+    # beyond the ladder top: chunked at the largest bucket, still no recompile
+    big = engine.predict(np.concatenate([x, x]))
+    assert big["predictions"].shape == (32,)
+    assert engine.compile_count == compiled
+
+
+def test_poisoned_replica_masked_by_median_not_average():
+    """Acceptance: a NaN or scale-corrupted replica is absorbed by the
+    median-of-replicas vote (served predictions identical to the clean
+    baseline) while plain averaging degrades; the faulty replica's
+    disagreement score flags it."""
+    exp, params = _digits()
+    x = np.asarray(exp.dataset.x_test[:24], np.float32)
+    clean = InferenceEngine(exp, [params], max_batch=16).predict(x)
+
+    for mode, value in (("nan", None), ("scale", 100.0)):
+        bad = corrupt_params(params, mode, value)
+        vote = gars.instantiate("median", 3, 1)
+        robust = InferenceEngine(exp, [params, params, bad], gar=vote, max_batch=16)
+        served = robust.predict(x)
+        np.testing.assert_array_equal(
+            served["predictions"], clean["predictions"],
+            err_msg="median vote did not mask a %s replica" % mode,
+        )
+        # the faulty replica ranks worst on disagreement (inf for NaN)
+        scores = served["disagreement"]
+        assert np.argmax(scores) == 2 or not np.isfinite(scores[2])
+        assert np.all(scores[:2] == 0.0)  # identical clean replicas agree exactly
+
+    avg = gars.instantiate("average", 3, 1)
+    poisoned = InferenceEngine(
+        exp, [params, params, corrupt_params(params, "nan")], gar=avg, max_batch=16
+    )
+    degraded = poisoned.predict(x)
+    assert not np.array_equal(degraded["predictions"], clean["predictions"]), (
+        "average-of-replicas unexpectedly masked the NaN replica"
+    )
+
+
+def test_engine_validates_shapes_and_gar_arity():
+    exp, params = _digits()
+    with pytest.raises(UserException):
+        InferenceEngine(exp, [])
+    with pytest.raises(UserException):
+        InferenceEngine(exp, [params, params], gar=gars.instantiate("median", 3, 1))
+    engine = InferenceEngine(exp, [params], max_batch=4)
+    with pytest.raises(UserException):
+        engine.predict(np.zeros((2, 5, 5, 1), np.float32))
+    with pytest.raises(UserException):
+        engine.predict(np.zeros((0, 8, 8, 1), np.float32))
+    # single-sample convenience: (8,8,1) -> (1,)
+    assert engine.predict(np.zeros((8, 8, 1), np.float32))["predictions"].shape == (1,)
+
+
+# --------------------------------------------------------------------- #
+# end to end: train -> checkpoint -> serve over HTTP
+
+
+def _post(base, path, payload, timeout=30):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as response:
+            return response.status, json.loads(response.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(base, path, timeout=10):
+    with urllib.request.urlopen(base + path, timeout=timeout) as response:
+        return json.loads(response.read())
+
+
+def test_train_checkpoint_serve_round_trip(tmp_path):
+    """The full serving story: train digits through the real CLI runner,
+    restore the checkpoint through cli.serve's replica loader (one replica
+    poisoned via the chaos tie-in), serve over HTTP, and verify the voted
+    predictions match a clean in-process engine — plus /healthz flags the
+    poisoned replica and /metrics reports the serving gauges."""
+    from aggregathor_tpu.cli import runner
+    from aggregathor_tpu.cli import serve as serve_cli
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    assert 0 == runner.main([
+        "--experiment", "digits", "--experiment-args", "batch-size:16",
+        "--aggregator", "average", "--nb-workers", "4", "--nb-devices", "1",
+        "--max-step", "30", "--learning-rate-args", "initial-rate:0.05",
+        "--prefetch", "0",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--checkpoint-dir", ckpt_dir, "--checkpoint-delta", "15",
+        "--checkpoint-period", "-1",
+        "--summary-delta", "-1", "--summary-period", "-1",
+    ])
+
+    args = serve_cli.build_parser().parse_args([
+        "--experiment", "digits", "--experiment-args", "batch-size:16",
+        "--ckpt-dir", ckpt_dir, "--replicas", "3", "--gar", "median",
+        "--poison-replica", "1:nan", "--max-batch", "8",
+    ])
+    experiment = models.instantiate("digits", ["batch-size:16"])
+    replicas, sources = serve_cli.load_replicas(args, experiment)
+    assert len(replicas) == 3 and "poisoned: nan" in sources[1]
+
+    vote = gars.instantiate("median", 3, 1)
+    engine = InferenceEngine(experiment, replicas, gar=vote, max_batch=8)
+    engine.warmup()
+    server = InferenceServer(engine, port=0, max_latency_s=0.005, queue_bound=64)
+    host, port = server.serve_background()
+    base = "http://%s:%d" % (host, port)
+    try:
+        x = np.asarray(experiment.dataset.x_test[:8], np.float32)
+        expected = InferenceEngine(
+            experiment, [replicas[0]], max_batch=8
+        ).predict(x)["predictions"]
+
+        code, out = _post(base, "/predict", {"inputs": x.tolist()})
+        assert code == 200
+        np.testing.assert_array_equal(np.asarray(out["predictions"]), expected)
+        assert out["disagreement"][1] is None  # NaN replica -> null (inf)
+
+        health = _get(base, "/healthz")
+        assert health["status"] == "ok"
+        assert health["suspect_replicas"] == [1]
+        assert health["replicas"] == 3
+
+        metrics = _get(base, "/metrics")
+        for key in ("queue_depth", "batch_count", "served_rows", "shed_count",
+                    "latency_ms", "batch_occupancy", "per_replica_disagreement",
+                    "compile_count"):
+            assert key in metrics, key
+        assert metrics["served_rows"] >= 8
+        assert metrics["latency_ms"]["p95"] is not None
+        assert metrics["compile_count"] == len(engine.buckets)
+
+        code, out = _post(base, "/predict", {"inputs": [[1.0, 2.0]]})
+        assert code == 400  # malformed input
+    finally:
+        server.shutdown_all()
+
+
+def test_server_sheds_under_synthetic_overload():
+    """HTTP-level load-shedding: with a tiny queue bound and a wedged
+    engine, concurrent /predict bursts return 429 and the shed count lands
+    in /metrics."""
+    exp, params = _digits()
+    engine = InferenceEngine(exp, [params], max_batch=4, buckets=(4,))
+    engine.warmup()
+    server = InferenceServer(engine, port=0, max_latency_s=0.2, queue_bound=2)
+    host, port = server.serve_background()
+    base = "http://%s:%d" % (host, port)
+    try:
+        x0 = np.zeros((1, 8, 8, 1), np.float32).tolist()
+        codes = []
+        lock = threading.Lock()
+
+        def fire():
+            code, _ = _post(base, "/predict", {"inputs": x0})
+            with lock:
+                codes.append(code)
+
+        threads = [threading.Thread(target=fire) for _ in range(12)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        assert set(codes) <= {200, 429}
+        assert 429 in codes, "no request was shed under a 12-deep burst at bound 2"
+        assert 200 in codes, "every request was shed"
+        metrics = _get(base, "/metrics")
+        assert metrics["shed_count"] > 0
+    finally:
+        server.shutdown_all()
+
+
+# --------------------------------------------------------------------- #
+# serve campaign (chaos tie-in harness)
+
+
+def test_replica_campaign_matrix_and_verdicts():
+    """The campaign-style harness proves the serving thesis as data: the
+    median vote keeps served predictions at the clean bar under a NaN
+    replica, plain average does not; the matrix carries the asserted
+    schema."""
+    from aggregathor_tpu.serve import campaign
+
+    args = campaign.build_parser().parse_args([
+        "--experiment", "digits", "--experiment-args", "batch-size:16",
+        "--train-steps", "25", "--eval-rows", "64", "--replicas", "3",
+        "--gars", "median", "average", "--faults", "nan",
+    ])
+    matrix = campaign.run_campaign(args)
+    assert matrix["schema"] == campaign.SCHEMA
+    for cell in matrix["cells"]:
+        for key in campaign.CELL_KEYS:
+            assert key in cell, key
+    by = {(c["gar"], c["fault"]): c for c in matrix["cells"]}
+    assert by[("median", "nan")]["masked"], by[("median", "nan")]
+    assert by[("median", "clean")]["masked"]
+    assert not by[("average", "nan")]["masked"], by[("average", "nan")]
+    # the faulty replica is named by its disagreement score
+    assert by[("median", "nan")]["suspects"] == [2]
