@@ -79,6 +79,13 @@ def build_parser():
     parser.add_argument("--guardian-args", nargs="*", default=[],
                         help="key:value watchdog options (patience:N, spike:X, "
                              "retries:N, ladder:..., see docs/guardian.md)")
+    parser.add_argument("--forensics", action="store_true",
+                        help="run every cell with a Byzantine forensics ledger "
+                             "(obs/forensics.py) and assert ATTRIBUTION, not "
+                             "just convergence: the cell records which workers "
+                             "the ledger names Byzantine vs the injected "
+                             "coalition (workers 0..r-1), with step-range "
+                             "overlap against the attack-active regimes")
     parser.add_argument("--output", default=None, metavar="JSON", help="resilience matrix output path")
     parser.add_argument("--report", default=None, metavar="MD", help="markdown report output path")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
@@ -124,7 +131,8 @@ def _declares_attack(spec, nb_workers):
 
 
 def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
-             chaos_args, nb_steps, lr, seed, nb_devices=1, guardian=None):
+             chaos_args, nb_steps, lr, seed, nb_devices=1, guardian=None,
+             forensics=False):
     """Train one grid cell; returns the cell record (see CELL_KEYS).
 
     With ``guardian`` (a :class:`guardian.GuardianConfig`), the cell runs
@@ -133,7 +141,14 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
     escalation ladder and replays — the cell then reports
     ``rollbacks``/``escalations``/``recovered`` instead of stopping at the
     first non-finite loss, closing the loop where an injected breakdown
-    regime becomes the test harness for the recovery layer."""
+    regime becomes the test harness for the recovery layer.
+
+    With ``forensics``, the cell runs with per-worker suspicion diagnostics
+    on and a :class:`obs.forensics.ForensicsLedger` fed per step; the cell
+    record gains a ``forensics`` block comparing the ledger's attribution
+    (named workers + suspect step ranges) against the injected coalition
+    (workers ``0..r-1``) and the attack-active step range — the campaign
+    then asserts WHO, not just WHETHER."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -160,6 +175,7 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         )
         engine = RobustEngine(
             mesh, gar, n, nb_real_byz=nb_real, chaos=chaos,
+            worker_metrics=bool(forensics),
             reputation_decay=ov.reputation_decay,
             quarantine_threshold=ov.quarantine_threshold,
         )
@@ -172,6 +188,12 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
     engine, tx, step = build(overrides)
     state = engine.init_state(experiment.init(jax.random.PRNGKey(seed)), tx, seed=seed + 1)
     it = experiment.make_train_iterator(n, seed=seed + 2)
+
+    ledger = None
+    if forensics:
+        from ..obs.forensics import ForensicsLedger
+
+        ledger = ForensicsLedger(n)
 
     losses = []
     diverged = False
@@ -187,6 +209,22 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         loss = float(jax.device_get(metrics["total_loss"]))
         losses.append(loss)
         s += 1
+        if ledger is not None:
+            # ledger steps are 1-based (step s executed under the regime
+            # governing 0-based index s-1), matching the runner's feed
+            probe = metrics.get("probe")
+            ridx = chaos.regime_at(s - 1) if chaos is not None else None
+            dist = metrics.get("worker_sq_dist")
+            ledger.observe(
+                s,
+                worker_sq_dist=None if dist is None else jax.device_get(dist),
+                worker_nan=(
+                    jax.device_get(probe["worker_nan_rows"])
+                    if probe is not None else None
+                ),
+                regime=ridx,
+                regime_desc=chaos.describe(ridx) if ridx is not None else None,
+            )
         if watchdog is None:
             if not np.isfinite(loss):
                 # params are poisoned; every later loss is NaN too — stop
@@ -245,6 +283,9 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
             state = fresh
         losses = losses[:target_len]
         s = target_len
+        if ledger is not None:
+            ledger.truncate_after(target_len)
+            ledger.note_guardian(target_len, "rollback", {"attempt": attempt})
     finite = [x for x in losses if np.isfinite(x)]
     first = losses[0] if losses else float("nan")
     final = losses[-1] if losses else float("nan")
@@ -272,6 +313,41 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         cell["recovered"] = bool(
             rollbacks > 0 and not failed and np.isfinite(final) and recovered
         )
+    if ledger is not None:
+        freport = ledger.report()
+        expected = list(range(nb_real))
+        # 1-based ledger steps whose governing regime runs an attack
+        attack_steps = set()
+        if chaos is not None and chaos.has_attacks:
+            for sx in range(nb_steps):
+                if chaos.regimes[chaos.regime_at(sx)].attack is not None:
+                    attack_steps.add(sx + 1)
+
+        def overlaps_attack(worker):
+            return any(
+                iv["start"] <= sx <= iv["end"]
+                for iv in freport["workers"][worker]["intervals"]
+                for sx in attack_steps
+            )
+
+        suspects = freport["suspects"]
+        # correct attribution: exactly the injected coalition is named, and
+        # every coalition member's suspect ranges overlap the attack window
+        # (a calm cell is correct when NOBODY is named)
+        correct = sorted(suspects) == expected and all(
+            overlaps_attack(w) for w in expected
+        )
+        cell["forensics"] = {
+            "suspects": suspects,
+            "expected": expected,
+            "attack_steps": (
+                [min(attack_steps), max(attack_steps)] if attack_steps else None
+            ),
+            "attribution_correct": bool(correct),
+            "suspect_intervals": {
+                str(w): freport["workers"][w]["intervals"] for w in suspects
+            },
+        }
     return cell
 
 
@@ -296,7 +372,7 @@ def run_campaign(args):
                 args.experiment, args.experiment_args, gar_name, args.gar_args,
                 n, f, r, spec, args.chaos_args, args.nb_steps,
                 args.learning_rate, args.seed, nb_devices=args.nb_devices,
-                guardian=guardian,
+                guardian=guardian, forensics=getattr(args, "forensics", False),
             )
             cell["scenario"] = scenario
             cell["schedule"] = spec
@@ -305,6 +381,12 @@ def run_campaign(args):
                        else ("converged" if cell["converged"] else "degraded"))
             if cell.get("recovered"):
                 verdict = "recovered (%d rollback(s))" % cell["rollbacks"]
+            if "forensics" in cell:
+                fx = cell["forensics"]
+                verdict += ", attribution %s (named %s, expected %s)" % (
+                    "CORRECT" if fx["attribution_correct"] else "WRONG",
+                    fx["suspects"] or "nobody", fx["expected"] or "nobody",
+                )
             info("  -> %s (first %.4f final %.4f)"
                  % (verdict, cell["first_loss"], cell["final_loss"]))
     breakdown = []
@@ -398,6 +480,29 @@ def render_report(matrix):
             else:
                 row.append("degraded (%.3f→%.3f)" % (cell["first_loss"], cell["final_loss"]))
         lines.append(" | ".join(row) + " |")
+    if any("forensics" in cell for cell in matrix["cells"]):
+        lines += [
+            "",
+            "## Forensics attribution",
+            "",
+            "Per cell: the workers the ledger (obs/forensics.py) named",
+            "Byzantine vs the injected coalition; `correct` means exactly the",
+            "coalition was named with suspect ranges overlapping the attack",
+            "window (calm cells: correct = nobody named).",
+            "",
+            "| GAR | scenario | named | expected | correct |",
+            "|---|---|---|---|---|",
+        ]
+        for cell in matrix["cells"]:
+            fx = cell.get("forensics")
+            if fx is None:
+                continue
+            lines.append("| %s | %s | %s | %s | %s |" % (
+                cell["gar"], cell["scenario"],
+                ",".join(str(w) for w in fx["suspects"]) or "—",
+                ",".join(str(w) for w in fx["expected"]) or "—",
+                "**yes**" if fx["attribution_correct"] else "NO",
+            ))
     if matrix["breakdown"]:
         lines += [
             "",

@@ -202,6 +202,34 @@ def build_parser():
     )
     parser.add_argument("--trace", action="store_true", help="capture a jax.profiler trace of a few steps")
     parser.add_argument("--trace-dir", default="trace", help="profiler trace output directory")
+    parser.add_argument(
+        "--trace-file", default=None, metavar="PATH",
+        help="whole-run HOST span trace (obs/trace): dispatch / block / "
+             "host-gap / input / eval / checkpoint spans as Chrome "
+             "trace-event JSON, Perfetto-loadable; zero added recompiles, "
+             "bounded overhead (benchmarks/trace_overhead.py); "
+             "multi-process runs suffix non-lead files with .<process>",
+    )
+    parser.add_argument(
+        "--forensics", default=None, metavar="JSON",
+        help="write a Byzantine forensics attribution report here at exit "
+             "(schema aggregathor.obs.forensics.v1, plus a .md rendering): "
+             "a per-worker suspicion timeline built from the engines' "
+             "per-step diagnostics + guardian verdicts + chaos regime "
+             "context (docs/observability.md); implies --worker-metrics",
+    )
+    parser.add_argument(
+        "--metrics-file", default=None, metavar="PATH",
+        help="dump the process-wide metrics registry as Prometheus text "
+             "exposition here at every summary fire and at exit (the "
+             "training-side counterpart of serve's /metrics endpoint)",
+    )
+    parser.add_argument(
+        "--run-id", default=None, metavar="ID",
+        help="run id stamped on every summary line, the trace metadata and "
+             "the forensics report so the streams join after the fact "
+             "(default: generated)",
+    )
     parser.add_argument("--trace-ops", action="store_true",
                         help="per-op terminal narrative: print a marker after "
                              "each phase of the step body (gradients, "
@@ -321,12 +349,30 @@ def main(argv=None):
 
     from .. import config, gars, models
     from ..core import build_optimizer, build_schedule
-    from ..obs import CadenceTrigger, Checkpoints, EvalFile, PerfReport, SummaryWriter
+    from ..obs import (
+        CadenceTrigger,
+        Checkpoints,
+        EvalFile,
+        ForensicsLedger,
+        PerfReport,
+        SummaryWriter,
+        trace,
+    )
+    from ..obs import metrics as obs_metrics
+    from ..obs.summaries import make_run_id
     from ..parallel import RobustEngine, attacks, make_mesh
     from ..parallel.lossy import LossyLink
     from ..utils import Context, UserException, info, replicate_streams, warning
 
     replicate_streams(args.stdout_to, args.stderr_to)
+
+    run_id = args.run_id if args.run_id else make_run_id()
+    registry = obs_metrics.REGISTRY
+    if args.forensics and not args.worker_metrics:
+        # the ledger's distance evidence rides worker_sq_dist
+        info("--forensics implies --worker-metrics: enabling the per-worker "
+             "suspicion diagnostics")
+        args.worker_metrics = True
 
     ignored = [flag for flag, value in (
         ("--client", args.client), ("--server", args.server),
@@ -404,6 +450,25 @@ def main(argv=None):
                 "Mesh: %d x %s device(s), %d worker(s)/device"
                 % (nb_devices, devices[0].platform, n // nb_devices)
             )
+
+    # Host span tracing (obs/trace.py, docs/observability.md): installed
+    # BEFORE the graph/restore phases so their spans are captured too.  Each
+    # process writes its own file (suffixed for non-lead processes) — one
+    # shared path would clobber.
+    if args.trace_file:
+        path = args.trace_file
+        if jax.process_index() != 0:
+            path = "%s.%d" % (path, jax.process_index())
+        if args.run_id is None and jax.process_count() > 1:
+            # summaries/forensics are lead-only, so the lead's streams still
+            # join — but each process GENERATES its own id, so non-lead
+            # trace files won't carry the lead's without an explicit id
+            warning(
+                "Multi-process run without --run-id: per-process trace files "
+                "carry independent run_ids; pass --run-id to join them"
+            )
+        trace.install(path, run_id=run_id)
+        info("Span tracing to %r (run_id %s)" % (path, run_id))
 
     # Guardian recovery layer (guardian/, docs/guardian.md): parsed up front
     # so a bad ladder/threshold fails before any compilation.
@@ -689,7 +754,23 @@ def main(argv=None):
     ) if args.checkpoint_dir else None
     save_snapshots = checkpoints is not None and lead
     eval_file = EvalFile(args.evaluation_file if lead else None)
-    summaries = SummaryWriter(args.summary_dir if lead else None)
+    summaries = SummaryWriter(args.summary_dir if lead else None, run_id=run_id)
+
+    # Byzantine forensics ledger (obs/forensics.py): fed one dispatch behind
+    # (the same lag as the NaN-abort check, so the feed never blocks the
+    # in-flight step), written at exit.  Lead-only — the diagnostics are
+    # replicated, every process would ledger identical evidence.
+    ledger = None
+    if args.forensics and lead:
+        ledger = ForensicsLedger(n, run_id=run_id)
+
+    def dump_metrics_file():
+        if not args.metrics_file or not lead:
+            return
+        tmp = args.metrics_file + ".tmp"
+        with open(tmp, "w") as fd:
+            fd.write(registry.render_prometheus())
+        os.replace(tmp, args.metrics_file)
 
     # Auto-restore the latest checkpoint (reference: runner.py:514-525).
     # Every process must make the SAME restore decision or the SPMD step
@@ -868,6 +949,7 @@ def main(argv=None):
         # eval batches instead of re-uploading per batch.
         dense_metrics_fn = jax.jit(experiment.metrics)
 
+    @trace.span("eval", cat="eval")
     def run_eval(step):
         if ts.eval_fn is None:
             # Sharded engine: the sharded loss is always reported; when the
@@ -913,7 +995,36 @@ def main(argv=None):
         eval_file.append(step, metrics)
         return metrics
 
-    perf = PerfReport()
+    perf = PerfReport(registry=registry)
+    # Training gauges on the process-wide registry (obs/metrics.py): the
+    # same values the summary stream carries, updated at every summary fire
+    # and dumped as Prometheus text by --metrics-file.
+    g_loss = registry.gauge("train_loss", "Last summarized total training loss")
+    g_grad_norm = registry.gauge("train_grad_norm", "Last summarized aggregate norm")
+    g_lr = registry.gauge("train_learning_rate", "Learning rate at the last summary")
+    g_steps_per_s = registry.gauge(
+        "train_steps_per_second", "Throughput excluding the first (compile) step"
+    )
+    g_regime = registry.gauge("train_chaos_regime", "Active chaos regime index")
+    g_quarantined = registry.gauge("train_quarantined_workers", "Workers under quarantine")
+    g_worker_dist = registry.gauge(
+        "train_worker_sq_dist", "Per-worker squared distance to the aggregate",
+        labelnames=("worker",),
+    )
+    g_worker_rep = registry.gauge(
+        "train_worker_reputation", "Per-worker reputation EMA (1 = trusted)",
+        labelnames=("worker",),
+    )
+    # guardian recovery counters — the third subsystem on the one registry
+    g_rollbacks = registry.counter(
+        "guardian_rollbacks_total", "Guardian rollbacks to last-known-good"
+    )
+    g_escalations = registry.counter(
+        "guardian_escalations_total", "Guardian escalation-ladder rungs applied"
+    )
+    g_recoveries = registry.counter(
+        "guardian_recoveries_total", "Guardian diverged-then-recovered verdicts"
+    )
     metrics = {}
     diverged = False
     with Context("train"):
@@ -968,6 +1079,23 @@ def main(argv=None):
                 scalars["nb_quarantined"] = int(jax.device_get(metrics["nb_quarantined"]))
             if "chaos_regime" in metrics:
                 scalars["chaos_regime"] = int(jax.device_get(metrics["chaos_regime"]))
+            # mirror into the registry — one metrics surface (obs/metrics.py)
+            g_loss.set(scalars["total_loss"])
+            g_grad_norm.set(scalars["grad_norm"])
+            g_lr.set(scalars["learning_rate"])
+            g_steps_per_s.set(scalars["steps_per_s"])
+            if "chaos_regime" in scalars:
+                g_regime.set(scalars["chaos_regime"])
+            if "nb_quarantined" in scalars:
+                g_quarantined.set(scalars["nb_quarantined"])
+            if "worker_sq_dist" in scalars:
+                for w, value in enumerate(scalars["worker_sq_dist"]):
+                    g_worker_dist.labels(worker=str(w)).set(
+                        float(value) if np.isfinite(value) else float("inf")
+                    )
+            if "worker_reputation" in scalars:
+                for w, value in enumerate(scalars["worker_reputation"]):
+                    g_worker_rep.labels(worker=str(w)).set(float(value))
             return scalars
 
         def check_divergence():
@@ -977,12 +1105,62 @@ def main(argv=None):
             # rather than up to 2K-1 steps late via the last element only.
             if pending_loss is None:
                 return
-            values = np.asarray(jax.device_get(pending_loss))
+            with trace.span("block.loss_fetch", cat="train"):
+                values = np.asarray(jax.device_get(pending_loss))
             if not np.all(np.isfinite(values)):
                 if watchdog is not None:
                     return  # the guardian owns divergence: rollback, not abort
                 diverged = True
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
+
+        # Forensics feed: one ledger observation per completed step, taken
+        # from the PREVIOUS dispatch (the same one-step lag as the NaN-abort
+        # check — by feed time the values are materialized, so the fetch
+        # costs a host copy, not a device sync).  ``fed_start`` dedups: the
+        # same pending dispatch is visible from several call sites.
+        forensics_fed = {"start": None}
+
+        def feed_pending_forensics():
+            if ledger is None or pending_metrics is None:
+                return
+            if forensics_fed["start"] == pending_start:
+                return
+            forensics_fed["start"] = pending_start
+            with trace.span("forensics.feed", cat="obs"):
+                def fetch(value):
+                    return None if value is None else np.asarray(jax.device_get(value))
+
+                dist = fetch(pending_metrics.get("worker_sq_dist"))
+                rep = fetch(pending_metrics.get("worker_reputation"))
+                regime = fetch(pending_metrics.get("chaos_regime"))
+                probe = pending_metrics.get(health.PROBE_KEY)
+                nan_rows = (
+                    fetch(probe.get("worker_nan_rows")) if probe is not None else None
+                )
+
+                def rows(vector):
+                    # (n,) -> one step; (K, n) -> one row per scanned step
+                    if vector is None:
+                        return None
+                    return vector[None] if vector.ndim == 1 else vector
+                dist, rep, nan_rows = rows(dist), rows(rep), rows(nan_rows)
+                regime = None if regime is None else np.atleast_1d(regime)
+                nb = max(
+                    v.shape[0] for v in (dist, rep, nan_rows, regime) if v is not None
+                ) if any(v is not None for v in (dist, rep, nan_rows, regime)) else 0
+                for i in range(nb):
+                    ridx = None if regime is None else int(regime[min(i, regime.shape[0] - 1)])
+                    ledger.observe(
+                        pending_start + i + 1,
+                        worker_sq_dist=None if dist is None else dist[i],
+                        worker_nan=None if nan_rows is None else nan_rows[i],
+                        reputation=None if rep is None else rep[i],
+                        regime=ridx,
+                        regime_desc=(
+                            chaos.describe(ridx)
+                            if (ridx is not None and chaos is not None) else None
+                        ),
+                    )
 
         def probe_clean(dispatch_metrics):
             """Is the state this dispatch produced healthy by the probe?
@@ -1023,6 +1201,17 @@ def main(argv=None):
                 "reason": reason, "from_step": int(at_step), "to_step": int(rstep),
                 "attempt": attempt, "restored_snapshot": target is not None,
             })
+            g_rollbacks.inc()
+            if ledger is not None:
+                # the replay window re-observes the truncated steps; the
+                # rollback event (stamped at the restore step so it survives
+                # the truncation) keeps the audit trail of WHY
+                ledger.truncate_after(rstep)
+                forensics_fed["start"] = None
+                ledger.note_guardian(rstep, "rollback", {
+                    "reason": reason, "from_step": int(at_step),
+                    "attempt": attempt,
+                })
             rung = guardian.ladder.rung(attempt)
             if rung is not None:
                 try:
@@ -1036,6 +1225,12 @@ def main(argv=None):
                         "rung": rung.describe(), "attempt": attempt,
                         "overrides": overrides.describe(),
                     })
+                    g_escalations.inc()
+                    if ledger is not None:
+                        ledger.note_guardian(rstep, "escalation", {
+                            "rung": rung.describe(),
+                            "overrides": overrides.describe(),
+                        })
                 except UserException as exc:
                     warning(
                         "guardian: escalation rung %r rejected (%s); retrying "
@@ -1076,14 +1271,17 @@ def main(argv=None):
                 chaos_regime_seen = chaos.regime_at(step)
 
         def observe_pending():
-            """Feed the watchdog the previous dispatch's probe, one
-            observation per completed step.  Returns True when a rollback
-            happened — the caller discards its in-flight results."""
+            """Feed the forensics ledger and the watchdog the previous
+            dispatch's diagnostics, one observation per completed step.
+            Returns True when a rollback happened — the caller discards its
+            in-flight results."""
             nonlocal pending_loss, pending_metrics
+            feed_pending_forensics()
             if watchdog is None or pending_metrics is None:
                 return False
-            view = health.host_view(pending_metrics)
-            losses = np.atleast_1d(np.asarray(jax.device_get(pending_loss)))
+            with trace.span("block.probe_fetch", cat="guardian"):
+                view = health.host_view(pending_metrics)
+                losses = np.atleast_1d(np.asarray(jax.device_get(pending_loss)))
             start = pending_start
             pending_loss = pending_metrics = None
             if view is None:  # engine built without the probe
@@ -1101,10 +1299,30 @@ def main(argv=None):
                         "attempt": watchdog.attempts - 1,
                         "overrides": overrides.describe(),
                     })
+                    g_recoveries.inc()
+                    if ledger is not None:
+                        ledger.note_guardian(start + i + 1, "recovered", {
+                            "attempt": watchdog.attempts - 1,
+                        })
                 elif action == "rollback":
                     do_rollback(start + i + 1)
                     return True
             return False
+
+        # Host-gap span: the wall time between one dispatch returning and
+        # the next one starting (input, cadences, watchdog) — the "off-
+        # graph" slice of the perf report, now visible per step in the
+        # trace.  Manual start/stop because its lifetime spans loop turns.
+        gap = {"span": None}
+
+        def gap_open():
+            if trace.installed() is not None:
+                gap["span"] = trace.span("host_gap", cat="train").start()
+
+        def gap_close():
+            if gap["span"] is not None:
+                gap["span"].stop()
+                gap["span"] = None
 
         tail_warned = False
         # Chaos regime transition logging: host-side tracking of the regime
@@ -1134,12 +1352,14 @@ def main(argv=None):
                     # Unrolled dispatch: K distinct batches, one executable
                     # (device-sampled: the resident dataset IS the input and
                     # the trainer draws its own fresh per-step batches)
-                    if ts.device_dataset is not None:
-                        device_chunk = ts.device_dataset
-                    elif chunk_prefetcher is not None:
-                        device_chunk = next(chunk_prefetcher)
-                    else:
-                        device_chunk = ts.engine.shard_batches(next_chunk())
+                    with trace.span("input", cat="train"):
+                        if ts.device_dataset is not None:
+                            device_chunk = ts.device_dataset
+                        elif chunk_prefetcher is not None:
+                            device_chunk = next(chunk_prefetcher)
+                        else:
+                            device_chunk = ts.engine.shard_batches(next_chunk())
+                    gap_close()
                     perf.step_begin()
                     state, many = ts.multi_fn(state, device_chunk)
                     if observe_pending():
@@ -1147,6 +1367,7 @@ def main(argv=None):
                     check_divergence()
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], many)
                     perf.step_end(unroll)
+                    gap_open()
                     chunk = unroll
                     pending_loss = many["total_loss"]  # full vector: see check_divergence
                     pending_metrics = many
@@ -1171,13 +1392,16 @@ def main(argv=None):
                         # numpy Generators are not thread-safe.
                         chunk_prefetcher.close()
                         chunk_prefetcher = None
-                    batch = next(prefetcher) if prefetcher is not None else ts.engine.shard_batch(next(train_iter))
+                    with trace.span("input", cat="train"):
+                        batch = next(prefetcher) if prefetcher is not None else ts.engine.shard_batch(next(train_iter))
+                    gap_close()
                     perf.step_begin()
                     state, metrics = ts.step_fn(state, batch)
                     if observe_pending():
                         continue  # previous step diverged: this one is abandoned
                     check_divergence()
                     perf.step_end()
+                    gap_open()
                     pending_loss = metrics["total_loss"]
                     pending_metrics = metrics
                     pending_start = step
@@ -1216,7 +1440,9 @@ def main(argv=None):
                     ckpt_trigger.fired(step)
                 if summary_trigger.should_fire(step):
                     check_divergence()
-                    summaries.scalars(step, summary_scalars(step, metrics))
+                    with trace.span("summaries", cat="obs"):
+                        summaries.scalars(step, summary_scalars(step, metrics))
+                    dump_metrics_file()
                     summary_trigger.fired(step)
         finally:
             for signum, handler in previous_handlers.items():
@@ -1240,6 +1466,35 @@ def main(argv=None):
                 chunk_prefetcher.close()
             eval_file.close()
             summaries.close()
+            gap_close()
+            # Telemetry flush — last observations (a diverged tail IS
+            # evidence), attribution report, metrics dump, trace.  Best-
+            # effort: a telemetry write failure must not mask a propagating
+            # training error.
+            aborting = sys.exc_info()[0] is not None
+            try:
+                feed_pending_forensics()
+                if ledger is not None:
+                    md_path = (
+                        args.forensics[:-5] + ".md"
+                        if args.forensics.endswith(".json") else args.forensics + ".md"
+                    )
+                    report = ledger.save(args.forensics, markdown_path=md_path)
+                    suspects = report["suspects"]
+                    info("Forensics report -> %r (%s)" % (
+                        args.forensics,
+                        "Byzantine worker(s): %s" % ", ".join(map(str, suspects))
+                        if suspects else "no worker attributed Byzantine",
+                    ))
+                dump_metrics_file()
+                if args.trace_file:
+                    written = trace.uninstall(save=True)
+                    if written:
+                        info("Span trace -> %r (run_id %s)" % (written, run_id))
+            except Exception as exc:
+                if not aborting:
+                    raise
+                warning("Telemetry flush failed during abort: %s" % exc)
             perf.report()
             if checkpoints is not None:
                 # LAST cleanup step, so a flush failure can no longer skip

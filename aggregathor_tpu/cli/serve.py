@@ -89,6 +89,13 @@ def build_parser():
                         help="write 'host port pid' here once serving (harness handshake)")
     parser.add_argument("--summary-dir", default=None,
                         help="JSONL serve_batch/serve_shed event directory (obs/summaries)")
+    parser.add_argument("--trace-file", default=None, metavar="PATH",
+                        help="write a Chrome trace-event JSON of the request "
+                             "lifecycle spans (enqueue -> batch -> jit -> reply) "
+                             "here at shutdown — Perfetto-loadable (obs/trace)")
+    parser.add_argument("--run-id", default=None, metavar="ID",
+                        help="run id stamped on summary lines and trace metadata "
+                             "(default: generated)")
     parser.add_argument("--request-timeout", type=float, default=60.0,
                         help="seconds a /predict handler waits on its batch")
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed (template init)")
@@ -201,9 +208,15 @@ def main(argv=None):
         jax.config.update("jax_platforms", args.platform)
 
     from .. import gars, models
-    from ..obs import SummaryWriter
+    from ..obs import SummaryWriter, trace
+    from ..obs.summaries import make_run_id
     from ..serve import InferenceEngine, InferenceServer
     from ..utils import Context, UserException, info
+
+    run_id = args.run_id if args.run_id else make_run_id()
+    if args.trace_file:
+        # installed BEFORE compile so the warmup's serve.jit spans land too
+        trace.install(args.trace_file, run_id=run_id)
 
     with Context("load"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
@@ -232,7 +245,7 @@ def main(argv=None):
         if not args.no_warmup:
             engine.warmup()
 
-    summaries = SummaryWriter(args.summary_dir, run_name="serve")
+    summaries = SummaryWriter(args.summary_dir, run_name="serve", run_id=run_id)
     server = InferenceServer(
         engine, host=args.host, port=args.port,
         max_latency_s=args.max_latency_ms / 1e3,
@@ -273,6 +286,10 @@ def main(argv=None):
         server.server_close()
         server.batcher.close()
         summaries.close()
+        if args.trace_file:
+            written = trace.uninstall(save=True)
+            if written:
+                info("Trace written to %r (run_id %s)" % (written, run_id))
     return 0
 
 

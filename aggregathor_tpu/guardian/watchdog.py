@@ -25,6 +25,7 @@ rollback count; past it the runner declares the run failed.
 
 import math
 
+from ..obs import trace
 from ..utils import parse_keyval
 from .escalate import DEFAULT_LADDER, EscalationLadder
 
@@ -105,6 +106,8 @@ class Watchdog:
             self.unhealthy_streak = 0
             if self.recovering and self.healthy_streak >= self.config.recover_after:
                 self.recovering = False
+                trace.instant("guardian.recovered", cat="guardian", step=int(step),
+                              attempts=self.attempts)
                 return "recovered"
             return None
         self.unhealthy_streak += 1
@@ -112,6 +115,8 @@ class Watchdog:
         if not finite:
             # params are poisoned: no cooldown, no patience
             self.last_reason = "non-finite loss at step %d" % step
+            trace.instant("guardian.rollback_decision", cat="guardian",
+                          step=int(step), reason="non-finite")
             return "rollback"
         if step >= self.cooldown_until and self.unhealthy_streak >= self.config.patience:
             self.last_reason = (
@@ -119,6 +124,8 @@ class Watchdog:
                 % (spike, self.unhealthy_streak, self.config.spike_factor,
                    self.config.patience)
             )
+            trace.instant("guardian.rollback_decision", cat="guardian",
+                          step=int(step), reason="spike", spike=float(spike))
             return "rollback"
         return None
 
@@ -135,4 +142,7 @@ class Watchdog:
         self.recovering = True
         grace = math.ceil(self.config.patience * self.config.backoff ** self.attempts)
         self.cooldown_until = restore_step + grace
+        trace.instant("guardian.rollback", cat="guardian",
+                      restore_step=int(restore_step), attempt=attempt,
+                      cooldown_until=int(self.cooldown_until))
         return attempt

@@ -9,14 +9,28 @@ the same step-delta / wall-period policies, plus a final fire at shutdown.
 - ``CadenceTrigger``  step-delta / wall-period firing policy
 - ``Checkpoints``     step-indexed train-state snapshots, auto-restore latest
 - ``EvalFile``        the reference's TSV evaluation log format
-- ``SummaryWriter``   JSONL scalar event log (summary-file parity)
+- ``SummaryWriter``   JSONL scalar event log (summary-file parity), every
+  line stamped with the writer's ``run_id``
 - ``PerfReport``      steps/s report, first (compilation) step excluded
 - ``LatencyHistogram``  bounded-reservoir p50/p95/p99 tail latency (shared by
   ``PerfReport`` and the serving ``/metrics`` endpoint)
+
+The telemetry pillars (docs/observability.md):
+
+- ``trace``           host-side span tracer -> Chrome trace-event JSON
+  (Perfetto-loadable); ``span(...)`` context manager/decorator, zero
+  recompiles, near-zero cost disabled
+- ``metrics``         process-wide counter/gauge/histogram registry with
+  Prometheus text exposition (``MetricsRegistry``, default ``REGISTRY``)
+- ``ForensicsLedger`` per-worker suspicion timeline -> Byzantine
+  attribution report (schema ``aggregathor.obs.forensics.v1``)
 """
 
+from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
 from .cadence import CadenceTrigger  # noqa: F401
 from .checkpoint import Checkpoints  # noqa: F401
 from .evalfile import EvalFile  # noqa: F401
+from .forensics import ForensicsLedger  # noqa: F401
 from .summaries import SummaryWriter  # noqa: F401
 from .perf import LatencyHistogram, PerfReport  # noqa: F401

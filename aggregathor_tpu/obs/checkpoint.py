@@ -44,6 +44,7 @@ import re
 import flax.serialization
 import jax
 
+from . import trace
 from ..utils import UserException, info, warning
 
 
@@ -130,6 +131,10 @@ class Checkpoints:
 
     def restore(self, template_state, step=None):
         """Restore into ``template_state``'s structure; latest step if None."""
+        with trace.span("checkpoint.restore", cat="checkpoint"):
+            return self._restore(template_state, step)
+
+    def _restore(self, template_state, step=None):
         steps = self.steps()
         if not steps:
             raise UserException("No checkpoint to restore in %r" % (self.directory,))
@@ -221,7 +226,8 @@ class Checkpoints:
                 # Not serialized (core/train_state.py) — drop BEFORE device_get
                 # or the (n, d) matrix crosses to the host just to be discarded.
                 state = state.replace(**{field: None})
-        host_state = jax.device_get(state)
+        with trace.span("checkpoint.fetch", cat="checkpoint", step=int(step)):
+            host_state = jax.device_get(state)
         if self._pool is not None:
             self._pending.append(self._pool.submit(self._write, host_state, step))
             return self._path(step)
@@ -251,7 +257,11 @@ class Checkpoints:
         if first_error is not None:
             raise first_error
 
+    @trace.span("checkpoint.write", cat="checkpoint")
     def _write(self, host_state, step):
+        # (span runs on the writer thread under background=True — the
+        # tracer is thread-safe and the trace shows the write off the
+        # critical path, which is the point of the background writer)
         data = flax.serialization.to_bytes(host_state)
         if self.cipher is not None:
             # BEFORE tagging: encrypt-then-MAC, the tag authenticates

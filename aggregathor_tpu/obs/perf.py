@@ -75,15 +75,45 @@ class LatencyHistogram:
 
 
 class PerfReport:
-    def __init__(self):
+    """End-of-run throughput report; optionally registry-backed.
+
+    With ``registry`` (an ``obs.metrics.MetricsRegistry``), the report's
+    accumulators are ALSO exported as first-class metrics —
+    ``train_steps_total``, ``train_in_graph_seconds_total`` and the
+    ``train_step_latency_seconds`` histogram.  The printed report always
+    reads this instance's own fresh reservoir (per-run percentiles), while
+    the registry instruments are get-or-create and therefore cumulative
+    over the process — the standard Prometheus counter/histogram contract,
+    and the reason a second ``runner.main()`` in one process (tests) does
+    not pollute the first's printed numbers.
+    """
+
+    def __init__(self, registry=None):
         self.nb_steps = 0
         self.first_step_s = 0.0
         self.in_graph_s = 0.0
         self.start = time.monotonic()
         self._step_start = None
-        # Per-dispatch latency spread (first/compile dispatch excluded so the
-        # percentiles describe the steady state, like steps/s excl. 1st).
+        self._steps_counter = None
+        self._in_graph_counter = None
+        self._registry_latency = None
+        # Per-dispatch latency spread (first/compile dispatch excluded so
+        # the percentiles describe the steady state, like steps/s excl.
+        # 1st) — ALWAYS a fresh per-run reservoir, so the printed report is
+        # this run's, even when the process-global registry is shared.
         self.latency = LatencyHistogram()
+        if registry is not None:
+            self._registry_latency = registry.histogram(
+                "train_step_latency_seconds",
+                "Per-step train latency (first/compile dispatch excluded)",
+            )
+            self._steps_counter = registry.counter(
+                "train_steps_total", "Completed training steps"
+            )
+            self._in_graph_counter = registry.counter(
+                "train_in_graph_seconds_total",
+                "Wall time spent blocked on dispatched step programs",
+            )
 
     def step_begin(self):
         self._step_start = time.monotonic()
@@ -95,8 +125,13 @@ class PerfReport:
             self.first_step_s = elapsed
         else:
             self.latency.record(elapsed / max(int(nb_steps), 1))
+            if self._registry_latency is not None:
+                self._registry_latency.observe(elapsed / max(int(nb_steps), 1))
         self.in_graph_s += elapsed
         self.nb_steps += int(nb_steps)
+        if self._steps_counter is not None:
+            self._steps_counter.inc(int(nb_steps))
+            self._in_graph_counter.inc(elapsed)
 
     def report(self):
         total = time.monotonic() - self.start

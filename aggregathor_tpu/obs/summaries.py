@@ -5,20 +5,33 @@ The reference writes TF summaries (learning rate, eval metrics) through a
 format buys nothing without TensorBoard in the loop; the portable equivalent
 is one JSON object per event line — trivially greppable/plottable, and
 convertible to TF events offline if ever needed.
+
+Every line is stamped with the writer's ``run_id`` (given, or generated):
+multi-process runs interleave their JSONL streams in one directory, and the
+same id rides the trace file's metadata (``obs/trace.py``) and the
+forensics report (``obs/forensics.py``), so streams, traces and attribution
+reports join after the fact on one key.
 """
 
 import itertools
 import json
 import time
+import uuid
 
 
 _serial = itertools.count()
 
 
+def make_run_id():
+    """A short unique run id (shared by summaries, traces, forensics)."""
+    return uuid.uuid4().hex[:12]
+
+
 class SummaryWriter:
-    def __init__(self, directory, run_name="run"):
+    def __init__(self, directory, run_name="run", run_id=None):
         self.path = None
         self._fd = None
+        self.run_id = run_id if run_id is not None else make_run_id()
         if directory:
             import os
 
@@ -55,7 +68,7 @@ class SummaryWriter:
             except TypeError:
                 return [finite(v) for v in value]
 
-        event = {"wall": time.time(), "step": int(step)}
+        event = {"wall": time.time(), "step": int(step), "run_id": self.run_id}
         event.update({name: coerce(value) for name, value in values.items()})
         self._fd.write(json.dumps(event) + "\n")
         self._fd.flush()
@@ -64,12 +77,16 @@ class SummaryWriter:
         """Write one TAGGED event line (``{"event": tag, ...}``) — discrete
         occurrences like chaos regime transitions, as opposed to the cadenced
         scalar stream.  ``payload`` values must be JSON-serializable; the
-        reserved ``wall``/``step``/``event`` fields always win over payload
-        keys of the same name (stream consumers filter on them)."""
+        reserved ``wall``/``step``/``event``/``run_id`` fields always win
+        over payload keys of the same name (stream consumers filter on
+        them)."""
         if self._fd is None:
             return
         record = dict(payload) if payload else {}
-        record.update({"wall": time.time(), "step": int(step), "event": str(tag)})
+        record.update({
+            "wall": time.time(), "step": int(step), "event": str(tag),
+            "run_id": self.run_id,
+        })
         self._fd.write(json.dumps(record) + "\n")
         self._fd.flush()
 

@@ -1,0 +1,318 @@
+"""Host-side span tracer emitting Chrome trace-event JSON.
+
+The reference's only timing story is the end-of-run steps/s printout
+(runner.py:504-598); a production run needs to see WHERE a step's wall time
+went — dispatch vs blocking on the device vs host-side gaps — after the
+fact, per step, without attaching a profiler.  This module is that story's
+host half: lightweight spans written as Chrome trace events (the
+``{"traceEvents": [...]}`` JSON Array Format), loadable in Perfetto /
+``chrome://tracing`` next to a ``jax.profiler`` device trace.
+
+Design constraints (the acceptance bar in ISSUE 4):
+
+- **Zero compiles touched** — everything here is host-side Python; the
+  jitted step programs are wrapped (``traced``), never modified, so the jit
+  cache is byte-identical with tracing on or off (asserted by
+  tests/test_obs.py).
+- **Near-zero cost disabled** — tracing is OFF until :func:`install` is
+  called; the disabled fast path of :class:`span` / :func:`instant` /
+  :class:`TracedCallable` is a single global ``None`` check.
+- **Bounded enabled cost** — events append to an in-memory list under a
+  lock (one append per span, microseconds against millisecond steps) with a
+  hard event cap; past it events are counted as dropped, never written.
+
+Usage::
+
+    from aggregathor_tpu.obs import trace
+    trace.install("run.trace.json", run_id=run_id)
+    with trace.span("dispatch", cat="train", step=12):
+        ...
+    @trace.span("checkpoint.save")
+    def save(...): ...
+    trace.save()            # or trace.uninstall(save=True)
+
+Nesting is tracked per thread (a thread-local span stack): each event
+carries its stack depth and parent name in ``args``, and Perfetto nests
+same-thread "X" events by time containment.  All public entry points are
+thread-safe — the serving stack records from handler threads while the
+batcher thread records batches.
+"""
+
+import functools
+import json
+import os
+import threading
+import time
+
+#: the process-wide installed tracer (None = tracing disabled)
+_tracer = None
+
+#: per-thread span stack for nesting (list of span names)
+_local = threading.local()
+
+#: hard cap on buffered events — a runaway loop degrades to a counted drop,
+#: not an OOM (at ~150 B/event this caps the buffer around 150 MB)
+MAX_EVENTS = 1_000_000
+
+
+def _stack():
+    stack = getattr(_local, "spans", None)
+    if stack is None:
+        stack = _local.spans = []
+    return stack
+
+
+class Tracer:
+    """Event buffer + clock for one trace file.  Use the module-level
+    :func:`install` / :func:`save` / :func:`uninstall` in application code;
+    construct directly only in tests."""
+
+    def __init__(self, path, run_id=None, clock=None):
+        self.path = path
+        self.run_id = run_id
+        self._clock = clock if clock is not None else time.perf_counter
+        self._epoch = self._clock()
+        self._lock = threading.Lock()
+        self._events = []
+        self._named_threads = set()
+        self.dropped = 0
+        self._pid = os.getpid()
+        self._events.append({
+            "ph": "M", "name": "process_name", "pid": self._pid, "tid": 0,
+            "args": {"name": "aggregathor_tpu"},
+        })
+
+    # ------------------------------------------------------------------ #
+
+    def now_us(self):
+        """Microseconds since tracer epoch (the trace's ``ts`` clock)."""
+        return (self._clock() - self._epoch) * 1e6
+
+    def _append(self, event, tid):
+        with self._lock:
+            if tid not in self._named_threads:
+                self._named_threads.add(tid)
+                self._events.append({
+                    "ph": "M", "name": "thread_name", "pid": self._pid,
+                    "tid": tid, "args": {"name": threading.current_thread().name},
+                })
+            if len(self._events) >= MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
+    def complete(self, name, start_us, dur_us, cat="host", args=None):
+        """One "X" (complete) event: a span of ``dur_us`` from ``start_us``."""
+        self._append({
+            "ph": "X", "name": name, "cat": cat, "pid": self._pid,
+            "tid": threading.get_ident(), "ts": start_us,
+            "dur": max(dur_us, 0.0), "args": args or {},
+        }, threading.get_ident())
+
+    def instant(self, name, cat="host", args=None):
+        """One "i" (instant) event — discrete occurrences like a guardian
+        rollback decision."""
+        self._append({
+            "ph": "i", "s": "t", "name": name, "cat": cat, "pid": self._pid,
+            "tid": threading.get_ident(), "ts": self.now_us(),
+            "args": args or {},
+        }, threading.get_ident())
+
+    def save(self):
+        """Write the trace (atomic: tmp + rename).  Callable repeatedly —
+        each call snapshots the events so far."""
+        if self.path is None:
+            return None
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        payload = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "aggregathor_tpu.obs.trace",
+                "run_id": self.run_id,
+                "dropped_events": dropped,
+            },
+        }
+        directory = os.path.dirname(self.path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fd:
+            json.dump(payload, fd)
+        os.replace(tmp, self.path)
+        return self.path
+
+    @property
+    def nb_events(self):
+        with self._lock:
+            return len(self._events)
+
+
+# --------------------------------------------------------------------- #
+# module-level lifecycle
+
+
+def install(path, run_id=None, clock=None):
+    """Enable tracing process-wide, writing to ``path`` on :func:`save`.
+    Returns the :class:`Tracer`.  Installing over a live tracer replaces it
+    (the old one is saved first)."""
+    global _tracer
+    if _tracer is not None:
+        _tracer.save()
+    _tracer = Tracer(path, run_id=run_id, clock=clock)
+    return _tracer
+
+
+def installed():
+    """The active tracer, or None when tracing is disabled."""
+    return _tracer
+
+
+def save():
+    """Flush the active tracer to its path (no-op when disabled)."""
+    if _tracer is not None:
+        return _tracer.save()
+    return None
+
+
+def uninstall(save=True):
+    """Disable tracing; optionally flush first.  Returns the written path
+    (or None)."""
+    global _tracer
+    tracer, _tracer = _tracer, None
+    if tracer is not None and save:
+        return tracer.save()
+    return None
+
+
+# --------------------------------------------------------------------- #
+# spans
+
+
+class span:
+    """Context manager AND decorator for one named span.
+
+    ``with span("dispatch", cat="train", step=3): ...`` times the block;
+    ``@span("checkpoint.save")`` times every call of the decorated function.
+    When tracing is disabled the enter/exit path is one global ``None``
+    check.  ``start()``/``stop()`` expose the manual form for spans whose
+    lifetime does not nest lexically (the runner's host-gap span).
+    """
+
+    __slots__ = ("name", "cat", "args", "_t0", "_tracer")
+
+    def __init__(self, name, cat="host", **args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+        self._tracer = None
+
+    def __enter__(self):
+        tracer = _tracer
+        self._tracer = tracer
+        if tracer is None:
+            return self
+        stack = _stack()
+        if self.args is not None and stack:
+            self.args = dict(self.args, parent=stack[-1], depth=len(stack))
+        stack.append(self.name)
+        self._t0 = tracer.now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        tracer = self._tracer
+        if tracer is None:
+            return False
+        stack = _stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        args = self.args or {}
+        if exc_type is not None:
+            args = dict(args, error=exc_type.__name__)
+        tracer.complete(self.name, self._t0, tracer.now_us() - self._t0,
+                        cat=self.cat, args=args)
+        return False
+
+    # manual form (non-lexical lifetimes)
+    start = __enter__
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+    def __call__(self, fn):
+        name, cat, args = self.name, self.cat, self.args
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with span(name, cat=cat, **args):
+                return fn(*a, **kw)
+
+        return wrapper
+
+
+def instant(name, cat="host", **args):
+    """Record an instant event (no-op when tracing is disabled)."""
+    tracer = _tracer
+    if tracer is not None:
+        tracer.instant(name, cat=cat, args=args)
+
+
+class TracedCallable:
+    """Wrap a callable (typically a jitted step function) so every call is
+    a span — WITHOUT touching the callable itself: attribute access
+    (``_cache_size``, ``lower``, ...) falls through to the wrapped function,
+    so compile-count assertions and AOT APIs keep working, and the jit
+    cache is untouched (tracing adds zero recompiles by construction).
+    ``inner`` is the unwrapped callable (the overhead benchmark's
+    uninstrumented baseline)."""
+
+    __slots__ = ("inner", "_name", "_cat")
+
+    def __init__(self, name, fn, cat="dispatch"):
+        object.__setattr__(self, "inner", fn)
+        object.__setattr__(self, "_name", name)
+        object.__setattr__(self, "_cat", cat)
+
+    def __call__(self, *args, **kwargs):
+        if _tracer is None:
+            return self.inner(*args, **kwargs)
+        with span(self._name, cat=self._cat):
+            return self.inner(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+def traced(name, fn, cat="dispatch"):
+    """Shorthand: ``traced("train_step.dispatch", jax.jit(f))``."""
+    return TracedCallable(name, fn, cat=cat)
+
+
+def validate_chrome_trace(payload):
+    """Structural check that ``payload`` (a parsed trace file) is loadable
+    Chrome trace JSON: ``traceEvents`` list, every event a dict with
+    ``ph``/``name``/``pid``/``tid``, "X" events with numeric ``ts``/``dur``.
+    Returns the event list; raises ``ValueError`` on violations.  Shared by
+    tests and scripts/run_obs_smoke.sh so the smoke asserts the same schema
+    the tests do."""
+    if not isinstance(payload, dict) or not isinstance(payload.get("traceEvents"), list):
+        raise ValueError("Chrome trace JSON wants a top-level traceEvents list")
+    for event in payload["traceEvents"]:
+        if not isinstance(event, dict):
+            raise ValueError("trace event is not an object: %r" % (event,))
+        for key in ("ph", "name", "pid", "tid"):
+            if key not in event:
+                raise ValueError("trace event missing %r: %r" % (key, event))
+        if event["ph"] == "X":
+            for key in ("ts", "dur"):
+                if not isinstance(event.get(key), (int, float)):
+                    raise ValueError("X event wants numeric %r: %r" % (key, event))
+            if event["dur"] < 0:
+                raise ValueError("X event with negative dur: %r" % (event,))
+        elif event["ph"] == "i":
+            if not isinstance(event.get("ts"), (int, float)):
+                raise ValueError("i event wants numeric ts: %r" % (event,))
+    return payload["traceEvents"]

@@ -43,6 +43,7 @@ from .. import config
 from ..core.flatten import FlatMap
 from ..core.train_state import TrainState
 from ..gars.common import centered_gram_sq_distances
+from ..obs import trace
 from ..utils import UserException
 from ..utils import compat
 from .mesh import worker_axis
@@ -774,7 +775,13 @@ class RobustEngine:
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        # The span wrapper is HOST-side only (obs/trace.py): it never touches
+        # the jitted callable, so the compile count is identical with tracing
+        # on or off (tests/test_obs.py asserts), and attribute access
+        # (``_cache_size``) falls through to the jit.
+        return trace.traced(
+            "train_step.dispatch", jax.jit(sharded, donate_argnums=(0,)), cat="train"
+        )
 
     def build_multi_step(self, loss_fn, tx, repeat_steps=None):
         """Build a jitted K-step trainer: one dispatch runs a whole scan.
@@ -815,7 +822,10 @@ class RobustEngine:
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return trace.traced(
+            "train_multi_step.dispatch", jax.jit(sharded, donate_argnums=(0,)),
+            cat="train",
+        )
 
     def build_sampled_multi_step(self, loss_fn, tx, repeat_steps, batch_size):
         """K-step trainer drawing FRESH per-worker batches ON DEVICE each
@@ -878,7 +888,10 @@ class RobustEngine:
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return trace.traced(
+            "train_sampled_multi_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,)), cat="train",
+        )
 
     def build_eval_sums(self, metric_fn):
         """Build the jitted evaluation step returning (sum, count) accumulators.
@@ -909,7 +922,7 @@ class RobustEngine:
             out_specs=P(),
             check_vma=False,
         )
-        return jax.jit(sharded)
+        return trace.traced("eval_step.dispatch", jax.jit(sharded), cat="eval")
 
     def build_eval(self, metric_fn):
         """Like ``build_eval_sums`` but divides, returning per-batch means."""

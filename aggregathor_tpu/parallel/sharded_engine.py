@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import config
 from ..core.train_state import TrainState
 from ..gars.common import centered_gram_sq_distances
+from ..obs import trace
 from ..utils import UserException
 from ..utils import compat
 from .mesh import model_axis, pipe_axis, worker_axis
@@ -650,7 +651,11 @@ class ShardedRobustEngine:
             out_specs=(state_specs, P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        # Host-side span wrapper only (obs/trace.py): the jit underneath is
+        # untouched — zero added compiles, ``_cache_size`` falls through.
+        return trace.traced(
+            "train_step.dispatch", jax.jit(sharded, donate_argnums=(0,)), cat="train"
+        )
 
     def build_multi_step(self, loss_fn, tx, state, repeat_steps=None):
         """K-step trainer in one dispatch: ``lax.scan`` over the step body,
@@ -689,7 +694,10 @@ class ShardedRobustEngine:
             out_specs=(state_specs, P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return trace.traced(
+            "train_multi_step.dispatch", jax.jit(sharded, donate_argnums=(0,)),
+            cat="train",
+        )
 
     def build_eval(self, loss_fn, state):
         """Jitted eval: mean of the sharded loss over the worker axis.
@@ -711,4 +719,4 @@ class ShardedRobustEngine:
             out_specs=P(),
             check_vma=False,
         )
-        return jax.jit(sharded)
+        return trace.traced("eval_step.dispatch", jax.jit(sharded), cat="eval")

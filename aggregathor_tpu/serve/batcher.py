@@ -28,6 +28,8 @@ import time
 
 import numpy as np
 
+from ..obs import trace
+
 
 class LoadShed(Exception):
     """Raised by ``submit`` when the queue is over ``queue_bound`` rows —
@@ -140,6 +142,8 @@ class MicroBatcher:
             # never be served, even by an idle server.
             if self._queued_rows and self._queued_rows + k > self.queue_bound:
                 self.shed_count += 1
+                trace.instant("serve.shed", cat="serve", rows=k,
+                              queued_rows=self._queued_rows)
                 raise LoadShed(
                     "queue at %d/%d rows; request of %d rows shed"
                     % (self._queued_rows, self.queue_bound, k)
@@ -148,6 +152,7 @@ class MicroBatcher:
             self._queue.append(pending)
             self._queued_rows += k
             self._wake.notify()
+        trace.instant("serve.enqueue", cat="serve", rows=k)
         return Ticket(self, pending)
 
     def _cancel(self, pending):
@@ -214,7 +219,9 @@ class MicroBatcher:
             rows = np.concatenate([p.rows for p in batch]) if len(batch) > 1 else batch[0].rows
             started = self.clock()
             try:
-                out = self.runner(rows)
+                with trace.span("serve.batch", cat="serve",
+                                rows=int(rows.shape[0]), requests=len(batch)):
+                    out = self.runner(rows)
             except Exception as exc:  # surfaced per ticket, batcher survives
                 for pending in batch:
                     pending.error = exc

@@ -28,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import trace
 from ..utils import UserException, info
 
 
@@ -218,17 +219,21 @@ class InferenceEngine:
         # forward.  The transferred buffer is the donated jit argument.
         pad = np.zeros((bucket,) + self.sample_shape, np.float32)
         pad[: rows.shape[0]] = rows
-        preds, logits, disagreement = _quiet_dispatch(
-            self._fn, self._params, jnp.asarray(pad), jnp.int32(rows.shape[0]),
-            self._vote_key,
-        )
-        n = rows.shape[0]
-        return (
-            np.asarray(jax.device_get(preds))[:n],
-            np.asarray(jax.device_get(logits))[:n],
-            np.asarray(jax.device_get(disagreement)),
-            bucket,
-        )
+        # One span covers dispatch AND the result fetch: under async
+        # dispatch the device_get is where the forward's wall time lands.
+        with trace.span("serve.jit", cat="serve", bucket=int(bucket),
+                        rows=int(rows.shape[0])):
+            preds, logits, disagreement = _quiet_dispatch(
+                self._fn, self._params, jnp.asarray(pad), jnp.int32(rows.shape[0]),
+                self._vote_key,
+            )
+            n = rows.shape[0]
+            return (
+                np.asarray(jax.device_get(preds))[:n],
+                np.asarray(jax.device_get(logits))[:n],
+                np.asarray(jax.device_get(disagreement)),
+                bucket,
+            )
 
     def predict(self, x):
         """Serve a batch: ``(n, *sample_shape)`` -> dict with ``predictions``

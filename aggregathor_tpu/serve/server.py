@@ -13,24 +13,34 @@ of :class:`serve.engine.InferenceEngine`.
   malformed input.
 - ``GET /healthz``   liveness + replica summary (suspect replicas flagged
   from the latest disagreement scores).
-- ``GET /metrics``   JSON gauge snapshot: queue depth, batch occupancy,
-  request p50/p95/p99 (``obs.perf.LatencyHistogram``), shed/served counts,
-  per-replica disagreement, compile count.
+- ``GET /metrics``   the metrics surface, in two formats: the original JSON
+  gauge snapshot (byte-compatible with the pre-registry payload — the smoke
+  scripts parse it), and Prometheus text exposition via
+  ``/metrics?format=prometheus`` or an ``Accept: text/plain`` header.  Both
+  read the ONE process-wide registry (``obs/metrics.py``): request latency
+  is a registry histogram, shed/served counts are registry counters, queue
+  depth / occupancy / compile count are scrape-time gauge callbacks.
 
 Observability flows through ``obs/summaries.SummaryWriter`` when a summary
 directory is configured: one tagged ``serve_batch`` event per dispatched
 batch and one ``serve_shed`` event per rejected request — the same JSONL
-stream the training loop writes, so one tail follows both phases.
+stream the training loop writes, so one tail follows both phases.  Span
+tracing (``obs/trace.py``, when installed) brackets the request lifecycle:
+``serve.request`` (handler) around ``serve.enqueue`` / ``serve.batch`` /
+``serve.jit`` (batcher/engine).
 """
 
 import json
 import threading
+import urllib.parse
 
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..obs import LatencyHistogram
+from ..obs import metrics as obs_metrics
+from ..obs import trace
 from ..utils import UserException, info
 from .batcher import LoadShed, MicroBatcher
 
@@ -50,15 +60,51 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code, body, content_type):
+        body = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _wants_prometheus(self, query):
+        """Format negotiation: explicit ``?format=`` wins; otherwise an
+        ``Accept`` header that asks for text/plain (and not JSON) —
+        Prometheus scrapers send ``text/plain;version=0.0.4``."""
+        fmt = urllib.parse.parse_qs(query).get("format", [None])[0]
+        if fmt is not None:
+            if fmt not in ("json", "prometheus"):
+                raise UserException(
+                    "unknown metrics format %r (json or prometheus)" % fmt
+                )
+            return fmt == "prometheus"
+        accept = self.headers.get("Accept", "")
+        return "text/plain" in accept and "application/json" not in accept
+
     def do_GET(self):
-        if self.path == "/healthz":
+        parsed = urllib.parse.urlsplit(self.path)
+        if parsed.path == "/healthz":
             self._reply(200, self.server.health_payload())
-        elif self.path == "/metrics":
-            self._reply(200, self.server.metrics_payload())
+        elif parsed.path == "/metrics":
+            try:
+                prometheus = self._wants_prometheus(parsed.query)
+            except UserException as exc:
+                self._reply(400, {"error": str(exc)})
+                return
+            if prometheus:
+                self._reply_text(200, self.server.prometheus_payload(),
+                                 obs_metrics.PROMETHEUS_CONTENT_TYPE)
+            else:
+                self._reply(200, self.server.metrics_payload())
         else:
             self._reply(404, {"error": "unknown path %r" % self.path})
 
     def do_POST(self):
+        with trace.span("serve.request", cat="serve"):
+            self._do_predict()
+
+    def _do_predict(self):
         # Drain the body FIRST, before any reply: under HTTP/1.1 keep-alive
         # an unread body would be parsed as the next request line, desyncing
         # the connection for whatever the client sends next.
@@ -110,14 +156,19 @@ class InferenceServer(ThreadingHTTPServer):
     construction — the smoke script's ready-file does).  ``summaries`` is an
     optional ``SummaryWriter``; ``flag_threshold`` marks a replica suspect
     when its latest disagreement exceeds it (non-finite scores are always
-    suspect).
+    suspect).  ``registry`` is the metrics registry to export through
+    (default: the process-wide ``obs.metrics.REGISTRY``).  CONCURRENT
+    servers sharing one registry share its serve_* instruments;
+    ``shutdown_all`` unregisters them, so a SUCCESSOR server starts from
+    fresh counts (and the scrape-time gauge closures stop pinning this
+    server's engine — its replica buffers become collectable).
     """
 
     daemon_threads = True
 
     def __init__(self, engine, host="127.0.0.1", port=0, max_latency_s=0.010,
                  queue_bound=256, summaries=None, request_timeout_s=60.0,
-                 flag_threshold=None, clock=None):
+                 flag_threshold=None, clock=None, registry=None):
         import time
 
         super().__init__((host, int(port)), _Handler)
@@ -126,7 +177,35 @@ class InferenceServer(ThreadingHTTPServer):
         self.summaries = summaries
         self.request_timeout_s = float(request_timeout_s)
         self.flag_threshold = flag_threshold
-        self.latency = LatencyHistogram()
+        self.registry = registry if registry is not None else obs_metrics.REGISTRY
+        self._metric_names = [
+            "serve_request_latency_seconds", "serve_shed_requests_total",
+            "serve_shed_rows_total", "serve_batches_total",
+            "serve_served_rows_total", "serve_replica_disagreement",
+            "serve_queue_rows", "serve_queue_bound", "serve_compile_count",
+            "serve_batch_occupancy_fill", "serve_suspect_replica_count",
+        ]
+        # Registry-backed instruments; ``latency`` keeps the LatencyHistogram
+        # API (record/percentiles/count), so the JSON payload is unchanged.
+        self.latency = self.registry.histogram(
+            "serve_request_latency_seconds", "End-to-end /predict latency"
+        )
+        self._m_shed_requests = self.registry.counter(
+            "serve_shed_requests_total", "Requests rejected by load-shedding (429)"
+        )
+        self._m_shed_rows = self.registry.counter(
+            "serve_shed_rows_total", "Rows rejected by load-shedding"
+        )
+        self._m_batches = self.registry.counter(
+            "serve_batches_total", "Micro-batches dispatched"
+        )
+        self._m_served_rows = self.registry.counter(
+            "serve_served_rows_total", "Rows served through dispatched batches"
+        )
+        self._m_disagreement = self.registry.gauge(
+            "serve_replica_disagreement",
+            "Latest per-replica disagreement score", labelnames=("replica",),
+        )
         self.shed_rows = 0
         self._event_lock = threading.Lock()
         self._last_disagreement = [0.0] * engine.nb_replicas
@@ -137,6 +216,25 @@ class InferenceServer(ThreadingHTTPServer):
             queue_bound=queue_bound,
             on_batch=self._on_batch,
         )
+        # Live views, read at scrape time (no writer loop to go stale).
+        self.registry.gauge(
+            "serve_queue_rows", "Rows queued awaiting dispatch"
+        ).set_function(lambda: self.batcher.queue_depth)
+        self.registry.gauge(
+            "serve_queue_bound", "Queued-row bound beyond which requests shed"
+        ).set_function(lambda: self.batcher.queue_bound)
+        self.registry.gauge(
+            "serve_compile_count", "Executables compiled (one per bucket shape)"
+        ).set_function(lambda: self.engine.compile_count)
+        self.registry.gauge(
+            "serve_batch_occupancy_fill", "Row fill of the last dispatched batch"
+        ).set_function(
+            lambda: (self.batcher.last_occupancy[0] / self.batcher.last_occupancy[1])
+            if self.batcher.last_occupancy[1] else 0.0
+        )
+        self.registry.gauge(
+            "serve_suspect_replica_count", "Replicas currently flagged suspect"
+        ).set_function(lambda: len(self.suspect_replicas()))
         self._serve_thread = None
 
     # ------------------------------------------------------------------ #
@@ -166,9 +264,15 @@ class InferenceServer(ThreadingHTTPServer):
 
     def _on_batch(self, rows, requests, latency_s, output):
         disagreement = np.atleast_1d(np.asarray(output.get("disagreement", [])))
+        self._m_batches.inc()
+        self._m_served_rows.inc(int(rows))
         with self._event_lock:
             if disagreement.size == self.engine.nb_replicas:
                 self._last_disagreement = [float(v) for v in disagreement]
+                for index, score in enumerate(self._last_disagreement):
+                    self._m_disagreement.labels(replica=str(index)).set(
+                        score if np.isfinite(score) else float("inf")
+                    )
         if self.summaries is not None:
             self.summaries.event(self.batcher.batch_count, "serve_batch", {
                 "rows": int(rows),
@@ -179,6 +283,8 @@ class InferenceServer(ThreadingHTTPServer):
             })
 
     def note_shed(self, rows, detail):
+        self._m_shed_requests.inc()
+        self._m_shed_rows.inc(int(rows))
         with self._event_lock:
             self.shed_rows += int(rows)
         if self.summaries is not None:
@@ -241,6 +347,12 @@ class InferenceServer(ThreadingHTTPServer):
             "nb_buckets": len(self.engine.buckets),
         }
 
+    def prometheus_payload(self):
+        """Text exposition of the whole registry (``/metrics?format=
+        prometheus``) — training/serve metrics that share the process-wide
+        registry scrape together."""
+        return self.registry.render_prometheus()
+
     # ------------------------------------------------------------------ #
     # lifecycle
 
@@ -258,10 +370,14 @@ class InferenceServer(ThreadingHTTPServer):
         return host, port
 
     def shutdown_all(self):
-        """Stop the HTTP loop and the batcher (idempotent)."""
+        """Stop the HTTP loop and the batcher (idempotent), and unregister
+        this server's serve_* instruments so a successor starts fresh and
+        the gauge closures no longer keep the engine alive."""
         self.shutdown()
         self.server_close()
         self.batcher.close()
         if self._serve_thread is not None:
             self._serve_thread.join(5.0)
             self._serve_thread = None
+        for name in self._metric_names:
+            self.registry.unregister(name)

@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# Observability smoke on CPU (<60 s), docs/observability.md: one training
+# run with an injected Byzantine worker under a TIME-VARYING chaos schedule,
+# all three telemetry pillars on — then assert
+#   1. the trace file parses as valid Chrome trace JSON (dispatch + host
+#      spans present, run_id in the metadata),
+#   2. the metrics surface scrapes in BOTH formats (training --metrics-file
+#      Prometheus text round-trips the strict parser; the serve /metrics
+#      endpoint negotiates JSON and Prometheus),
+#   3. the forensics report NAMES the injected attacker (worker 0) over a
+#      step range overlapping the attack window,
+#   4. every summary JSONL line is stamped with the shared run_id.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_obs}"
+run_id="obssmoke01"
+rm -rf "$out"
+mkdir -p "$out/sum"
+
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment mnist --experiment-args batch-size:16 \
+  --aggregator median --nb-workers 6 --nb-decl-byz-workers 1 \
+  --nb-real-byz-workers 1 --chaos "0:calm 8:attack=empire,epsilon=4.0" \
+  --max-step 24 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --summary-dir "$out/sum" --summary-delta 5 \
+  --run-id "$run_id" \
+  --trace-file "$out/run.trace.json" \
+  --metrics-file "$out/train.prom" \
+  --forensics "$out/forensics.json"
+
+python - "$out" "$run_id" <<'EOF'
+import json, os, sys
+
+out, run_id = sys.argv[1], sys.argv[2]
+
+# ---- pillar 1: Chrome trace JSON ------------------------------------- #
+from aggregathor_tpu.obs.trace import validate_chrome_trace
+
+payload = json.load(open(os.path.join(out, "run.trace.json")))
+events = validate_chrome_trace(payload)
+assert payload["otherData"]["run_id"] == run_id, payload["otherData"]
+names = {e["name"] for e in events}
+for wanted in ("train_step.dispatch", "input", "host_gap", "forensics.feed"):
+    assert wanted in names, "missing span %r (got %r)" % (wanted, sorted(names))
+dispatches = [e for e in events if e["name"] == "train_step.dispatch"]
+assert len(dispatches) == 24, len(dispatches)
+print("trace OK: %d events, %d dispatch spans, run_id %s"
+      % (len(events), len(dispatches), run_id))
+
+# ---- pillar 2a: training Prometheus dump ----------------------------- #
+from aggregathor_tpu.obs.metrics import parse_prometheus
+
+parsed = parse_prometheus(open(os.path.join(out, "train.prom")).read())
+assert parsed["train_loss"]["type"] == "gauge"
+steps = dict((n, v) for n, l, v in parsed["train_steps_total"]["samples"])
+assert steps["train_steps_total"] == 24.0, steps
+latency = parse_latency = parsed["train_step_latency_seconds"]
+assert latency["type"] == "histogram"
+count = [v for n, l, v in latency["samples"] if n.endswith("_count")]
+assert count and count[0] >= 23, count  # first/compile dispatch excluded
+workers = parsed["train_worker_sq_dist"]["samples"]
+assert {l["worker"] for n, l, v in workers} == {str(w) for w in range(6)}
+print("training exposition OK: %d families, %d steps counted"
+      % (len(parsed), steps["train_steps_total"]))
+
+# ---- pillar 3: forensics names the attacker -------------------------- #
+report = json.load(open(os.path.join(out, "forensics.json")))
+assert report["schema"] == "aggregathor.obs.forensics.v1", report["schema"]
+assert report["run_id"] == run_id
+assert report["suspects"] == [0], (
+    "forensics named %r, expected the injected worker [0]" % report["suspects"])
+intervals = report["workers"][0]["intervals"]
+assert any(iv["end"] >= 9 for iv in intervals), intervals  # attack window
+md = open(os.path.join(out, "forensics.md")).read()
+assert "worker(s) 0" in md and "**BYZANTINE**" in md
+print("forensics OK: named worker 0 over %s"
+      % ["%d-%d" % (iv["start"], iv["end"]) for iv in intervals])
+
+# ---- run_id joins the summary stream --------------------------------- #
+sum_dir = os.path.join(out, "sum")
+lines = [json.loads(line)
+         for name in os.listdir(sum_dir)
+         for line in open(os.path.join(sum_dir, name))]
+assert lines and all(line.get("run_id") == run_id for line in lines), (
+    "summary lines missing the run_id stamp")
+print("summaries OK: %d lines stamped %s" % (len(lines), run_id))
+EOF
+
+# ---- pillar 2b: the serve /metrics endpoint in BOTH formats ---------- #
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, urllib.request
+
+import jax
+
+from aggregathor_tpu import models
+from aggregathor_tpu.obs.metrics import parse_prometheus
+from aggregathor_tpu.serve import InferenceEngine, InferenceServer
+
+exp = models.instantiate("digits", ["batch-size:16"])
+params = exp.init(jax.random.PRNGKey(0))
+engine = InferenceEngine(exp, [params], max_batch=16)
+server = InferenceServer(engine, port=0, max_latency_s=0.005)
+host, port = server.serve_background()
+base = "http://%s:%d" % (host, port)
+try:
+    import numpy as np
+    rows = np.zeros((3,) + engine.sample_shape, np.float32).tolist()
+    req = urllib.request.Request(
+        base + "/predict", json.dumps({"inputs": rows}).encode(),
+        {"Content-Type": "application/json"})
+    assert json.loads(urllib.request.urlopen(req, timeout=10).read())["predictions"]
+    # JSON payload: byte-compatible keys the serve smoke scripts parse
+    metrics = json.loads(urllib.request.urlopen(base + "/metrics", timeout=10).read())
+    for key in ("queue_depth", "latency_ms", "served_rows", "compile_count"):
+        assert key in metrics, (key, sorted(metrics))
+    assert metrics["served_rows"] >= 3 and metrics["latency_ms"]["p95"] is not None
+    # explicit ?format=prometheus
+    text = urllib.request.urlopen(
+        base + "/metrics?format=prometheus", timeout=10).read().decode()
+    parsed = parse_prometheus(text)
+    assert parsed["serve_request_latency_seconds"]["type"] == "histogram"
+    served = dict((n, v) for n, l, v in parsed["serve_served_rows_total"]["samples"])
+    assert served["serve_served_rows_total"] >= 3.0, served
+    # Accept-header negotiation (what a Prometheus scraper sends)
+    req = urllib.request.Request(
+        base + "/metrics", headers={"Accept": "text/plain;version=0.0.4"})
+    negotiated = urllib.request.urlopen(req, timeout=10).read().decode()
+    parse_prometheus(negotiated)
+    assert "serve_compile_count" in negotiated
+    print("serve /metrics OK: JSON + %d Prometheus families, negotiation honored"
+          % len(parsed))
+finally:
+    server.shutdown_all()
+EOF
+
+echo "obs smoke OK: $out"
