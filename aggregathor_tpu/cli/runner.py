@@ -113,9 +113,19 @@ def build_parser():
     )
     parser.add_argument(
         "--prefetch", type=int, default=2, metavar="DEPTH",
-        help="device-resident input batches prepared ahead by a background "
-             "thread (0 disables; applies to the per-step path, --unroll "
-             "chunks already amortize the input cost)",
+        help="device-ready input batches/chunks prepared ahead of the "
+             "training dispatch (0 disables): per-step runs use a "
+             "background prefetch thread, --unroll runs the three-stage "
+             "chunk pipeline (parallel sharded gather into ping-pong "
+             "buffers, sliced async transfer, device-side assemble — "
+             "docs/input_pipeline.md)",
+    )
+    parser.add_argument(
+        "--input-slices", type=int, default=4, metavar="S",
+        help="transfer slices per --unroll chunk in the input pipeline: "
+             "each slice's host->device copy is issued as soon as it is "
+             "gathered, so the wire starts moving after 1/S of the chunk "
+             "(1 = one monolithic transfer per chunk)",
     )
     parser.add_argument(
         "--input-source", default="stream", choices=["stream", "device"],
@@ -566,6 +576,18 @@ def main(argv=None):
                         "replicating the dataset would device_put onto "
                         "non-addressable devices; use --input-source stream"
                     )
+                if (experiment.train_arrays() is None
+                        and experiment.route_augmentation_to_device()):
+                    # host-tier augmentation with an in-step device twin
+                    # (models/preprocessing.py): re-route it so augmented
+                    # training gets device sampling too (the augmentation
+                    # STREAM changes — in-step keyed draws — exactly like
+                    # the sample stream device sampling already changes)
+                    info(
+                        "--input-source device: routing %r augmentation "
+                        "through the in-step device tier"
+                        % getattr(experiment, "preprocessing", "host")
+                    )
                 if experiment.train_arrays() is None:
                     raise UserException(
                         "--input-source device: experiment %r keeps a host-side "
@@ -593,6 +615,7 @@ def main(argv=None):
             tx = build_optimizer(args.optimizer, schedule, args.optimizer_args)
             ts.gar, ts.schedule, ts.tx = gar, schedule, tx
             ts.device_dataset = None
+            ts.sampled_tail = None
             if mesh_axes is not None:
                 # ---- fully-sharded engine (per-layer GAR on sharded grads) ----
                 from ..parallel.sharded_engine import ShardedRobustEngine
@@ -674,6 +697,25 @@ def main(argv=None):
                         loss_fn, tx, repeat_steps=unroll,
                         batch_size=experiment.batch_size,
                     )
+                    tail_fns = {}
+
+                    def sampled_tail(nb_steps, _cache=tail_fns):
+                        # The final (max_step - offstep) % unroll steps run
+                        # device-sampled too, through ONE tail-sized
+                        # executable (the remainder is invariant across the
+                        # run — chunks advance by unroll and rollbacks land
+                        # on chunk boundaries — so this compiles once; a
+                        # compile-count test asserts it).
+                        fn = _cache.get(nb_steps)
+                        if fn is None:
+                            fn = engine.build_sampled_multi_step(
+                                loss_fn, tx, repeat_steps=nb_steps,
+                                batch_size=experiment.batch_size,
+                            )
+                            _cache[nb_steps] = fn
+                        return fn
+
+                    ts.sampled_tail = sampled_tail
                 else:
                     ts.multi_fn = engine.build_multi_step(loss_fn, tx) if unroll > 1 else None
                 ts.eval_fn = engine.build_eval_sums(experiment.metrics)
@@ -841,7 +883,7 @@ def main(argv=None):
     max_step = pick(args.max_step, config.default_max_step)
     train_iter = None
     prefetcher = None
-    chunk_prefetcher = None
+    chunk_pipeline = None
 
     def next_chunk():
         """K distinct batches as one (K, n, ...) stack for the unrolled path
@@ -863,13 +905,13 @@ def main(argv=None):
         passes ``reseed`` > 0 instead: it draws the replay window's batches
         from a fresh stream, one more way a retry differs from the
         deterministic trajectory that just diverged."""
-        nonlocal train_iter, prefetcher, chunk_prefetcher
+        nonlocal train_iter, prefetcher, chunk_pipeline
         if prefetcher is not None:
             prefetcher.close()
             prefetcher = None
-        if chunk_prefetcher is not None:
-            chunk_prefetcher.close()
-            chunk_prefetcher = None
+        if chunk_pipeline is not None:
+            chunk_pipeline.close()
+            chunk_pipeline = None
         train_iter = experiment.make_train_iterator(
             n, seed=args.seed + 1 + RESEED_STRIDE * reseed
         )
@@ -888,17 +930,21 @@ def main(argv=None):
         if args.prefetch > 0 and nb_processes == 1 and ts.device_dataset is None:
             # Overlap host batch assembly + host->device transfer with compute
             # (the reference's fetcher/batcher threads + prefetch queue,
-            # cnnet.py:115-146).  Under --unroll the prefetcher carries whole
-            # K-step chunks.  Disabled in multi-process runs: a background
+            # cnnet.py:115-146).  Disabled in multi-process runs: a background
             # device_put would interleave differently on each host, breaking the
             # strict cross-process ordering collectives require.
-            from ..models.datasets import DevicePrefetcher
+            from ..models.datasets import (
+                ChunkPipeline, DevicePrefetcher, supports_buffered_next_many)
 
             if unroll == 1:
                 prefetcher = DevicePrefetcher(
                     train_iter, ts.engine.shard_batch, depth=args.prefetch
                 )
             elif not args.trace:
+                # The three-stage chunk pipeline (docs/input_pipeline.md):
+                # parallel sharded gather into ping-pong host buffers,
+                # sliced async transfer, device-side assemble — overlap is
+                # exported through the metrics registry (input_* family).
                 # FINITE producer: exactly the chunks the loop will consume
                 # ((max_step-start_step) // unroll — the loop's unrolled-branch
                 # count is deterministic).  An infinite producer would over-draw
@@ -911,13 +957,24 @@ def main(argv=None):
                 # interleave per-step and unrolled dispatches, breaking the
                 # chunk count: they keep the synchronous path.)
                 chunks_total = max(0, (max_step - start_step)) // unroll
-                if chunks_total > 0:
+                if chunks_total > 0 and supports_buffered_next_many(train_iter):
+                    chunk_pipeline = ChunkPipeline(
+                        train_iter, unroll, chunks_total,
+                        put=ts.engine.shard_batches,
+                        assemble=ts.engine.assemble_batches,
+                        depth=args.prefetch, slices=args.input_slices,
+                        registry=registry,
+                    )
+                elif chunks_total > 0:
+                    # iterators without a buffered next_many(k, out=...)
+                    # (plugin experiments, possibly on the pre-pipeline
+                    # signature) keep the legacy whole-chunk prefetch thread
 
                     def chunk_source():
                         for _ in range(chunks_total):
                             yield next_chunk()
 
-                    chunk_prefetcher = DevicePrefetcher(
+                    chunk_pipeline = DevicePrefetcher(
                         chunk_source(), ts.engine.shard_batches, depth=args.prefetch
                     )
 
@@ -1324,7 +1381,6 @@ def main(argv=None):
                 gap["span"].stop()
                 gap["span"] = None
 
-        tail_warned = False
         # Chaos regime transition logging: host-side tracking of the regime
         # governing the NEXT step to dispatch (under --unroll, transitions
         # inside a chunk surface at the chunk boundary).
@@ -1355,8 +1411,8 @@ def main(argv=None):
                     with trace.span("input", cat="train"):
                         if ts.device_dataset is not None:
                             device_chunk = ts.device_dataset
-                        elif chunk_prefetcher is not None:
-                            device_chunk = next(chunk_prefetcher)
+                        elif chunk_pipeline is not None:
+                            device_chunk = next(chunk_pipeline)
                         else:
                             device_chunk = ts.engine.shard_batches(next_chunk())
                     gap_close()
@@ -1372,26 +1428,38 @@ def main(argv=None):
                     pending_loss = many["total_loss"]  # full vector: see check_divergence
                     pending_metrics = many
                     pending_start = step
+                elif ts.sampled_tail is not None:
+                    # Device-sampled tail: the final (max_step - step) <
+                    # unroll steps — and --trace windows, one step per
+                    # dispatch so the profiler window sees step boundaries —
+                    # run through a tail-sized SAMPLED executable.  Every
+                    # step of a device-input run is device-sampled; no
+                    # host-batch fallback remains.  The tail length is a
+                    # pure function of (max_step, offstep, unroll), so the
+                    # executable compiles once per run (asserted by
+                    # tests/test_input_pipeline.py's compile-count test).
+                    nb_steps = 1 if trace_ctx is not None else max_step - step
+                    tail_fn = ts.sampled_tail(nb_steps)
+                    gap_close()
+                    perf.step_begin()
+                    state, many = tail_fn(state, ts.device_dataset)
+                    if observe_pending():
+                        continue  # previous chunk diverged: this one is abandoned
+                    check_divergence()
+                    metrics = jax.tree_util.tree_map(lambda x: x[-1], many)
+                    perf.step_end(nb_steps)
+                    gap_open()
+                    chunk = nb_steps
+                    pending_loss = many["total_loss"]
+                    pending_metrics = many
+                    pending_start = step
                 else:
-                    if (ts.device_dataset is not None and not tail_warned
-                            and not stop["requested"]):
-                        # Tail steps (max_step % unroll) and --trace windows
-                        # fall back to per-step HOST batches — say so once,
-                        # or a tunnel-bound tail is inexplicable from the
-                        # logs.  (device_dataset itself stays set: the
-                        # unrolled branch resumes after a --trace window.)
-                        tail_warned = True
-                        warning(
-                            "--input-source device: trace-window/tail steps "
-                            "use per-step host batches (the sampled trainer "
-                            "dispatches whole --unroll chunks)"
-                        )
-                    if chunk_prefetcher is not None:
+                    if chunk_pipeline is not None:
                         # Entering the per-step tail: retire the chunk
                         # producer FIRST — its daemon shares train_iter and
                         # numpy Generators are not thread-safe.
-                        chunk_prefetcher.close()
-                        chunk_prefetcher = None
+                        chunk_pipeline.close()
+                        chunk_pipeline = None
                     with trace.span("input", cat="train"):
                         batch = next(prefetcher) if prefetcher is not None else ts.engine.shard_batch(next(train_iter))
                     gap_close()
@@ -1462,8 +1530,8 @@ def main(argv=None):
                     summaries.scalars(step, summary_scalars(step, metrics))
             if prefetcher is not None:
                 prefetcher.close()
-            if chunk_prefetcher is not None:
-                chunk_prefetcher.close()
+            if chunk_pipeline is not None:
+                chunk_pipeline.close()
             eval_file.close()
             summaries.close()
             gap_close()

@@ -121,17 +121,55 @@ class Experiment:
         at 2.0 steps/s vs 26 resident), and a dataset transferred once
         removes it.
 
-        Default: the ``self.dataset`` train split for experiments that
-        moved their augmentation in-step (``augment:device`` — the host
-        path is then a plain gather); None otherwise (host augmentation or
-        a host transform must see every batch).
+        Default: the ``self.dataset`` train split for experiments whose
+        host input path is a plain gather — augmentation moved in-step
+        (``augment:device``) or a host tier that is the identity
+        (``preprocessing:none``/``lenet``); None otherwise (a stateful host
+        transform — augmentation streams, poisoning — must see every batch).
         """
-        if getattr(self, "augment", "host") != "device":
+        augment = getattr(self, "augment", None)
+        if augment == "device":
+            eligible = True
+        elif augment == "host":
+            from .preprocessing import PREPROCESSING, none_preprocessing
+
+            eligible = (
+                PREPROCESSING.get(getattr(self, "preprocessing", None))
+                is none_preprocessing
+            )
+        else:
+            eligible = False
+        if not eligible:
             return None
         dataset = getattr(self, "dataset", None)
         if dataset is None:
             return None
         return {"image": dataset.x_train, "label": dataset.y_train}
+
+    def route_augmentation_to_device(self):
+        """Move a host-tier augmentation to its in-step device twin
+        (models/preprocessing.py ``DEVICE_PREPROCESSING``), making the host
+        input path a plain gather so DEVICE-RESIDENT sampling
+        (``train_arrays`` + ``RobustEngine.build_sampled_multi_step``) can
+        serve augmented training too.  Returns True when the experiment now
+        augments in-step (or already did); False when it has no
+        re-routable augmentation machinery — a stateful non-augmentation
+        transform (poisoning, streaming corpus) stays host-bound and
+        ``train_arrays`` keeps returning None.  Note the augmentation
+        STREAM changes (numpy per-worker generators -> in-step
+        (seed, step, worker) keys) — same distribution, different draws,
+        exactly like the device sampling it enables."""
+        if getattr(self, "augment", None) == "device":
+            return True
+        name = getattr(self, "preprocessing", None)
+        if getattr(self, "augment", None) != "host" or name is None:
+            return False
+        from .preprocessing import DEVICE_PREPROCESSING
+
+        if name not in DEVICE_PREPROCESSING:
+            return False
+        self.augment = "device"
+        return True
 
 
 import_directory(__name__, __path__, skip=("datasets",))

@@ -20,10 +20,99 @@ equivalent of the reference's dataset-on-task-CPU placement (graph.py:248-252).
 """
 
 import os
+import threading
 
 import numpy as np
 
 from ..utils import UserException, can_access, info, warning
+
+# --------------------------------------------------------------------- #
+# Sharded host gather: the ~250 MB-per-chunk fancy-index gather of
+# ``WorkerBatchIterator.next_many`` split into contiguous row ranges
+# written concurrently via ``np.take(..., out=...)``.  The reference hid
+# this work behind TF queue-runner fetcher/batcher thread pools
+# (experiments/cnnet.py:115-146); this is the numpy-side equivalent, and
+# with ``out=`` there is also no fresh ~250 MB allocation per chunk.
+
+#: rows below this skip the pool entirely (thread dispatch costs more than
+#: the copy it would parallelize)
+_GATHER_POOL_MIN_ROWS = 4096
+
+_gather_pool = None
+_gather_pool_lock = threading.Lock()
+
+
+def gather_threads():
+    """Worker count for the sharded gather pool: ``AGGREGATHOR_GATHER_THREADS``
+    or min(4, cpu_count).  0/1 disables the pool (single-shot gather)."""
+    env = os.environ.get("AGGREGATHOR_GATHER_THREADS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            raise UserException(
+                "AGGREGATHOR_GATHER_THREADS must be an integer (got %r)" % env
+            )
+    return min(4, os.cpu_count() or 1)
+
+
+def _pool():
+    global _gather_pool
+    if _gather_pool is None:
+        with _gather_pool_lock:
+            if _gather_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _gather_pool = ThreadPoolExecutor(
+                    max_workers=gather_threads(), thread_name_prefix="gather"
+                )
+    return _gather_pool
+
+
+def sharded_take(src, indices, out):
+    """``out[:] = src[indices]`` with the row copies sharded over the gather
+    pool.  Bit-identical to the fancy index by construction (``np.take``
+    writes the same rows; shards are disjoint contiguous ranges of ``out``).
+    Falls back to one single-shot ``np.take`` for small gathers or when the
+    pool is disabled."""
+    nb = gather_threads()
+    rows = indices.shape[0]
+    if nb <= 1 or rows < _GATHER_POOL_MIN_ROWS:
+        np.take(src, indices, axis=0, out=out)
+        return out
+    bounds = np.linspace(0, rows, nb + 1).astype(np.int64)
+    futures = [
+        _pool().submit(np.take, src, indices[lo:hi], 0, out[lo:hi])
+        for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo
+    ]
+    for future in futures:
+        future.result()  # re-raises a shard's failure
+    return out
+
+
+def supports_buffered_next_many(iterator):
+    """True when ``iterator.next_many`` accepts the ``out=`` buffer the
+    ChunkPipeline's ping-pong gather needs.  Plugin iterators that copied
+    the pre-pipeline ``next_many(k)`` signature stay on the legacy
+    whole-chunk prefetch path instead of crashing in the producer."""
+    next_many = getattr(iterator, "next_many", None)
+    if next_many is None:
+        return False
+    import inspect
+
+    try:
+        return "out" in inspect.signature(next_many).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def transform_is_stateless(transform):
+    """True when ``transform`` declared itself stateless (``.stateless``):
+    its output depends only on its inputs — it draws no RNG and keeps no
+    call-count state — so skipping batches never needs to invoke it and
+    batches may be produced out of order (models/preprocessing.py marks the
+    identity tier; custom transforms opt in via ``stateless(fn)``)."""
+    return transform is None or bool(getattr(transform, "stateless", False))
 
 
 def _data_dirs():
@@ -340,8 +429,19 @@ class WorkerBatchIterator:
     def __iter__(self):
         return self
 
+    def _draw_indices(self, k):
+        """The (k, nb_workers, batch) index block: worker streams drawn
+        batch-major exactly like ``__next__`` — every consumer of a block
+        shares this one definition, so sharded/sequential gathers and
+        ``skip`` can never disagree about the sample streams."""
+        idx = np.empty((k, self.nb_workers, self.batch_size), dtype=np.int64)
+        for step in range(k):
+            for w, rng in enumerate(self.rngs):
+                idx[step, w] = rng.integers(0, self.x.shape[0], size=self.batch_size)
+        return idx
+
     def __next__(self):
-        idx = np.stack([rng.integers(0, self.x.shape[0], size=self.batch_size) for rng in self.rngs])
+        idx = self._draw_indices(1)[0]
         flat = idx.reshape(-1)
         bx = self.x[flat].reshape((self.nb_workers, self.batch_size) + self.x.shape[1:])
         by = self.y[flat].reshape(self.nb_workers, self.batch_size)
@@ -355,10 +455,12 @@ class WorkerBatchIterator:
         restoring step S, the stream must sit exactly where an
         uninterrupted run's would, so the resumed trajectory is
         bit-identical.  Stateful host transforms (preprocessing.py per-worker
-        augmentation streams) must advance in lockstep, so with a transform
-        the full draw path is kept."""
+        augmentation streams) must advance in lockstep, so those keep the
+        full draw path; stateless transforms (``transform_is_stateless``)
+        consume no per-batch randomness, so only the index streams advance —
+        resuming after a long run costs index draws, not gathers."""
         k = int(k)
-        if self.transform is not None:
+        if not transform_is_stateless(self.transform):
             for _ in range(k):
                 next(self)
             return
@@ -366,32 +468,61 @@ class WorkerBatchIterator:
             for rng in self.rngs:
                 rng.integers(0, self.x.shape[0], size=self.batch_size)
 
-    def next_many(self, k):
+    def alloc_chunk(self, k):
+        """A preallocated (k, nb_workers, batch, ...) chunk for
+        ``next_many(k, out=...)`` — the ping-pong buffers of the input
+        pipeline are two of these."""
+        k = int(k)
+        return {
+            "image": np.empty(
+                (k, self.nb_workers, self.batch_size) + self.x.shape[1:], self.x.dtype
+            ),
+            "label": np.empty((k, self.nb_workers, self.batch_size), self.y.dtype),
+        }
+
+    def next_many(self, k, out=None):
         """K batches in one call: a (k, nb_workers, batch, ...) stack.
 
         Sample streams are identical to k successive ``next()`` calls (each
-        batch's indices are drawn per worker in the same order); the speedup
-        is doing ONE gather into a contiguous stack instead of k gathers plus
-        an ``np.stack`` re-copy — at CIFAR bench scale (k=20, n=8, b=128)
-        that re-copy alone cost seconds per chunk.  With a host ``transform``
-        the per-batch path is kept (host augmentation is per-batch seeded);
-        the fast path serves device-side augmentation (preprocessing.py
-        ``device_transform``), where the host's only job is the gather.
+        batch's indices are drawn per worker in the same order; asserted by
+        tests/test_input_pipeline.py).  The gather is sharded over a small
+        thread pool via ``np.take(..., out=...)`` (``sharded_take``), and
+        with ``out`` (an ``alloc_chunk(k)`` buffer) it re-fills the caller's
+        buffer instead of allocating ~chunk-size afresh — the zero-re-copy
+        half of the input pipeline (ChunkPipeline alternates two such
+        buffers).  Without ``out`` a fresh chunk is allocated (still one
+        sharded gather, no ``np.stack`` re-copy).
+
+        A STATEFUL host ``transform`` (per-worker augmentation streams,
+        poisoning) must see every batch in order, so that path keeps the
+        per-batch draws; stateless transforms run on the gathered stack.
         """
-        if self.transform is not None:
+        if not transform_is_stateless(self.transform):
             batches = [next(self) for _ in range(k)]
-            return {
+            stack = {
                 name: np.stack([b[name] for b in batches]) for name in batches[0]
             }
-        # (k, n, b) index block, worker streams drawn batch-major like next()
-        idx = np.empty((k, self.nb_workers, self.batch_size), dtype=np.int64)
-        for step in range(k):
-            for w, rng in enumerate(self.rngs):
-                idx[step, w] = rng.integers(0, self.x.shape[0], size=self.batch_size)
+            if out is not None:
+                for name, value in stack.items():
+                    out[name][...] = value
+                return out
+            return stack
+        idx = self._draw_indices(k)
         flat = idx.reshape(-1)
-        bx = self.x[flat].reshape((k, self.nb_workers, self.batch_size) + self.x.shape[1:])
-        by = self.y[flat].reshape(k, self.nb_workers, self.batch_size)
-        return {"image": bx, "label": by}
+        if out is None:
+            out = self.alloc_chunk(k)
+        sharded_take(self.x, flat, out["image"].reshape((-1,) + self.x.shape[1:]))
+        sharded_take(self.y, flat, out["label"].reshape(-1))
+        if self.transform is not None:
+            # stateless: per-slice application == sequential application
+            for step in range(k):
+                img, lab = out["image"][step], out["label"][step]
+                bx, by = self.transform(img, lab)
+                if bx is not img:
+                    img[...] = bx
+                if by is not lab:
+                    lab[...] = by
+        return out
 
 
 def eval_batches(x, y, nb_workers, batch_size):
@@ -489,3 +620,217 @@ class DevicePrefetcher:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
+
+
+def split_chunk(chunk, nb_slices):
+    """Split a (K, ...) host chunk into ``nb_slices`` contiguous step-axis
+    slices (views, no copy; ``np.array_split`` boundaries, so slice shapes
+    are a pure function of (K, nb_slices) — stable across chunks, one
+    compiled transfer/assemble program per pipeline)."""
+    leaves = list(chunk.values())
+    k = leaves[0].shape[0]
+    nb_slices = max(1, min(int(nb_slices), k))
+    bounds = [k * i // nb_slices for i in range(nb_slices + 1)]
+    return [
+        {name: value[lo:hi] for name, value in chunk.items()}
+        for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo
+    ]
+
+
+class ChunkPipeline:
+    """Three-stage pipelined host→device input for the unrolled trainer.
+
+    Replaces the chunk-path ``DevicePrefetcher`` (measured SLOWER than
+    synchronous dispatch, BENCH_r05: 2.62 vs 2.74 steps/s — its one daemon
+    thread serially re-did the whole gather + one monolithic ``device_put``
+    the sync path pays anyway).  Here each stage overlaps with the next
+    *and* with device compute:
+
+    1. **parallel zero-re-copy gather** — ``iterator.next_many(unroll,
+       out=...)`` refills one of TWO preallocated ping-pong host buffers,
+       the row copies sharded over the gather pool (``sharded_take``);
+    2. **sliced transfer** — the chunk is split into ``slices`` step-axis
+       slices (``split_chunk``) and each is issued as its own async
+       ``put`` (= ``engine.shard_batches``), so the wire starts moving
+       after the first 1/S of the chunk instead of after all of it;
+    3. **device-side assemble** — ``assemble`` (= ``engine.
+       assemble_batches``, a jitted concatenate compiled once) turns the
+       slice transfers into the one (K, n, ...) chunk the scanned trainer
+       consumes, all while the PREVIOUS chunk's scan occupies the device.
+
+    **Aliasing safety** (the ping-pong contract): buffer ``i % 2`` is
+    re-gathered for chunk ``i+2`` only after chunk ``i``'s *assembled*
+    device chunk is materialized (``block_until_ready``) — at that point
+    the concatenate has consumed the slice buffers, so even a zero-copy
+    ``device_put`` that aliased host memory can no longer observe the
+    overwrite.  Consumers therefore never receive a chunk whose backing
+    store a later gather may touch.
+
+    The producer is FINITE (``nb_chunks``) for the same reason the old
+    chunk prefetcher was: it shares ``iterator`` with the caller's tail
+    path, so it must consume exactly the chunks the loop will, then exit —
+    after exhaustion (or ``close()``), the caller's direct ``iterator``
+    use cannot race the daemon.
+
+    Overlap is *measured*, not presumed: with a ``registry``
+    (obs/metrics.py) the pipeline exports ``input_gather_seconds_total`` /
+    ``input_put_seconds_total`` (producer busy time), ``input_wait_seconds_
+    total`` (consumer blocked in ``__next__`` — the true input gap),
+    ``input_chunks_total``, a live ``input_queue_depth`` gauge and the
+    derived ``input_overlap_fraction`` (1 - wait/busy: the fraction of
+    input work hidden under compute); the producer stages also emit
+    ``input.gather`` / ``input.put`` trace spans next to the runner's
+    ``host_gap``.
+    """
+
+    def __init__(self, iterator, unroll, nb_chunks, put, assemble,
+                 depth=2, slices=4, registry=None):
+        import queue
+
+        self._iterator = iterator
+        self._unroll = int(unroll)
+        self._nb_chunks = int(nb_chunks)
+        self._put = put
+        self._assemble = assemble
+        self._slices = max(1, int(slices))
+        self._queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._terminal = None
+        self._buffers = [None, None]  # ping-pong host chunks (lazy alloc)
+        self._retire = [None, None]   # assembled device chunk per buffer
+        self._wait_s = 0.0
+        self._gauge_depth = None
+        if registry is not None:
+            self._c_gather = registry.counter(
+                "input_gather_seconds_total",
+                "Producer time in the sharded host gather")
+            self._c_put = registry.counter(
+                "input_put_seconds_total",
+                "Producer time issuing slice transfers + assemble")
+            self._c_wait = registry.counter(
+                "input_wait_seconds_total",
+                "Consumer time blocked waiting for an input chunk")
+            self._c_chunks = registry.counter(
+                "input_chunks_total", "Chunks produced by the input pipeline")
+            self._gauge_depth = registry.gauge(
+                "input_queue_depth", "Device-ready input chunks queued")
+            self._gauge_depth.set_function(self._queue.qsize)
+            gather, put_c, wait = self._c_gather, self._c_put, self._c_wait
+
+            def overlap_fraction():
+                busy = gather.value + put_c.value
+                if busy <= 0.0:
+                    return 0.0
+                return max(0.0, min(1.0, 1.0 - wait.value / busy))
+
+            registry.gauge(
+                "input_overlap_fraction",
+                "Fraction of input-pipeline work hidden under device compute "
+                "(1 - wait/busy)",
+            ).set_function(overlap_fraction)
+        else:
+            class _Null:
+                value = 0.0
+
+                def inc(self, amount=1.0):
+                    pass
+
+            self._c_gather = self._c_put = self._c_wait = self._c_chunks = _Null()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="input-pipeline"
+        )
+        self._thread.start()
+
+    # producer ---------------------------------------------------------- #
+
+    def _run(self):
+        import time
+
+        import jax
+
+        from ..obs import trace
+
+        try:
+            for index in range(self._nb_chunks):
+                if self._stop.is_set():
+                    return
+                slot = index % 2
+                if self._retire[slot] is not None:
+                    # aliasing safety: chunk index-2's assemble must have
+                    # consumed this buffer's slice transfers before regather
+                    jax.block_until_ready(self._retire[slot])
+                t0 = time.perf_counter()
+                with trace.span("input.gather", cat="input"):
+                    host = self._iterator.next_many(
+                        self._unroll, out=self._buffers[slot]
+                    )
+                self._buffers[slot] = host
+                self._c_gather.inc(time.perf_counter() - t0)
+                if self._stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                with trace.span("input.put", cat="input"):
+                    parts = [self._put(s) for s in split_chunk(host, self._slices)]
+                    device_chunk = self._assemble(parts)
+                self._c_put.inc(time.perf_counter() - t0)
+                self._retire[slot] = device_chunk
+                self._c_chunks.inc()
+                self._queue.put(device_chunk)
+            self._queue.put(_PrefetchError(StopIteration()))
+        except BaseException as exc:  # surfaced on the consumer side
+            self._queue.put(_PrefetchError(exc))
+
+    # consumer ---------------------------------------------------------- #
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import time
+
+        if self._terminal is not None:  # iterator protocol: stay terminal
+            raise self._terminal
+        t0 = time.perf_counter()
+        item = self._queue.get()
+        waited = time.perf_counter() - t0
+        self._c_wait.inc(waited)
+        self._wait_s += waited
+        if isinstance(item, _PrefetchError):
+            self._terminal = item.exc
+            raise item.exc
+        return item
+
+    @property
+    def wait_seconds(self):
+        """Total time THIS consumer spent blocked in ``__next__`` (the
+        registry counter is process-cumulative across pipelines)."""
+        return self._wait_s
+
+    def close(self):
+        """Stop and join the producer; afterwards the shared ``iterator``
+        is exclusively the caller's again (the guardian-rollback /
+        tail-handoff contract).  Same bounded drain-and-join discipline as
+        ``DevicePrefetcher.close``; idempotent."""
+        import queue
+        import time
+
+        self._stop.set()
+        self._terminal = StopIteration()
+        deadline = time.monotonic() + 5.0
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._gauge_depth is not None:
+            self._gauge_depth.set(0.0)  # drop the qsize closure pinning us
+            self._gauge_depth = None
+        self._buffers = [None, None]
+        self._retire = [None, None]

@@ -35,9 +35,14 @@ class MNISTAttackExperiment(MNISTExperiment):
         return poisoned, shuffled
 
     def make_train_iterator(self, nb_workers, seed=0):
+        from .preprocessing import stateless
+
+        # the poison is a pure function of its inputs (severity-2's rng is
+        # keyed off the batch's own labels), so resume fast-forward may
+        # skip it: only the index streams need advancing
         return WorkerBatchIterator(
             self.dataset.x_train, self.dataset.y_train, nb_workers, self.batch_size,
-            seed=seed, transform=self._poison,
+            seed=seed, transform=stateless(lambda bx, by: self._poison(bx, by)),
         )
 
     def train_arrays(self):

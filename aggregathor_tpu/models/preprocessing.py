@@ -44,8 +44,20 @@ class _PerWorkerRng:
         return self._rngs[worker]
 
 
+def stateless(transform):
+    """Declare ``transform`` stateless: its output depends only on its
+    inputs — no RNG draws, no call-count state.  The batch iterator then
+    skips it entirely on resume fast-forward (``WorkerBatchIterator.skip``
+    advances only the index streams — seconds per thousand skipped steps
+    saved) and applies it per-slice on the gathered ``next_many`` stack.
+    Stateful transforms (the per-worker augmentation streams below,
+    poisoning) must NOT be marked: their streams advance per batch."""
+    transform.stateless = True
+    return transform
+
+
 def none_preprocessing(seed=0):
-    return lambda bx, by: (bx, by)
+    return stateless(lambda bx, by: (bx, by))
 
 
 def cifarnet_preprocessing(seed=0, pad=4):

@@ -272,6 +272,8 @@ class RobustEngine:
         self.carries_gradients = (lossy_link is not None and lossy_link.clever) or (
             self.chaos is not None and self.chaos.needs_carry
         )
+        # jitted slice-concat executables for assemble_batches, per slice count
+        self._assemble_cache = {}
 
     # ------------------------------------------------------------------ #
 
@@ -942,9 +944,28 @@ class RobustEngine:
         return jax.device_put(batch, spec)
 
     def shard_batches(self, batches):
-        """Device_put a (K, nb_workers, ...) batch stack for build_multi_step."""
+        """Device_put a (K, nb_workers, ...) batch stack for build_multi_step.
+
+        The step axis is unsharded, so this also places a chunk SLICE
+        ((k_i, nb_workers, ...) for any k_i) — the input pipeline
+        (models/datasets.py ChunkPipeline) issues one such transfer per
+        slice and re-joins them with :meth:`assemble_batches`."""
         spec = jax.sharding.NamedSharding(self.mesh, P(None, worker_axis))
         return jax.device_put(batches, spec)
+
+    def assemble_batches(self, parts):
+        """Concatenate step-axis chunk slices (each ``shard_batches``-placed)
+        into the one (K, nb_workers, ...) device chunk ``build_multi_step``
+        consumes.  Jitted (cached per slice count), so after the first chunk
+        this is a single device-side executable whose output is a FRESH
+        buffer — the input pipeline's host ping-pong buffers are safe to
+        reuse once it has run, even if a backend aliased a ``device_put``."""
+        fn = self._assemble_cache.get(len(parts))
+        if fn is None:
+            fn = jax.jit(lambda *xs: jax.tree_util.tree_map(
+                lambda *leaves: jnp.concatenate(leaves, axis=0), *xs))
+            self._assemble_cache[len(parts)] = fn
+        return fn(*parts)
 
     def replicate(self, tree):
         """Device_put a pytree fully replicated over the mesh."""

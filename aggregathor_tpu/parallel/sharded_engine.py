@@ -87,6 +87,7 @@ class ShardedRobustEngine:
         self.gar = gar
         self.nb_workers = mesh.shape[worker_axis]
         self._state_shardings = None  # captured by init_state, for put_state
+        self._assemble_cache = {}  # slice-concat executables, per slice count
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
         self.lossy_link = lossy_link
@@ -258,8 +259,23 @@ class ShardedRobustEngine:
         return jax.device_put(batch, NamedSharding(self.mesh, P(worker_axis)))
 
     def shard_batches(self, batches):
-        """Device_put a (K, nb_workers, ...) chunk for ``build_multi_step``."""
+        """Device_put a (K, nb_workers, ...) chunk for ``build_multi_step``.
+        The step axis is unsharded, so chunk SLICES place identically — the
+        input pipeline issues one transfer per slice (ChunkPipeline)."""
         return jax.device_put(batches, NamedSharding(self.mesh, P(None, worker_axis)))
+
+    def assemble_batches(self, parts):
+        """Concatenate step-axis chunk slices into one (K, nb_workers, ...)
+        device chunk — the sharded-engine twin of
+        ``RobustEngine.assemble_batches`` (jitted once per slice count;
+        output is a fresh buffer, releasing the pipeline's host ping-pong
+        buffers for reuse)."""
+        fn = self._assemble_cache.get(len(parts))
+        if fn is None:
+            fn = jax.jit(lambda *xs: jax.tree.map(
+                lambda *leaves: jnp.concatenate(leaves, axis=0), *xs))
+            self._assemble_cache[len(parts)] = fn
+        return fn(*parts)
 
     def put_state(self, state):
         """Re-shard a (possibly host-resident) state onto this mesh with the

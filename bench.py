@@ -70,6 +70,24 @@ def _phase(msg):
           file=sys.stderr, flush=True)
 
 
+def _cost_key(cost, key):
+    """One key of an XLA ``cost_analysis()`` mapping as a positive float, or
+    None.  Guarded PER KEY: backends variously return None instead of a
+    mapping, a mapping missing the key, or a None/garbage value under it
+    (BENCH_r05's "'NoneType' object is not subscriptable") — any of those
+    degrades this one key, never the sibling keys."""
+    if cost is None:
+        return None
+    try:
+        value = cost.get(key)
+        if value is None:
+            return None
+        value = float(value)
+    except Exception:
+        return None
+    return value if value > 0.0 else None
+
+
 def run_bench(force_cpu=False, emit=lambda result: None):
     """Measure config 2; ``emit(result)`` is called with an UPDATED result
     dict after every completed phase (per-step dispatch, scanned fresh,
@@ -257,29 +275,40 @@ def run_bench(force_cpu=False, emit=lambda result: None):
         # compile.
         try:
             cost = step_fn.lower(state, resident_batch).cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0]
-            detail["flops_per_step"] = float(cost["flops"])
-            bytes_per_step = float(cost.get("bytes accessed", 0.0) or 0.0)
-            if bytes_per_step:
-                # Roofline context: config 2 moves ~21 GB/step for 1.7e11
-                # FLOPs (arithmetic intensity ~8 FLOP/byte), so the v5e's
-                # ~819 GB/s HBM caps it far below the MXU peak — the honest
-                # bar for this config is the MEMORY roofline, and MFU-vs-
-                # bf16-peak states how much that intensity leaves on the
-                # table, not an achievable target.
-                detail["bytes_per_step"] = bytes_per_step
-                # Whole-program bytes vs whole-mesh bandwidth — the same
-                # convention as flops vs peak above.
-                detail["hbm_roofline_steps_per_s"] = round(
-                    hbm_bw * nb_devices / bytes_per_step, 2)
+        except Exception as exc:
+            cost = None
+            _phase("%s: lowered cost analysis unavailable (%s); MFU omitted" % (tag, exc))
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        # Per-KEY guard (BENCH_r05: some backends return None, or a mapping
+        # missing/None-valued per key — one bad key must not discard the
+        # others, so flops/MFU still report whenever the backend provides
+        # them and each absent key degrades silently on its own).
+        flops = _cost_key(cost, "flops")
+        bytes_per_step = _cost_key(cost, "bytes accessed") or 0.0
+        if flops:
+            detail["flops_per_step"] = flops
+        if bytes_per_step:
+            # Roofline context: config 2 moves ~21 GB/step for 1.7e11
+            # FLOPs (arithmetic intensity ~8 FLOP/byte), so the v5e's
+            # ~819 GB/s HBM caps it far below the MXU peak — the honest
+            # bar for this config is the MEMORY roofline, and MFU-vs-
+            # bf16-peak states how much that intensity leaves on the
+            # table, not an achievable target.
+            detail["bytes_per_step"] = bytes_per_step
+            # Whole-program bytes vs whole-mesh bandwidth — the same
+            # convention as flops vs peak above.
+            detail["hbm_roofline_steps_per_s"] = round(
+                hbm_bw * nb_devices / bytes_per_step, 2)
+        if flops or bytes_per_step:
             _phase("%s: cost analysis %.3e flops/step, %.3e bytes/step" % (
-                tag, detail["flops_per_step"], bytes_per_step))
+                tag, flops or 0.0, bytes_per_step))
             # Re-emit so the current best (still per-step dispatch at this
             # point) gets its MFU field even if no later phase beats it.
             refresh(best_fresh, detail["headline_source"], detail["timed_steps"])
-        except Exception as exc:
-            _phase("%s: lowered cost analysis unavailable (%s); MFU omitted" % (tag, exc))
+        elif cost is not None:
+            _phase("%s: cost analysis carries neither flops nor bytes; MFU omitted"
+                   % tag)
 
         # Scale timed-loop length to the observed rate so each loop stays
         # ~<=90 s even if the chip runs this program far slower than expected.

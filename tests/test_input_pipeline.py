@@ -1,0 +1,431 @@
+"""Input pipeline: sharded gather bit-identity, ping-pong aliasing safety,
+pipeline shutdown/handoff discipline, device-sampled tail, fast skip.
+
+The contracts under test are the ones ISSUE 5 rebuilt the host->device
+input path around (docs/input_pipeline.md):
+
+- ``WorkerBatchIterator.next_many`` (sharded ``np.take(..., out=...)``
+  gather) produces byte-identical sample streams to sequential ``next()``,
+  with and without a caller-owned ping-pong buffer;
+- a chunk handed to the consumer is NEVER overwritten by a later gather
+  before its dispatch retired (the ping-pong contract);
+- ``ChunkPipeline`` exhaustion / ``close()`` hands the shared iterator
+  back to the caller with no daemon racing it (the tail-handoff and
+  guardian-rollback patterns in cli/runner.py);
+- the device-sampled tail executable compiles ONCE and its trajectory is
+  the exact prefix of a longer sampled run;
+- ``skip`` with a stateless transform advances the index streams only and
+  still lands on the exact sequential stream position.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars, models
+from aggregathor_tpu.core import build_optimizer, build_schedule
+from aggregathor_tpu.models import datasets
+from aggregathor_tpu.models.datasets import (
+    ChunkPipeline, WorkerBatchIterator, sharded_take, split_chunk,
+    supports_buffered_next_many, transform_is_stateless)
+from aggregathor_tpu.models.preprocessing import (
+    instantiate as make_preprocessing, stateless)
+from aggregathor_tpu.obs.metrics import MetricsRegistry
+from aggregathor_tpu.parallel import RobustEngine, make_mesh
+
+
+@pytest.fixture
+def corpus(rng):
+    x = rng.normal(size=(512, 8, 8, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=512).astype(np.int32)
+    return x, y
+
+
+@pytest.fixture
+def forced_pool(monkeypatch):
+    """Force the sharded gather down the thread-pool path regardless of
+    gather size, with a fresh pool so the worker-count env var is honored."""
+    monkeypatch.setenv("AGGREGATHOR_GATHER_THREADS", "3")
+    monkeypatch.setattr(datasets, "_GATHER_POOL_MIN_ROWS", 1)
+    monkeypatch.setattr(datasets, "_gather_pool", None)
+    yield
+    datasets._gather_pool = None
+
+
+def make_engine(n=4, nb_devices=4, batch_transform=None):
+    gar = gars.instantiate("average", n, 0)
+    mesh = make_mesh(nb_workers=nb_devices)
+    return RobustEngine(mesh, gar, nb_workers=n, batch_transform=batch_transform)
+
+
+# --------------------------------------------------------------------- #
+# sharded gather bit-identity
+
+
+def test_sharded_take_matches_fancy_index(corpus, forced_pool, rng):
+    x, _ = corpus
+    idx = rng.integers(0, x.shape[0], size=1000)
+    out = np.empty((1000,) + x.shape[1:], x.dtype)
+    sharded_take(x, idx, out)
+    np.testing.assert_array_equal(out, x[idx])
+
+
+def test_next_many_bit_identical_to_sequential(corpus, forced_pool):
+    x, y = corpus
+    a = WorkerBatchIterator(x, y, 4, 16, seed=5)
+    b = WorkerBatchIterator(x, y, 4, 16, seed=5)
+    many = a.next_many(6)
+    for step in range(6):
+        ref = next(b)
+        np.testing.assert_array_equal(many["image"][step], ref["image"])
+        np.testing.assert_array_equal(many["label"][step], ref["label"])
+    # ...and the NEXT draws still agree: next_many advanced the per-worker
+    # streams exactly as six next() calls did
+    np.testing.assert_array_equal(next(a)["image"], next(b)["image"])
+
+
+def test_next_many_out_buffer_bit_identical(corpus, forced_pool):
+    x, y = corpus
+    a = WorkerBatchIterator(x, y, 4, 16, seed=5)
+    b = WorkerBatchIterator(x, y, 4, 16, seed=5)
+    buf = a.alloc_chunk(6)
+    out = a.next_many(6, out=buf)
+    assert out is buf, "out= must refill the caller's buffer, not allocate"
+    ref = b.next_many(6)
+    np.testing.assert_array_equal(buf["image"], ref["image"])
+    np.testing.assert_array_equal(buf["label"], ref["label"])
+    # refilling the same buffer yields the NEXT chunk (streams advanced)
+    ref2 = b.next_many(6)
+    a.next_many(6, out=buf)
+    np.testing.assert_array_equal(buf["image"], ref2["image"])
+
+
+def test_next_many_stateful_transform_keeps_sequential_path(corpus):
+    """A stateful transform (cifarnet's per-worker augmentation streams)
+    must see every batch in order: next_many == stacked next() draws,
+    including the transform's own RNG stream."""
+    x, y = corpus
+    a = WorkerBatchIterator(x, y, 2, 8, seed=7,
+                            transform=make_preprocessing("cifarnet", seed=3))
+    b = WorkerBatchIterator(x, y, 2, 8, seed=7,
+                            transform=make_preprocessing("cifarnet", seed=3))
+    many = a.next_many(3, out=a.alloc_chunk(3))
+    for step in range(3):
+        np.testing.assert_array_equal(many["image"][step], next(b)["image"])
+
+
+def test_split_chunk_views_cover_chunk(corpus):
+    x, y = corpus
+    chunk = WorkerBatchIterator(x, y, 4, 16, seed=1).next_many(10)
+    parts = split_chunk(chunk, 4)
+    assert sum(p["image"].shape[0] for p in parts) == 10
+    np.testing.assert_array_equal(
+        np.concatenate([p["image"] for p in parts]), chunk["image"])
+    # views, not copies: the zero-copy half of the slicing contract
+    assert all(p["image"].base is not None for p in parts)
+    # degenerate requests clamp instead of erroring
+    assert len(split_chunk(chunk, 1)) == 1
+    assert len(split_chunk(chunk, 99)) == 10
+
+
+# --------------------------------------------------------------------- #
+# ChunkPipeline: aliasing safety, exhaustion handoff, rollback close
+
+
+def pipeline_on(engine, iterator, unroll, nb_chunks, **kw):
+    return ChunkPipeline(
+        iterator, unroll, nb_chunks, put=engine.shard_batches,
+        assemble=engine.assemble_batches, **kw)
+
+
+def test_pipeline_stream_bit_identical_and_aliasing_safe(corpus):
+    """Consumed chunks are never overwritten by a later gather: hold every
+    chunk while the producer runs ahead over its two ping-pong buffers,
+    then compare ALL of them against the sequential reference."""
+    x, y = corpus
+    engine = make_engine()
+    it = WorkerBatchIterator(x, y, 4, 16, seed=9)
+    ref_it = WorkerBatchIterator(x, y, 4, 16, seed=9)
+    pipe = pipeline_on(engine, it, unroll=5, nb_chunks=6, depth=2, slices=3)
+    try:
+        held = [next(pipe) for _ in range(6)]  # > 2 buffers: forces reuse
+        for chunk in held:
+            ref = ref_it.next_many(5)
+            np.testing.assert_array_equal(np.asarray(chunk["image"]), ref["image"])
+            np.testing.assert_array_equal(np.asarray(chunk["label"]), ref["label"])
+    finally:
+        pipe.close()
+
+
+def test_pipeline_exhaustion_hands_iterator_back(corpus):
+    """The producer is FINITE: after its nb_chunks it exits, and the shared
+    iterator sits exactly nb_chunks*unroll draws in — the per-step tail the
+    runner then serves directly must continue the stream seamlessly."""
+    x, y = corpus
+    engine = make_engine()
+    it = WorkerBatchIterator(x, y, 4, 16, seed=11)
+    ref = WorkerBatchIterator(x, y, 4, 16, seed=11)
+    pipe = pipeline_on(engine, it, unroll=4, nb_chunks=3, depth=2, slices=2)
+    for _ in range(3):
+        next(pipe)
+    with pytest.raises(StopIteration):
+        next(pipe)
+    with pytest.raises(StopIteration):  # stays terminal (iterator protocol)
+        next(pipe)
+    pipe.close()
+    assert not pipe._thread.is_alive(), "producer daemon survived close()"
+    ref.skip(12)
+    tail = next(it)  # caller-owned again: no daemon racing this draw
+    np.testing.assert_array_equal(tail["image"], next(ref)["image"])
+
+
+def test_pipeline_close_midstream_then_restart(corpus):
+    """The guardian-rollback pattern (cli/runner.py rebuild_input): close a
+    mid-stream pipeline, then build a FRESH iterator + pipeline; the old
+    daemon must be gone and the new stream must start from its own seed."""
+    x, y = corpus
+    engine = make_engine()
+    before = threading.active_count()
+    it = WorkerBatchIterator(x, y, 4, 16, seed=13)
+    pipe = pipeline_on(engine, it, unroll=4, nb_chunks=50, depth=2, slices=2)
+    next(pipe)
+    pipe.close()
+    pipe.close()  # idempotent
+    assert not pipe._thread.is_alive()
+    it2 = WorkerBatchIterator(x, y, 4, 16, seed=14)
+    pipe2 = pipeline_on(engine, it2, unroll=4, nb_chunks=2, depth=2, slices=2)
+    try:
+        ref = WorkerBatchIterator(x, y, 4, 16, seed=14)
+        np.testing.assert_array_equal(
+            np.asarray(next(pipe2)["image"]), ref.next_many(4)["image"])
+    finally:
+        pipe2.close()
+    assert threading.active_count() <= before + 1  # no daemon accumulation
+
+
+def test_pipeline_surfaces_producer_error(corpus):
+    x, y = corpus
+
+    class Boom(WorkerBatchIterator):
+        def next_many(self, k, out=None):
+            raise RuntimeError("gather exploded")
+
+    engine = make_engine()
+    pipe = pipeline_on(engine, Boom(x, y, 4, 16, seed=1), 4, 3)
+    with pytest.raises(RuntimeError, match="gather exploded"):
+        next(pipe)
+    pipe.close()
+
+
+def test_supports_buffered_next_many_gate(corpus):
+    """Plugin iterators on the pre-pipeline ``next_many(k)`` signature (or
+    with none at all) must be steered to the legacy prefetcher, not into
+    the ChunkPipeline's ``out=`` producer."""
+    x, y = corpus
+    assert supports_buffered_next_many(WorkerBatchIterator(x, y, 2, 8))
+
+    class Legacy:
+        def next_many(self, k):
+            return {}
+
+    class NoBulk:
+        pass
+
+    assert not supports_buffered_next_many(Legacy())
+    assert not supports_buffered_next_many(NoBulk())
+
+
+def test_pipeline_exports_overlap_metrics(corpus):
+    x, y = corpus
+    engine = make_engine()
+    registry = MetricsRegistry()
+    it = WorkerBatchIterator(x, y, 4, 16, seed=21)
+    pipe = pipeline_on(engine, it, unroll=4, nb_chunks=3, depth=2, slices=2,
+                       registry=registry)
+    try:
+        for _ in range(3):
+            jax.block_until_ready(next(pipe)["image"])
+    finally:
+        pipe.close()
+    snap = registry.snapshot()
+    assert snap["input_chunks_total"] == 3.0
+    assert snap["input_gather_seconds_total"] > 0.0
+    assert snap["input_put_seconds_total"] > 0.0
+    assert 0.0 <= snap["input_overlap_fraction"] <= 1.0
+    assert snap["input_queue_depth"] == 0.0  # drained + closed
+
+
+# --------------------------------------------------------------------- #
+# engine assemble: sliced transfer == monolithic transfer
+
+
+def test_assemble_batches_matches_monolithic_put(corpus):
+    x, y = corpus
+    engine = make_engine()
+    chunk = WorkerBatchIterator(x, y, 4, 16, seed=17).next_many(8)
+    whole = engine.shard_batches(chunk)
+    parts = [engine.shard_batches(s) for s in split_chunk(chunk, 3)]
+    joined = engine.assemble_batches(parts)
+    np.testing.assert_array_equal(np.asarray(joined["image"]), np.asarray(whole["image"]))
+    np.testing.assert_array_equal(np.asarray(joined["label"]), np.asarray(whole["label"]))
+    # one executable per slice count, reused across chunks
+    assert engine._assemble_cache[3]._cache_size() == 1
+    engine.assemble_batches([engine.shard_batches(s) for s in split_chunk(chunk, 3)])
+    assert engine._assemble_cache[3]._cache_size() == 1
+
+
+# --------------------------------------------------------------------- #
+# device-sampled tail
+
+
+def sampled_setup(n=4):
+    exp = models.instantiate("digits", ["batch-size:16"])
+    gar = gars.instantiate("average", n, 0)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = make_engine(n=n, nb_devices=n)
+    data = engine.replicate(exp.train_arrays())
+    state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    return exp, engine, tx, data, state
+
+
+def test_sampled_tail_compiles_once():
+    """The runner's tail cache dispatches the SAME executable for every
+    same-length tail: two calls, one compile (acceptance: zero recompiles
+    beyond the tail executable)."""
+    exp, engine, tx, data, state = sampled_setup()
+    tail_fn = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=3,
+                                              batch_size=exp.batch_size)
+    state, _ = tail_fn(state, data)
+    assert tail_fn._cache_size() == 1
+    state, _ = tail_fn(state, data)
+    assert tail_fn._cache_size() == 1, "tail executable recompiled"
+
+
+def test_sampled_tail_is_exact_prefix_of_longer_run():
+    """A T-step tail from state S must replay the first T steps a K-step
+    sampled run would take from S (per-step draw keys fold in the ABSOLUTE
+    step index, so the trajectory is invariant to how the run is chunked)."""
+    exp, engine, tx, data, state = sampled_setup()
+    state_b = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    k_fn = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=6,
+                                           batch_size=exp.batch_size)
+    t_fn = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=2,
+                                           batch_size=exp.batch_size)
+    _, many_k = k_fn(state, data)
+    _, many_t = t_fn(state_b, data)
+    np.testing.assert_array_equal(
+        np.asarray(many_t["total_loss"]), np.asarray(many_k["total_loss"])[:2])
+
+
+def test_sampled_path_trains_like_host_path():
+    """Device-resident sampling is a different stream (in-step keyed draws)
+    but the same task: both paths must genuinely train the digits MLP."""
+    exp, engine, tx, data, state = sampled_setup()
+    host_state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    sampled_fn = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=20,
+                                                 batch_size=exp.batch_size)
+    host_fn = engine.build_multi_step(exp.loss, tx)
+    it = exp.make_train_iterator(engine.nb_workers, seed=3)
+    _, many_s = sampled_fn(state, data)
+    _, many_h = host_fn(host_state, engine.shard_batches(it.next_many(20)))
+    s_losses = np.asarray(many_s["total_loss"])
+    h_losses = np.asarray(many_h["total_loss"])
+    assert s_losses[-1] < s_losses[0], "sampled path did not train"
+    assert h_losses[-1] < h_losses[0], "host path did not train"
+    # same task, same model, same horizon: final losses in the same regime
+    assert abs(s_losses[-1] - h_losses[-1]) < 0.5 * max(s_losses[0], h_losses[0])
+
+
+def test_sampled_path_composes_with_device_augmentation():
+    """The re-routed augmentation runs INSIDE the sampled step body: a
+    device-sampled run with the cifarnet device twin still trains (the
+    --input-source device + augment:host CLI path, minus the conv model)."""
+    from aggregathor_tpu.models.preprocessing import _device_cifarnet
+
+    exp = models.instantiate("digits", ["batch-size:16"])
+    gar = gars.instantiate("average", 4, 0)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = make_engine(n=4, nb_devices=4, batch_transform=_device_cifarnet(pad=1))
+    data = engine.replicate(exp.train_arrays())
+    state = engine.init_state(exp.init(jax.random.PRNGKey(42)), tx, seed=1)
+    fn = engine.build_sampled_multi_step(exp.loss, tx, repeat_steps=15,
+                                         batch_size=exp.batch_size)
+    _, many = fn(state, data)
+    losses = np.asarray(many["total_loss"])
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0], "augmented sampled path did not train"
+
+
+def test_route_augmentation_to_device():
+    """cnnet's host-tier cifarnet augmentation re-routes to its in-step
+    device twin, unlocking train_arrays(); a poisoning experiment (a
+    stateful NON-augmentation transform) must refuse."""
+    exp = models.instantiate("cnnet", ["batch-size:16", "augment:host"])
+    assert exp.train_arrays() is None
+    assert exp.route_augmentation_to_device()
+    assert exp.augment == "device"
+    assert exp.train_arrays() is not None
+    assert exp.device_transform() is not None
+    atk = models.instantiate("digitsAttack", ["batch-size:16"])
+    assert not atk.route_augmentation_to_device()
+    assert atk.train_arrays() is None, "poisoned stream must stay host-bound"
+
+
+# --------------------------------------------------------------------- #
+# fast skip for stateless transforms
+
+
+def test_skip_equivalence_stateless_transform(corpus):
+    x, y = corpus
+    t = make_preprocessing("none", seed=0)
+    assert transform_is_stateless(t)
+    fast = WorkerBatchIterator(x, y, 4, 16, seed=19, transform=t)
+    slow = WorkerBatchIterator(x, y, 4, 16, seed=19, transform=t)
+    fast.skip(37)
+    for _ in range(37):
+        next(slow)
+    np.testing.assert_array_equal(next(fast)["image"], next(slow)["image"])
+
+
+def test_skip_equivalence_custom_stateless_transform(corpus):
+    x, y = corpus
+    t = stateless(lambda bx, by: (bx * np.float32(2.0), by))
+    fast = WorkerBatchIterator(x, y, 4, 16, seed=23, transform=t)
+    slow = WorkerBatchIterator(x, y, 4, 16, seed=23, transform=t)
+    fast.skip(11)
+    for _ in range(11):
+        next(slow)
+    ref = next(slow)
+    got = next(fast)
+    np.testing.assert_array_equal(got["image"], ref["image"])
+    # the transform genuinely ran on the fast path too (doubled pixels)
+    assert np.max(np.abs(got["image"])) > np.max(np.abs(x)) * 1.5
+
+
+def test_skip_stateful_transform_keeps_full_draws(corpus):
+    """A stateful transform's streams must advance in lockstep under skip —
+    the pre-existing contract stays intact."""
+    x, y = corpus
+    fast = WorkerBatchIterator(x, y, 2, 8, seed=29,
+                               transform=make_preprocessing("cifarnet", seed=5))
+    slow = WorkerBatchIterator(x, y, 2, 8, seed=29,
+                               transform=make_preprocessing("cifarnet", seed=5))
+    fast.skip(4)
+    for _ in range(4):
+        next(slow)
+    np.testing.assert_array_equal(next(fast)["image"], next(slow)["image"])
+
+
+def test_poisoning_transform_marked_stateless_resumes_fast(corpus):
+    """mnistAttack's poison is a pure function of its inputs, so it opts in:
+    skip() must not change the post-resume poisoned stream."""
+    exp = models.instantiate("digitsAttack", ["batch-size:16"])
+    fast = exp.make_train_iterator(4, seed=31)
+    slow = exp.make_train_iterator(4, seed=31)
+    assert transform_is_stateless(fast.transform)
+    fast.skip(9)
+    for _ in range(9):
+        next(slow)
+    np.testing.assert_array_equal(next(fast)["image"], next(slow)["image"])
