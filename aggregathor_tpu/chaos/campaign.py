@@ -41,6 +41,7 @@ SCHEMA = "aggregathor.chaos.resilience-matrix.v1"
 CELL_KEYS = (
     "gar", "scenario", "schedule", "nb_real_byz", "declared_byz",
     "first_loss", "final_loss", "min_loss", "converged", "diverged", "losses",
+    "compile_count",
 )
 
 
@@ -293,6 +294,12 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         "gar": gar_name,
         "nb_real_byz": nb_real,
         "declared_byz": f,
+        # Steady-state compile proof (the large-n acceptance bar): ONE
+        # compilation for the whole cell — logical workers decoupled from
+        # devices must not retrace, whatever n.  Guardian escalations
+        # legitimately rebuild the step (a new `step`), so the count is per
+        # final stack either way.
+        "compile_count": int(step._cache_size()),
         "first_loss": first,
         "final_loss": final,
         "min_loss": min(finite) if finite else float("nan"),
@@ -423,6 +430,7 @@ def run_campaign(args):
                     continue
                 entry["%s_converged" % tag] = cell["converged"]
                 entry["%s_final_loss" % tag] = cell["final_loss"]
+                entry["%s_compile_count" % tag] = cell["compile_count"]
             if "within_converged" in entry and "beyond_converged" in entry:
                 # the empirical boundary: the declared budget holds, a
                 # Byzantine majority does not

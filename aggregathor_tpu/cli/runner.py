@@ -112,6 +112,15 @@ def build_parser():
              "worker's participation weight (detects persistent attackers)",
     )
     parser.add_argument(
+        "--gar-probe", action="store_true",
+        help="measure the GAR's wall time at each summary fire: one jitted "
+             "rule-only aggregation at the run's exact (n, d) is timed under "
+             "a gar.aggregate span and exported as gar_seconds_total / "
+             "gar_probe_seconds on the metrics registry (the cost model "
+             "behind docs/gar_scaling.md, measured instead of presumed; "
+             "compiled once, outside the training step's jit cache)",
+    )
+    parser.add_argument(
         "--prefetch", type=int, default=2, metavar="DEPTH",
         help="device-ready input batches/chunks prepared ahead of the "
              "training dispatch (0 disables): per-step runs use a "
@@ -436,20 +445,22 @@ def main(argv=None):
         devices = jax.devices()
         if mesh_axes is not None:
             w_axis, pp_axis, tp_axis = mesh_axes
-            if w_axis != n:
+            if n % w_axis != 0:
                 raise UserException(
-                    "--mesh worker axis W=%d must equal --nb-workers %d (one "
-                    "logical Byzantine worker per (pipe x model) submesh)"
-                    % (w_axis, n)
+                    "--mesh worker axis W=%d must divide --nb-workers %d "
+                    "(k = n/W logical Byzantine workers are vmapped per "
+                    "(pipe x model) submesh — the large-n regime, "
+                    "docs/gar_scaling.md)" % (w_axis, n)
                 )
             mesh = make_mesh(
                 nb_workers=w_axis, model_parallelism=tp_axis,
                 pipeline_parallelism=pp_axis, devices=devices[:requested_devices],
             )
             info(
-                "Sharded mesh: %d worker(s) x %d pipeline stage(s) x %d-way tensor "
-                "parallelism on %d %s device(s)"
-                % (w_axis, pp_axis, tp_axis, requested_devices, devices[0].platform)
+                "Sharded mesh: %d worker slot(s) x %d pipeline stage(s) x %d-way "
+                "tensor parallelism on %d %s device(s), %d logical worker(s)/slot"
+                % (w_axis, pp_axis, tp_axis, requested_devices,
+                   devices[0].platform, n // w_axis)
             )
         else:
             nb_devices = args.nb_devices
@@ -625,7 +636,8 @@ def main(argv=None):
                 # distance matrix accumulated across shards).
                 gran = "global" if args.granularity == "vector" else args.granularity
                 engine = ShardedRobustEngine(
-                    mesh, gar, nb_real_byz=r, attack=attack, lossy_link=lossy,
+                    mesh, gar, nb_workers=n,
+                    nb_real_byz=r, attack=attack, lossy_link=lossy,
                     granularity=gran, exchange_dtype=args.exchange_dtype,
                     worker_momentum=args.worker_momentum,
                     worker_metrics=args.worker_metrics,
@@ -723,6 +735,14 @@ def main(argv=None):
             ts.engine = engine
             ts.make_fresh_state = make_fresh_state
             ts.initial_state = state0
+            # --gar-probe instrument (built lazily at the first summary fire
+            # so unprobed runs pay nothing): the rule's wall time at the
+            # run's exact (n, d), d = the whole model dimension.
+            ts.model_dim = sum(
+                int(np.prod(leaf.shape))
+                for leaf in jax.tree_util.tree_leaves(state0.params)
+            )
+            ts.gar_probe_fn = None
             return ts
 
         ts = build_training(overrides)
@@ -1072,6 +1092,16 @@ def main(argv=None):
         "train_worker_reputation", "Per-worker reputation EMA (1 = trusted)",
         labelnames=("worker",),
     )
+    # GAR cost instrumentation (--gar-probe, docs/gar_scaling.md): wall time
+    # of ONE rule application at the run's exact (n, d), measured on a jitted
+    # rule-only executable so the composite-vs-flat scaling claim is checked
+    # against the live run, not just the offline benchmark.
+    c_gar_seconds = registry.counter(
+        "gar_seconds_total", "Cumulative measured GAR aggregation wall time"
+    )
+    g_gar_probe = registry.gauge(
+        "gar_probe_seconds", "Last measured single-aggregation GAR wall time"
+    )
     # guardian recovery counters — the third subsystem on the one registry
     g_rollbacks = registry.counter(
         "guardian_rollbacks_total", "Guardian rollbacks to last-known-good"
@@ -1098,6 +1128,26 @@ def main(argv=None):
         pending_loss = None
         pending_metrics = None
         pending_start = 0
+
+        def time_gar_probe(step):
+            """One timed GAR-only aggregation (--gar-probe): the executable
+            is built and warmed at the first fire (compile excluded from the
+            timing — it is a separate jit cache, so the TRAINING step's
+            compile count is untouched), then each fire measures one
+            blocked-on aggregation and feeds the registry."""
+            from aggregathor_tpu.gars.scaling import sync_fetch
+
+            if ts.gar_probe_fn is None:
+                with trace.span("gar.probe_build", cat="train"):
+                    ts.gar_probe_fn = ts.engine.build_gar_probe(ts.model_dim)
+                    sync_fetch(ts.gar_probe_fn(0))  # compile + full drain
+            with trace.span("gar.aggregate", cat="train"):
+                begin = time.perf_counter()
+                sync_fetch(ts.gar_probe_fn(step))
+                elapsed = time.perf_counter() - begin
+            c_gar_seconds.inc(elapsed)
+            g_gar_probe.set(elapsed)
+            return elapsed
 
         def summary_scalars(step, metrics):
             """The summary event payload — shared by the cadence fires and
@@ -1136,6 +1186,8 @@ def main(argv=None):
                 scalars["nb_quarantined"] = int(jax.device_get(metrics["nb_quarantined"]))
             if "chaos_regime" in metrics:
                 scalars["chaos_regime"] = int(jax.device_get(metrics["chaos_regime"]))
+            if args.gar_probe:
+                scalars["gar_seconds"] = time_gar_probe(step)
             # mirror into the registry — one metrics surface (obs/metrics.py)
             g_loss.set(scalars["total_loss"])
             g_grad_norm.set(scalars["grad_norm"])

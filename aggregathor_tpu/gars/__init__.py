@@ -40,13 +40,75 @@ def itemize():
     return gars.itemize()
 
 
+def _split_args(text):
+    """Split ``k=v,k=v`` on top-level commas only — a parenthesized value
+    (a nested rule spec like ``hier(g=4,outer=krum)``) keeps its commas."""
+    parts, depth, cur = [], 0, []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p for p in (p.strip() for p in parts) if p]
+
+
+def parse_spec(spec):
+    """Parse an inline GAR spec into ``(name, [key:value, ...])``.
+
+    Three forms (all equivalent)::
+
+        krum
+        hier:g=16,inner=median,outer=krum
+        hier(g=16,inner=median,outer=krum)
+
+    Nested composite rules spell their sub-arguments in the parenthesized
+    form so the commas stay attached to the inner spec::
+
+        bucketing:s=2,inner=hier(g=8,outer=krum)
+
+    The returned args use the ``key:value`` convention ``parse_keyval``
+    expects.  A plain registered name passes through untouched.
+    """
+    from ..utils import UserException
+
+    spec = str(spec).strip()
+    ci, pi = spec.find(":"), spec.find("(")
+    if pi != -1 and spec.endswith(")") and (ci == -1 or pi < ci):
+        name, _, body = spec.partition("(")
+        body = body[:-1]
+    elif ci != -1:
+        name, _, body = spec.partition(":")
+    else:
+        return spec, []
+    name = name.strip()
+    args = []
+    for item in _split_args(body):
+        if "=" not in item:
+            raise UserException(
+                "GAR spec argument %r wants key=value (in spec %r)" % (item, spec)
+            )
+        key, _, value = item.partition("=")
+        args.append("%s:%s" % (key.strip(), value.strip()))
+    return name, args
+
+
 def instantiate(name, nb_workers, nb_byz_workers, args=None):
     """Build the GAR registered under ``name`` (reference: aggregators/__init__.py:66-70).
 
     ``args`` is a list of ``key:value`` strings, the same sub-argument
     convention every other registry uses (attacks, optimizers, experiments).
+    ``name`` may also be an inline spec (``hier:g=16,outer=krum`` — see
+    :func:`parse_spec`); spec args and explicit ``args`` concatenate, with
+    duplicate keys rejected by ``parse_keyval``.
     """
-    return gars.get(name)(nb_workers, nb_byz_workers, args or [])
+    name, spec_args = parse_spec(name)
+    return gars.get(name)(nb_workers, nb_byz_workers, spec_args + list(args or []))
 
 
 class GAR:

@@ -29,6 +29,23 @@ NaN rows (lossy link): a dead worker poisons its bucket's mean, and the
 inner rule's own NaN conventions then apply to that bucket row — with
 ``inner:krum`` a NaN bucket is never selected, so up to f lossy/Byzantine
 workers still only cost f buckets.
+
+Ragged n (s not dividing n): the permuted stack is padded with NaN rows to
+the next multiple of ``s``, so the LAST bucket is always NaN-poisoned (its
+mean contains padded NaN rows) and the existing NaN-row conventions absorb
+it.  f-accounting: the inner rule then sees ``ceil(n/s)`` rows of which up
+to ``f + 1`` are bad (f Byzantine buckets plus the one guaranteed-NaN
+padding bucket), so it is instantiated with ``f + 1`` declared Byzantine
+rows and MUST be NaN-row tolerant (validated at parse time) — a
+non-excluding inner would let the padding poison every step.  The price of
+raggedness: the ``s - (n mod s)``-padded bucket's real members are
+sacrificed that step (their bucket is never selected); the per-step
+permutation rotates who pays, and their scattered participation is 0, so
+the (n,) participation still sums to 1.  Caveat: the rotation needs the
+step key — on the keyless dense/oracle tier (``aggregate(grads)`` with no
+``key``) the permutation is the identity, so the SAME trailing workers sit
+in the padded bucket every call; keyless ragged use is for offline
+benchmarks/oracles, not training (both engines always pass the step key).
 """
 
 import jax
@@ -50,17 +67,30 @@ class BucketingGAR(GAR):
         from ..utils import UserException
 
         self.s = int(self.args["s"])
-        if self.s < 1 or self.nb_workers % self.s != 0:
+        if self.s < 1:
             raise UserException(
-                "bucketing needs s >= 1 dividing n (got n=%d, s=%r)"
+                "bucketing needs s >= 1 (got n=%d, s=%r)"
                 % (self.nb_workers, self.args["s"])
             )
-        self.nb_buckets = self.nb_workers // self.s
-        # The inner rule sees n/s rows with (at most) the same f Byzantine
-        # ones — its own (n/s, f) feasibility check runs here, at parse time.
-        self.inner = instantiate(str(self.args["inner"]), self.nb_buckets, self.nb_byz_workers)
+        # Ragged n: pad the permuted stack with NaN rows to the next multiple
+        # of s — the padding lands in ONE always-NaN bucket (see module
+        # docstring for the f-accounting).
+        self.nb_padded = (-self.nb_workers) % self.s
+        self.nb_buckets = (self.nb_workers + self.nb_padded) // self.s
+        # The inner rule sees ceil(n/s) rows with (at most) the same f
+        # Byzantine ones, plus the guaranteed-NaN padding bucket when ragged
+        # — its own (n_buckets, f') feasibility check runs here, at parse time.
+        inner_f = self.nb_byz_workers + (1 if self.nb_padded else 0)
+        self.inner = instantiate(str(self.args["inner"]), self.nb_buckets, inner_f)
         # A NaN worker makes its whole bucket NaN; tolerance is the inner's.
         self.nan_row_tolerant = self.inner.nan_row_tolerant
+        if self.nb_padded and not self.inner.nan_row_tolerant:
+            raise UserException(
+                "bucketing with s=%d not dividing n=%d pads with a NaN bucket "
+                "every step, which inner rule %s does not cleanly exclude; "
+                "pick a NaN-excluding inner rule or an s dividing n"
+                % (self.s, self.nb_workers, type(self.inner).__name__)
+            )
 
     def _buckets(self, block, key):
         n, s = self.nb_workers, self.s
@@ -69,7 +99,11 @@ class BucketingGAR(GAR):
             if key is not None
             else jnp.arange(n)  # dense/oracle tier without a step key
         )
-        grouped = block[perm].reshape(self.nb_buckets, s, block.shape[-1])
+        stack = block[perm]
+        if self.nb_padded:
+            pad = jnp.full((self.nb_padded, block.shape[-1]), jnp.nan, block.dtype)
+            stack = jnp.concatenate([stack, pad], axis=0)
+        grouped = stack.reshape(self.nb_buckets, s, block.shape[-1])
         return jnp.mean(grouped, axis=1), perm
 
     def _inner_dist2(self, buckets, axis_name):
@@ -101,8 +135,12 @@ class BucketingGAR(GAR):
         if bucket_part is None:
             return agg, None
         # Worker i inherits 1/s of its bucket's participation: scatter the
-        # (n/s,) bucket weights back through the permutation.
-        per_worker = jnp.repeat(bucket_part / self.s, self.s)
+        # (ceil(n/s),) bucket weights back through the permutation.  Ragged
+        # n: the padded slots sit at the END of the permuted stack, so
+        # dropping the tail keeps exactly the real workers — and their
+        # bucket (always-NaN, never selected by the validated NaN-tolerant
+        # inner) carries weight 0, so the scatter still sums to 1.
+        per_worker = jnp.repeat(bucket_part / self.s, self.s)[: self.nb_workers]
         participation = jnp.zeros(self.nb_workers, per_worker.dtype).at[perm].set(per_worker)
         return agg, participation
 

@@ -94,7 +94,16 @@ def use_pallas_coordinate_tier(block):
     )
 
 
-def centered_gram_sq_distances(g):
+#: n²·d element budget above which ``centered_gram_sq_distances`` chunks its
+#: Gram matmul over the coordinate axis: at large n (the hier/bucketing
+#: regime, n=128..512) one monolithic (n, d)x(d, n) contraction forces the
+#: scheduler to stage the whole centered operand through fast memory at
+#: once, while d-chunked accumulation bounds the working set without
+#: changing the O(n²·d) arithmetic.
+GRAM_CHUNK_BUDGET = 1 << 31
+
+
+def centered_gram_sq_distances(g, chunk_budget=GRAM_CHUNK_BUDGET):
     """Gram-form all-pairs squared distances of (n, d) rows, median-centered.
 
     The Gram form ``|a|² + |b|² - 2·a·b`` is one MXU matmul but suffers
@@ -103,11 +112,36 @@ def centered_gram_sq_distances(g):
     distances are translation-invariant and the robust center keeps the
     conditioning independent of Byzantine outliers.  Shared by the dense tier
     below and the sharded engine's per-block partial distances.
+
+    When ``n²·d`` exceeds ``chunk_budget`` the (n, n) Gram is accumulated
+    over coordinate chunks with one ``lax.scan`` (zero-padded tail — the
+    padding is applied AFTER centering, so it contributes nothing to norms
+    or inner products); within a chunked run the float accumulation order
+    differs from the monolithic matmul by ordinary non-associativity, same
+    as any blocking choice XLA could make itself.
     """
+    n, d = g.shape
     center = jnp.nan_to_num(jnp.nanmedian(jnp.where(jnp.isfinite(g), g, jnp.nan), axis=0))
     g = g - center[None, :]
     sq_norms = jnp.sum(g * g, axis=-1)
-    gram = jax.lax.dot_general(g, g, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST)
+    if n * n * d <= chunk_budget:
+        gram = jax.lax.dot_general(
+            g, g, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST
+        )
+    else:
+        chunk = max(128, min(d, chunk_budget // max(n * n, 1)))
+        pad = (-d) % chunk
+        gp = jnp.pad(g, ((0, 0), (0, pad))) if pad else g
+        chunks = gp.reshape(n, (d + pad) // chunk, chunk).transpose(1, 0, 2)
+
+        def body(acc, block):
+            partial = jax.lax.dot_general(
+                block, block, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            return acc + partial, None
+
+        gram, _ = jax.lax.scan(body, jnp.zeros((n, n), jnp.float32), chunks)
     return sq_norms[:, None] + sq_norms[None, :] - 2.0 * gram
 
 

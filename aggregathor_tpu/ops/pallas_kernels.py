@@ -63,32 +63,62 @@ def _clamp_block(blk, d):
     return min(blk, max(128, ((d + 127) // 128) * 128))
 
 
-def _pick_block_diff(n, d, vmem_budget=1 << 22):
-    """Diff-form distance block: the n·n·blk difference tensor sets the size."""
-    return _clamp_block(vmem_budget // max(n * n * 4, 1), d)
+#: Worker-row tile of the distance kernels: above this many (padded) rows
+#: the row axis is tiled so n=128..512 lowers without holding the whole
+#: (n, d_block) slab pair — per grid cell only two (ROW_TILE, blk) input
+#: tiles and one (ROW_TILE, ROW_TILE) output tile live in VMEM.
+ROW_TILE = 128
 
 
-def _pick_block_coord(n, d, vmem_budget=1 << 22):
+def _pick_block_diff(tile, d, vmem_budget=1 << 22):
+    """Diff-form distance block: the tile·tile·blk difference tensor sets
+    the size (``tile`` is the ROW TILE, not n — row tiling keeps the
+    budget independent of the worker count)."""
+    return _clamp_block(vmem_budget // max(tile * tile * 4, 1), d)
+
+
+def _pick_block_coord(n, d, vmem_budget=1 << 21):
     """Coordinate-kernel block: footprint is O(n·blk) (value slab + rank
-    temporaries, ~8 live (n, blk) f32 buffers)."""
+    temporaries, ~8 live (n, blk) f32 buffers).  The budget is HALF the
+    distance kernels' — the coordinate kernels cannot tile the row axis
+    (every rank needs all n comparators), so large n must come out of the
+    column block instead: at n=512 this picks blk=128, ~2 MB of live slab,
+    which lowers without spilling where the old budget's blk=256 doubled it."""
     return _clamp_block(vmem_budget // max(n * 4 * 8, 1), d)
 
 
 # --------------------------------------------------------------------------- #
 # Rank machinery (shared by the coordinate-wise kernels)
 
+#: Worker count above which ``_ranks`` switches from the statically-unrolled
+#: compare+accumulate loop to a ``fori_loop``: at n=512 the unrolled form
+#: emits 512 fused passes into the kernel body — a compile-time blowup —
+#: while the rolled loop compiles one pass.  The unrolled tier stays the
+#: default at small n (the silicon-proven path, scripts/pallas_tpu_check.py).
+RANK_UNROLL_MAX = 64
+
+
 def _ranks(key, n):
     """rank[i, :] = #{j : key_j < key_i, ties to lower j}, per coordinate.
 
-    n statically-unrolled VPU passes of compare+accumulate over the (n, blk)
-    slab; memory stays O(n·blk).
+    n VPU passes of compare+accumulate over the (n, blk) slab; memory stays
+    O(n·blk).  Statically unrolled up to ``RANK_UNROLL_MAX`` comparators,
+    a ``fori_loop`` with a dynamic row slice beyond (identical selections:
+    the loop body is the same compare+accumulate either way).
     """
     row = jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
-    ranks = jnp.zeros(key.shape, jnp.int32)
-    for j in range(n):
-        kj = key[j, :][None, :]
-        ranks = ranks + jnp.where((kj < key) | ((kj == key) & (j < row)), 1, 0)
-    return ranks
+    if n <= RANK_UNROLL_MAX:
+        ranks = jnp.zeros(key.shape, jnp.int32)
+        for j in range(n):
+            kj = key[j, :][None, :]
+            ranks = ranks + jnp.where((kj < key) | ((kj == key) & (j < row)), 1, 0)
+        return ranks
+
+    def body(j, ranks):
+        kj = jax.lax.dynamic_slice_in_dim(key, j, 1, axis=0)  # (1, blk)
+        return ranks + jnp.where((kj < key) | ((kj == key) & (j < row)), 1, 0)
+
+    return jax.lax.fori_loop(0, n, body, jnp.zeros(key.shape, jnp.int32))
 
 
 def _select_rank(x, ranks, r):
@@ -196,50 +226,63 @@ def average_nan_columns(x, block_d=None):
 
 
 # --------------------------------------------------------------------------- #
-# Pairwise squared distances, streamed over column blocks
+# Pairwise squared distances, tiled over row pairs and streamed over column
+# blocks.  The grid is (row tile i, row tile j, column block k) with k
+# innermost, so each (i, j) output tile stays resident in VMEM while its
+# column blocks accumulate — per grid cell only two (T, blk) input tiles and
+# one (T, T) output tile are live, which is what lets n=128..512 lower
+# without spilling (a single-tile grid reproduces the old full-slab kernels
+# bit-for-bit: same per-block accumulation order).
 
-def _dist_diff_kernel(x_ref, out_ref):
-    @pl.when(pl.program_id(0) == 0)
+def _dist_diff_kernel(xa_ref, xb_ref, out_ref):
+    @pl.when(pl.program_id(2) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    x = x_ref[:].astype(jnp.float32)
-    diff = x[:, None, :] - x[None, :, :]
+    xa = xa_ref[:].astype(jnp.float32)
+    xb = xb_ref[:].astype(jnp.float32)
+    diff = xa[:, None, :] - xb[None, :, :]
     out_ref[:] += jnp.sum(diff * diff, axis=-1)
 
 
-def _dist_gram_kernel(x_ref, out_ref):
+def _dist_gram_kernel(xa_ref, xb_ref, out_ref):
     # Input is pre-centered by the NaN-ignoring coordinate median (see
     # pairwise_sq_distances): |a|²+|b|²−2ab stays conditioned, NaN rows
     # poison only their own rows/columns, and the kernel is pure MXU work.
-    @pl.when(pl.program_id(0) == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    xc = x_ref[:].astype(jnp.float32)
-    sq = jnp.sum(xc * xc, axis=-1, keepdims=True)  # (n, 1)
+    xa = xa_ref[:].astype(jnp.float32)
+    xb = xb_ref[:].astype(jnp.float32)
+    sqa = jnp.sum(xa * xa, axis=-1, keepdims=True)  # (T, 1)
+    sqb = jnp.sum(xb * xb, axis=-1, keepdims=True)  # (T, 1)
     gram = jax.lax.dot_general(
-        xc, xc, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        xa, xb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    out_ref[:] += sq + jnp.transpose(sq) - 2.0 * gram
+    out_ref[:] += sqa + jnp.transpose(sqb) - 2.0 * gram
 
 
-def pairwise_sq_distances(x, block_d=None, use_mxu=None):
+def pairwise_sq_distances(x, block_d=None, use_mxu=None, row_tile=None):
     """(n, n) all-pairs squared L2 distances of the rows of (n, d).
 
     ``use_mxu=None`` picks the difference-form (exact) when the per-block
-    n²·blk intermediate is cheap and the Gram-form (one MXU matmul per
-    block) otherwise.  NaN rows yield NaN entries (callers map to +inf),
-    matching the jnp tier.
+    tile²·blk intermediate is cheap and the Gram-form (one MXU matmul per
+    tile pair) otherwise.  NaN rows yield NaN entries (callers map to +inf),
+    matching the jnp tier.  Rows are processed in ``row_tile``-sized tiles
+    (default: one tile up to ROW_TILE rows, ROW_TILE beyond) so the VMEM
+    footprint is independent of the worker count.
     """
     n, d = x.shape
-    rows = n + (-n) % 8  # VMEM budgets must see the padded slab size
+    rows = n + (-n) % 8  # sublane-padded row count
+    tile = row_tile or (rows if rows <= ROW_TILE else ROW_TILE)
+    tile = max(8, tile + (-tile) % 8)
     if use_mxu is None:
         use_mxu = n > 64
     x = x.astype(jnp.float32)
     if use_mxu:
         kernel = _dist_gram_kernel
-        blk = block_d or _pick_block_coord(rows, d)
+        blk = block_d or _pick_block_coord(tile, d)
         # Robust centering outside the kernel (distances are translation-
         # invariant, one global center suffices): NaN-ignoring coordinate
         # median, same scheme as gars/common.py centered_gram_sq_distances.
@@ -247,21 +290,26 @@ def pairwise_sq_distances(x, block_d=None, use_mxu=None):
         x = x - center[None, :]
     else:
         kernel = _dist_diff_kernel
-        blk = block_d or _pick_block_diff(rows, d)
+        blk = block_d or _pick_block_diff(tile, d)
     xp = _pad_axis(x, 1, blk)
-    # Sublane-align the worker dim with zero rows; every real-pair entry is
-    # computed rowwise-independently, so padded rows only affect their own
-    # (sliced-off) rows/columns.
-    xp = _pad_axis(xp, 0, 8, 0.0)
-    grid = xp.shape[1] // blk
+    # Row-pad the worker dim to the tile multiple with zero rows; every
+    # real-pair entry is computed rowwise-independently, so padded rows only
+    # affect their own (sliced-off) rows/columns.
+    xp = _pad_axis(xp, 0, tile, 0.0)
+    rows_p = xp.shape[0]
+    nt = rows_p // tile
+    grid = (nt, nt, xp.shape[1] // blk)
     out = pl.pallas_call(
         kernel,
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((rows, blk), lambda i: (0, i), memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((rows, rows), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, rows), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, blk), lambda i, j, k: (i, k), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, blk), lambda i, j, k: (j, k), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, tile), lambda i, j, k: (i, j), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows_p, rows_p), jnp.float32),
         interpret=_interpret(),
-    )(xp)
+    )(xp, xp)
     out = out[:n, :n]
     # Column padding contributes zero to every distance.  The Gram form can
     # go slightly negative from cancellation — clamp it (NaN passes through

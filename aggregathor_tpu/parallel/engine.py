@@ -80,13 +80,13 @@ def validate_reputation_args(gar, reputation_decay, quarantine_threshold):
                 name for name in _registry.itemize()
                 if getattr(_registry.get(name), "nan_row_tolerant", False)
             )
-            # ``bucketing`` sets nan_row_tolerant per-INSTANCE (it inherits
-            # its inner rule's tolerance), so the class-attribute scan above
-            # cannot list it — name it explicitly.
+            # ``bucketing``/``hier`` set nan_row_tolerant per-INSTANCE (they
+            # inherit their child rules' tolerance), so the class-attribute
+            # scan above cannot list them — name them explicitly.
             raise UserException(
                 "Quarantine masks rows to NaN, which %s does not cleanly "
-                "exclude (pick a NaN-excluding rule: %s; or bucketing with "
-                "a NaN-tolerant inner rule)"
+                "exclude (pick a NaN-excluding rule: %s; or bucketing/hier "
+                "with NaN-tolerant child rules)"
                 % (type(gar).__name__, ", ".join(tolerant))
             )
     return decay, threshold
@@ -894,6 +894,58 @@ class RobustEngine:
             "train_sampled_multi_step.dispatch",
             jax.jit(sharded, donate_argnums=(0,)), cat="train",
         )
+
+    def build_gar_probe(self, d, seed=0):
+        """Jitted GAR-only executable at the engine's exact (n, d) and
+        sharding — the measurement instrument behind the runner's
+        ``gar_seconds_total`` / ``gar.aggregate`` telemetry.
+
+        Returns ``probe(step)``: one full aggregation (psum-completed
+        distances + the rule's blockwise reduction — the same path the
+        compiled train step runs in phase 5/6 of the module docstring) over
+        a persistent synthetic device-resident row matrix.  Attacks, lossy
+        links and quarantine are deliberately excluded: the probe times the
+        RULE at the run's real (n, d), not the adversity simulation.  The
+        caller times ``jax.block_until_ready(probe(step))``; ``step`` folds
+        into the rule key so randomized meta-rules (bucketing/hier) redraw
+        like they do in training."""
+        from ..gars import GAR_KEY_TAG
+
+        W = self.nb_devices
+        blk = -(-int(d) // W)
+        # Generate the synthetic rows ON DEVICE under jit with an explicit
+        # output sharding: GSPMD shards the generation itself, so the host
+        # never materializes the (n, d) matrix (n x the model footprint at
+        # the large n the probe exists to measure).
+        make_rows = jax.jit(
+            lambda k: jax.random.normal(k, (self.nb_workers, W * blk), jnp.float32),
+            out_shardings=jax.sharding.NamedSharding(self.mesh, P(None, worker_axis)),
+        )
+        rows = make_rows(jax.random.PRNGKey(seed))
+
+        def body(block, key):
+            dist2 = None
+            if self.gar.needs_distances:
+                partial = _partial_pairwise_sq_distances(block)
+                dist2 = jax.lax.psum(partial, worker_axis) if W > 1 else partial
+                dist2 = jnp.maximum(dist2, 0.0)
+            axis = worker_axis if W > 1 else None
+            gar_key = jax.random.fold_in(key, GAR_KEY_TAG)
+            return self.gar._call_aggregate(block, dist2, axis_name=axis, key=gar_key)
+
+        sharded = compat.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(None, worker_axis), P()),
+            out_specs=P(worker_axis),
+            check_vma=False,
+        )
+        fn = jax.jit(sharded)
+        base = jax.random.PRNGKey(seed)
+
+        def probe(step=0):
+            return fn(rows, jax.random.fold_in(base, step))
+
+        return probe
 
     def build_eval_sums(self, metric_fn):
         """Build the jitted evaluation step returning (sum, count) accumulators.

@@ -82,10 +82,26 @@ class ShardedRobustEngine:
                  exchange_dtype=None, worker_momentum=None, worker_metrics=False,
                  reputation_decay=None, quarantine_threshold=0.0,
                  l1_regularize=None, l2_regularize=None, chaos=None,
-                 health_probe=True):
+                 health_probe=True, nb_workers=None):
         self.mesh = mesh
         self.gar = gar
-        self.nb_workers = mesh.shape[worker_axis]
+        # Logical workers decoupled from mesh slots (the flat engine's
+        # discipline, brought here for the large-n regime): ``nb_workers``
+        # may exceed the worker mesh axis, in which case each worker-group
+        # submesh hosts k = n/W logical workers — their grads are vmapped,
+        # their leading batch/buffer dims block-shard over the axis, and the
+        # per-bucket all_gathers recover the full (n, ...) row matrices.
+        # Default (None) keeps the historical one-worker-per-slot layout.
+        self.nb_mesh_workers = mesh.shape[worker_axis]
+        self.nb_workers = (
+            int(nb_workers) if nb_workers is not None else self.nb_mesh_workers
+        )
+        if self.nb_workers % self.nb_mesh_workers != 0:
+            raise UserException(
+                "nb_workers (%d) must be a multiple of the worker mesh axis (%d)"
+                % (self.nb_workers, self.nb_mesh_workers)
+            )
+        self.workers_per_device = self.nb_workers // self.nb_mesh_workers
         self._state_shardings = None  # captured by init_state, for put_state
         self._assemble_cache = {}  # slice-concat executables, per slice count
         self.nb_real_byz = int(nb_real_byz)
@@ -324,20 +340,25 @@ class ShardedRobustEngine:
         return out, out
 
     def _leaf_buckets(self, g, spec):
-        """Reshape a local leaf to (n_buckets, d_bucket) rows-to-be."""
+        """Reshape a locally worker-stacked (k, ...) leaf to (k, n_buckets,
+        d_bucket) rows-to-be."""
+        k = g.shape[0]
         if self.granularity == "layer" and spec is not None and len(spec) >= 2 and spec[0] == pipe_axis:
             # Stage-stacked leaf (local stage dim 1, then the scanned layer
             # dim): one bucket per layer.
-            return g.reshape(g.shape[0] * g.shape[1], -1)
-        return g.reshape(1, -1)
+            return g.reshape(k, g.shape[1] * g.shape[2], -1)
+        return g.reshape(k, 1, -1)
 
     def _gather_rows(self, buckets):
-        """(Lb, d) local buckets -> (Lb, n, d) per-worker rows via all_gather."""
+        """(k, Lb, d) local buckets -> (Lb, n, d) per-worker rows via one
+        all_gather over the worker axis (worker-major: global worker index
+        is group * k + local slot, the same layout the flat engine uses)."""
         if self.exchange_dtype is not None:
             buckets = buckets.astype(self.exchange_dtype)
-        rows = jax.lax.all_gather(buckets, worker_axis)  # (n, Lb, d)
+        rows = jax.lax.all_gather(buckets, worker_axis)  # (W, k, Lb, d)
         if self.exchange_dtype is not None:
             rows = rows.astype(jnp.float32)
+        rows = rows.reshape((self.nb_workers,) + rows.shape[2:])  # (n, Lb, d)
         return jnp.swapaxes(rows, 0, 1)
 
     def _apply_omniscient(self, rows, key, ridx=None):
@@ -376,27 +397,44 @@ class ShardedRobustEngine:
         ``build_multi_step`` (the scan over it)."""
         param_specs = state_specs.params
         gar = self.gar
+        k = self.workers_per_device
 
         def body(state, batch):
-            batch = jax.tree.map(lambda x: x[0], batch)  # strip worker block dim
             key = jax.random.fold_in(state.rng, state.step)
-            widx = jax.lax.axis_index(worker_axis)
+            gidx = jax.lax.axis_index(worker_axis)  # worker-GROUP index
             # Active chaos regime + per-STEP worker lateness (one draw per
-            # worker, shared by all its leaves).  The lateness key lives in
-            # the 30_000+ offset namespace — fold_in(key, widx) is the
-            # PARENT of every per-leaf stream (fold i, then tags 1/2), so
-            # folding the straggler tag onto it directly would collide with
-            # leaf index 5's stream (same convention as the 10_000+i /
+            # logical worker, shared by all its leaves).  The lateness key
+            # lives in the 30_000+ offset namespace — fold_in(key, widx) is
+            # the PARENT of every per-leaf stream (fold i, then tags 1/2),
+            # so folding the straggler tag onto it directly would collide
+            # with leaf index 5's stream (same convention as the 10_000+i /
             # 20_000+i offsets the engines use elsewhere).
-            ridx = late = None
+            ridx = None
+            lates = [None] * k
             if self.chaos is not None:
                 ridx = self.chaos.regime_index(state.step)
                 if self.chaos.has_stragglers:
-                    late = self.chaos.stragglers.is_late(
-                        jax.random.fold_in(key, 30_000 + widx), widx,
-                        self.chaos.straggler_rate(ridx),
-                    )
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+                    lates = [
+                        self.chaos.stragglers.is_late(
+                            jax.random.fold_in(key, 30_000 + gidx * k + j),
+                            gidx * k + j,
+                            self.chaos.straggler_rate(ridx),
+                        )
+                        for j in range(k)
+                    ]
+            if k == 1:
+                # one logical worker per submesh: the historical (and
+                # bit-proven) unvmapped path — keep it byte-for-byte
+                local = jax.tree.map(lambda x: x[0], batch)  # strip block dim
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, local)
+                losses = loss[None]
+                grads = jax.tree.map(lambda g: g[None], grads)
+            else:
+                # k logical workers per submesh (the large-n regime): vmap
+                # the per-worker loss/grad — every leaf leads with k
+                losses, grads = jax.vmap(
+                    lambda b: jax.value_and_grad(loss_fn)(state.params, b)
+                )(batch)
 
             g_leaves, treedef = jax.tree_util.tree_flatten(grads)
             s_leaves = treedef.flatten_up_to(param_specs)
@@ -426,36 +464,52 @@ class ShardedRobustEngine:
                     g_leaves[i] = g_leaves[i] + delta.astype(g_leaves[i].dtype)
                 # scaled per-leaf partials psum exactly like the data loss:
                 # the in-group psum in `metrics` then counts the norm once
-                loss = loss + reg
+                # (every logical worker's loss carries the reg term, the flat
+                # engine's per-worker in-loss placement)
+                losses = losses + reg
             # (2b) honest worker momentum (pre-attack, like the flat engine):
             # send bias-corrected momenta, carry the uncorrected buffer
             new_momentum, new_momentum_steps = state.momentum, state.momentum_steps
             if self.worker_momentum is not None:
                 beta = self.worker_momentum
+                # momentum buffers are worker-sharded: local block (k, ...)
                 m_leaves, _ = jax.tree_util.tree_flatten(state.momentum)
                 new_momentum_steps = state.momentum_steps + 1
                 corr = 1.0 - beta ** new_momentum_steps.astype(jnp.float32)
-                m_new = [beta * m[0] + (1.0 - beta) * g for m, g in zip(m_leaves, g_leaves)]
+                m_new = [beta * m + (1.0 - beta) * g for m, g in zip(m_leaves, g_leaves)]
                 g_leaves = [m / corr for m in m_new]
-                new_momentum = jax.tree_util.tree_unflatten(treedef, [m[None] for m in m_new])
-            # (3) per-worker perturbation of this worker's own shards
+                new_momentum = jax.tree_util.tree_unflatten(treedef, m_new)
+            # (3) per-worker perturbation of each logical worker's own shards
+            # (skipped entirely when no adversity is configured — at k
+            # workers per submesh the k-fold loop would otherwise pay trace
+            # size for an identity transform)
             carry_leaves = None
             if self.carries_gradients:
-                carry_leaves = [c[0] for c in jax.tree_util.tree_leaves(state.carry)]
-            perturbed = [
-                self._perturb(
-                    g, s, jax.random.fold_in(jax.random.fold_in(key, widx), i), widx,
-                    previous=carry_leaves[i] if carry_leaves is not None else None,
-                    ridx=ridx, late=late,
-                )
-                for i, (g, s) in enumerate(zip(g_leaves, s_leaves))
-            ]
-            g_leaves = [p[0] for p in perturbed]
+                carry_leaves = jax.tree_util.tree_leaves(state.carry)  # (k, ...)
             new_carry = state.carry
-            if self.carries_gradients:
-                new_carry = jax.tree_util.tree_unflatten(
-                    treedef, [p[1][None] for p in perturbed]
-                )
+            if (self.attack is not None or self.lossy_link is not None
+                    or self.chaos is not None):
+                post_leaves = []
+                for i, (g, s) in enumerate(zip(g_leaves, s_leaves)):
+                    outs, posts = [], []
+                    for j in range(k):
+                        widx = gidx * k + j
+                        out, post = self._perturb(
+                            g[j], s,
+                            jax.random.fold_in(jax.random.fold_in(key, widx), i),
+                            widx,
+                            previous=(
+                                carry_leaves[i][j]
+                                if carry_leaves is not None else None
+                            ),
+                            ridx=ridx, late=lates[j],
+                        )
+                        outs.append(out)
+                        posts.append(post)
+                    g_leaves[i] = jnp.stack(outs)
+                    post_leaves.append(jnp.stack(posts))
+                if self.carries_gradients:
+                    new_carry = jax.tree_util.tree_unflatten(treedef, post_leaves)
 
             # (4/5) per-bucket robust aggregation over the worker axis
             all_rows = []
@@ -564,7 +618,9 @@ class ShardedRobustEngine:
                         part_count += participation.shape[0] * (
                             self.mesh.shape[pipe_axis] if stacked else 1
                         )
-                agg_leaves.append(agg.reshape(g.shape).astype(g.dtype))
+                # one aggregate per PARAMETER: strip the local worker
+                # stacking dim from the layout target
+                agg_leaves.append(agg.reshape(g.shape[1:]).astype(g.dtype))
             agg_tree = jax.tree_util.tree_unflatten(treedef, agg_leaves)
 
             # (6) local optax update — layouts already match the parameters
@@ -588,8 +644,9 @@ class ShardedRobustEngine:
                 beta = self.reputation_decay
                 new_reputation = beta * state.reputation + (1.0 - beta) * signal
 
-            # loss is a local partial: sum the worker group, then workers
-            total_loss = jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,))
+            # loss is a local partial: sum the local workers, then the worker
+            # group's devices, then groups
+            total_loss = jax.lax.psum(jnp.sum(losses), _IN_GROUP_AXES + (worker_axis,))
             new_loss_ema = state.loss_ema
             probe_fields = None
             if self.health_probe:
@@ -598,9 +655,12 @@ class ShardedRobustEngine:
                 # Per-worker NaN-row flags over the POST-TRANSPORT shards:
                 # count this worker's non-finite coordinates locally,
                 # complete over the worker group, flag, gather workers.
-                bad = jnp.int32(0)
+                bad = jnp.zeros((k,), jnp.int32)
                 for g in g_leaves:
-                    bad = bad + jnp.sum((~jnp.isfinite(g)).astype(jnp.int32))
+                    bad = bad + jnp.sum(
+                        (~jnp.isfinite(g)).astype(jnp.int32),
+                        axis=tuple(range(1, g.ndim)),
+                    )
                 bad = jax.lax.psum(bad, _IN_GROUP_AXES)
                 worker_nan = jax.lax.all_gather(bad > 0, worker_axis).reshape(
                     self.nb_workers
@@ -669,8 +729,19 @@ class ShardedRobustEngine:
         )
         # Host-side span wrapper only (obs/trace.py): the jit underneath is
         # untouched — zero added compiles, ``_cache_size`` falls through.
+        # EXPLICIT out_shardings pin the output state to the init_state
+        # layout: without them the compiler canonicalizes size-1 mesh axes
+        # to replicated specs, so the SECOND step call would see differently
+        # committed inputs and retrace (the zero-steady-state-recompile bar,
+        # tests/test_gar_scaling.py).
+        out_shardings = (
+            jax.tree.map(lambda a: a.sharding, state),
+            NamedSharding(self.mesh, P()),
+        )
         return trace.traced(
-            "train_step.dispatch", jax.jit(sharded, donate_argnums=(0,)), cat="train"
+            "train_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,), out_shardings=out_shardings),
+            cat="train",
         )
 
     def build_multi_step(self, loss_fn, tx, state, repeat_steps=None):
@@ -710,10 +781,69 @@ class ShardedRobustEngine:
             out_specs=(state_specs, P()),
             check_vma=False,
         )
+        # Same out_shardings discipline as build_step: keep the output state
+        # committed exactly like init_state's, or call 2 retraces.
+        out_shardings = (
+            jax.tree.map(lambda a: a.sharding, state),
+            NamedSharding(self.mesh, P()),
+        )
         return trace.traced(
-            "train_multi_step.dispatch", jax.jit(sharded, donate_argnums=(0,)),
+            "train_multi_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,), out_shardings=out_shardings),
             cat="train",
         )
+
+    def build_gar_probe(self, d, seed=0):
+        """Jitted GAR-only executable at (n, d) — the sharded twin of
+        ``RobustEngine.build_gar_probe`` (the measurement instrument behind
+        ``gar_seconds_total`` / the ``gar.aggregate`` span).
+
+        The engine proper reduces per leaf/bucket; the probe measures ONE
+        rule application over the whole-model (n, d) row matrix on a single
+        replica — exact for ``granularity=global`` (one selection over the
+        flattened vector) and an upper bound for layer/leaf granularity
+        (the same arithmetic split across buckets).  Attacks/quarantine are
+        excluded: the probe times the rule, not the adversity simulation."""
+        from ..gars import GAR_KEY_TAG
+        from ..gars.common import centered_gram_sq_distances
+
+        # Column-shard the synthetic rows over the worker axis (the flat
+        # engine's probe layout): a replicated (n, d) matrix at whole-model
+        # d and large n would cost n x the model footprint PER DEVICE — the
+        # sharded engine's whole reason to exist is that that doesn't fit.
+        # The body is plain jit, so GSPMD partitions the distance Gram and
+        # the rule's columnwise work along d automatically.  d is padded to
+        # the worker-axis multiple (sharding a dim requires divisibility;
+        # model_dim is an arbitrary parameter count), and the rows are
+        # generated ON DEVICE under jit with an explicit output sharding so
+        # the host never materializes the (n, d) matrix.
+        W = self.nb_mesh_workers
+        blk = -(-int(d) // W)
+        make_rows = jax.jit(
+            lambda k: jax.random.normal(k, (self.nb_workers, W * blk), jnp.float32),
+            out_shardings=NamedSharding(self.mesh, P(None, worker_axis)),
+        )
+        rows = make_rows(jax.random.PRNGKey(seed))
+        gar = self.gar
+
+        def body(rows, key):
+            dist2 = None
+            if gar.needs_distances:
+                # jnp-tier Gram distances (same as _bucket_distances): the
+                # common pairwise_sq_distances auto-dispatches to a Pallas
+                # kernel on TPU, which GSPMD cannot partition over the
+                # column-sharded rows
+                dist2 = jnp.maximum(centered_gram_sq_distances(rows), 0.0)
+            gar_key = jax.random.fold_in(key, GAR_KEY_TAG)
+            return gar._call_aggregate(rows, dist2, axis_name=None, key=gar_key)
+
+        fn = jax.jit(body)
+        base = jax.random.PRNGKey(seed)
+
+        def probe(step=0):
+            return fn(rows, jax.random.fold_in(base, step))
+
+        return probe
 
     def build_eval(self, loss_fn, state):
         """Jitted eval: mean of the sharded loss over the worker axis.
@@ -722,11 +852,17 @@ class ShardedRobustEngine:
         cadenced evals hit the jit cache instead of recompiling.
         """
         specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        k = self.workers_per_device
 
         def body(state, batch):
-            batch = jax.tree.map(lambda x: x[0], batch)
-            loss = loss_fn(state.params, batch)  # local partial
-            return jax.lax.psum(loss, _IN_GROUP_AXES + (worker_axis,)) / self.nb_workers
+            if k == 1:
+                local = jax.tree.map(lambda x: x[0], batch)
+                total = loss_fn(state.params, local)  # local partial
+            else:
+                total = jnp.sum(
+                    jax.vmap(lambda b: loss_fn(state.params, b))(batch)
+                )
+            return jax.lax.psum(total, _IN_GROUP_AXES + (worker_axis,)) / self.nb_workers
 
         sharded = compat.shard_map(
             body,

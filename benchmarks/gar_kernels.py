@@ -35,29 +35,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["GRAFT_GAR_TIER"] = "jnp"
 
 
-def time_fn(fn, sync, reps):
-    """Amortized per-call ms with a REAL device sync.
+def time_fn(fn, reps):
+    """Median per-call ms; EVERY timed repetition individually synced.
 
-    Under the tunneled TPU backend ``jax.block_until_ready`` returns
-    immediately (measured: a d=8M aggregation "completed" in 0.03 ms at an
-    impossible 20 TB/s); only a host fetch actually waits for the device
-    stream.  So: dispatch ``reps`` calls, fetch a scalar of the last result
-    once, and subtract the single-dispatch+fetch overhead measured the same
-    way (slope, not intercept).
+    Delegates to the ONE canonical timing protocol in
+    ``aggregathor_tpu.gars.scaling.time_aggregate`` (warmup, then per rep:
+    ``sync_fetch`` — ``block_until_ready`` + a scalar host fetch — of that
+    rep's own output, median over reps).  Under the tunneled TPU backend
+    ``jax.block_until_ready`` returns immediately (measured: a d=8M
+    aggregation "completed" in 0.03 ms at an impossible 20 TB/s); only a
+    host fetch actually waits for the device stream.  The previous protocol
+    dispatched ``reps`` unsynced calls and subtracted a single-call time
+    (slope): under tunnel latency jitter the slope went NEGATIVE and the
+    ``max(..., 0.0)`` clamp wrote whole rows as 0.0 ms (the ``dnc`` rows in
+    resume_gar_kernels.json) — it was timing async dispatch, not the
+    kernel.  The host fetch subsumes both tiers (a no-op roundtrip on the
+    already-synchronous native tier).
     """
-    sync(fn())  # warmup / compile + sync
-    t0 = time.perf_counter()
-    sync(fn())
-    t_one = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(reps):
-        out = fn()
-    sync(out)
-    t_many = time.perf_counter() - t0
-    if reps > 1:
-        return max(t_many - t_one, 0.0) / (reps - 1) * 1e3  # ms
-    return t_many * 1e3
+    from aggregathor_tpu.gars.scaling import time_aggregate
+
+    return time_aggregate(fn, reps)
 
 
 def main():
@@ -78,6 +75,23 @@ def main():
                          "op_bulyan/cpu.cpp:134-161; Bulyan's lax.scan form "
                          "must keep compile time flat in t = n - 2f - 2)")
     ap.add_argument("--scale-d", type=int, default=65536)
+    ap.add_argument("--sweep-ns", default=None,
+                    help="comma list of worker counts (e.g. 8,32,128,512): "
+                         "the n-sweep scaling mode — flat krum/bulyan vs the "
+                         "composite tree rules (hier, bucketing-over-hier) "
+                         "at fixed --sweep-d, emitting one "
+                         "aggregathor.gar.scaling.v1 document with the "
+                         "sublinear-in-n² verdict (gars/scaling.py, "
+                         "docs/gar_scaling.md)")
+    ap.add_argument("--sweep-d", type=int, default=65536,
+                    help="fixed gradient dimension for --sweep-ns")
+    ap.add_argument("--sweep-f", type=int, default=1,
+                    help="declared Byzantine count for --sweep-ns (small, so "
+                         "every generated composite stays feasible at the "
+                         "smallest swept n)")
+    ap.add_argument("--sweep-reps", type=int, default=5)
+    ap.add_argument("--sweep-out", default=None,
+                    help="write the aggregathor.gar.scaling.v1 JSON here")
     ap.add_argument("--platform", default=None, help="force a JAX platform")
     ap.add_argument("--resume-file", default=None,
                     help="JSON path recording completed (rule, tier, d) "
@@ -111,16 +125,17 @@ def main():
         """The cell's ms: from the resume cache, or measured via thunk()."""
         key = "%s|%s|%d|%d|%d|%d" % (rule, tier, d, args.n, args.f, args.reps)
         ms = resume.get(key)
+        if ms == 0.0:
+            # A 0.0 cell is the old unsynced timer's failure signature (its
+            # dispatch-loop slope clamped negative), not a measurement:
+            # re-measure it with the per-rep-synced protocol.
+            ms = None
         if ms is None:
             ms = thunk()
             if args.resume_file:
                 resume[key] = ms
                 save_json_atomic(args.resume_file, resume)
         rows.append((rule, tier, d, ms, f))
-
-    _first = jax.jit(lambda x: x.ravel()[0])
-    dev_sync = lambda out: float(_first(out))  # real sync: host fetch
-    host_sync = lambda out: out  # native tier is synchronous already
 
     for d in dims:
         # The d=8.4M fixture is ~1 GB of random floats; build it LAZILY so
@@ -147,14 +162,14 @@ def main():
             gar = gars.instantiate(rule, args.n, f)
             agg = jax.jit(gar.aggregate)
             measured(rule, "jnp:" + platform, d, f,
-                     lambda: time_fn(lambda: agg(g_dev()), dev_sync, args.reps))
+                     lambda: time_fn(lambda: agg(g_dev()), args.reps))
 
             # pallas tier (TPU only)
             if on_tpu and (rule + "-pallas") in gars.itemize():
                 pgar = gars.instantiate(rule + "-pallas", args.n, f)
                 pagg = jax.jit(pgar.aggregate)
                 measured(rule, "pallas", d, f,
-                         lambda: time_fn(lambda: pagg(g_dev()), dev_sync, args.reps))
+                         lambda: time_fn(lambda: pagg(g_dev()), args.reps))
 
             # native host tier
             if native_ok and hasattr(native, rule.replace("-", "_")):
@@ -164,7 +179,7 @@ def main():
                 else:
                     call = lambda nfn=nfn: nfn(g_host())
                 measured(rule, "native", d, f,
-                         lambda: time_fn(call, host_sync, max(3, args.reps // 4)))
+                         lambda: time_fn(call, max(3, args.reps // 4)))
 
     scale_rows = []
     if args.scale_ns:
@@ -187,7 +202,7 @@ def main():
                     t0 = time.perf_counter()
                     compiled = agg.lower(g).compile()
                     compile_s = time.perf_counter() - t0
-                    ms = time_fn(lambda: compiled(g), dev_sync, max(3, args.reps // 2))
+                    ms = time_fn(lambda: compiled(g), max(3, args.reps // 2))
                     if args.resume_file:
                         resume[key] = [compile_s, ms]
                         save_json_atomic(args.resume_file, resume)
@@ -197,6 +212,21 @@ def main():
                     "compile_s": round(compile_s, 2),
                     "value": round(ms, 4), "unit": "ms",
                 })
+
+    sweep_doc = None
+    if args.sweep_ns:
+        from aggregathor_tpu.gars import scaling
+
+        sweep_doc = scaling.run_sweep(
+            [int(x) for x in args.sweep_ns.split(",") if x],
+            args.sweep_d, f=args.sweep_f, reps=args.sweep_reps,
+            progress=lambda line: print("sweep  " + line, flush=True),
+        )
+        scaling.validate_scaling_doc(sweep_doc)
+        print(scaling.render_table(sweep_doc))
+        if args.sweep_out:
+            scaling.save_doc(args.sweep_out, sweep_doc)
+            print("wrote %s" % args.sweep_out)
 
     print("%-18s %-12s %12s %12s" % ("rule", "tier", "d", "ms"))
     for rule, tier, d, ms, f in rows:
@@ -218,6 +248,10 @@ def main():
         )
     for row in scale_rows:
         print(json.dumps(row))
+    if sweep_doc is not None:
+        print("GRAFT_BENCH_RESULT " + json.dumps(sweep_doc, sort_keys=True))
+        return 0 if sweep_doc["verdict"]["ok"] else 1
+    return 0
 
 
 if __name__ == "__main__":
@@ -226,4 +260,4 @@ if __name__ == "__main__":
     from aggregathor_tpu.utils.proc import graceful_sigterm
 
     graceful_sigterm()
-    main()
+    sys.exit(main())
