@@ -165,7 +165,11 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         ChaosSchedule(schedule_spec, n, nb_real_byz=r, args=chaos_args)
         if schedule_spec else None
     )
-    nb_real = r if (chaos is not None and chaos.has_attacks) else 0
+    # forge/tamper regimes (docs/security.md) are coalition behavior too:
+    # the first r workers run them, exactly like attack regimes
+    nb_real = r if (
+        chaos is not None and (chaos.has_attacks or chaos.has_forgery)
+    ) else 0
     mesh = make_mesh(nb_workers=nb_devices)
 
     def build(ov):
@@ -179,6 +183,11 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
             worker_metrics=bool(forensics),
             reputation_decay=ov.reputation_decay,
             quarantine_threshold=ov.quarantine_threshold,
+            # forgery schedules run under the secure submission layer: the
+            # whole point of a forge/tamper cell is that verification
+            # rejects-and-NAMES the coalition (a tampered bit is invisible
+            # to the statistical diagnostics by design, docs/security.md)
+            secure=bool(chaos is not None and chaos.has_forgery),
         )
         return engine, tx, engine.build_step(experiment.loss, tx)
 
@@ -195,6 +204,13 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
         from ..obs.forensics import ForensicsLedger
 
         ledger = ForensicsLedger(n)
+    # the aggregator role for secure cells: per-step HMAC sign/verify over
+    # the step's digests, verdicts fed to the ledger as forgery evidence
+    secure_auth = None
+    if chaos is not None and chaos.has_forgery:
+        from ..secure import SubmissionAuthenticator
+
+        secure_auth = SubmissionAuthenticator(b"campaign-session-secret", n)
 
     losses = []
     diverged = False
@@ -216,6 +232,16 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
             probe = metrics.get("probe")
             ridx = chaos.regime_at(s - 1) if chaos is not None else None
             dist = metrics.get("worker_sq_dist")
+            forgery = None
+            if secure_auth is not None and "secure" in metrics:
+                sec = {
+                    name: np.asarray(jax.device_get(value))
+                    for name, value in metrics["secure"].items()
+                }
+                forgery = ~secure_auth.process_step(
+                    s, sec["digest_sent"], sec["digest_recv"],
+                    forged=sec["forged"],
+                )
             ledger.observe(
                 s,
                 worker_sq_dist=None if dist is None else jax.device_get(dist),
@@ -225,6 +251,7 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
                 ),
                 regime=ridx,
                 regime_desc=chaos.describe(ridx) if ridx is not None else None,
+                forgery=forgery,
             )
         if watchdog is None:
             if not np.isfinite(loss):
@@ -323,11 +350,15 @@ def run_cell(exp_name, exp_args, gar_name, gar_args, n, f, r, schedule_spec,
     if ledger is not None:
         freport = ledger.report()
         expected = list(range(nb_real))
-        # 1-based ledger steps whose governing regime runs an attack
+        # 1-based ledger steps whose governing regime runs coalition
+        # behavior: an attack, or a forge/tamper storm (the submission-
+        # integrity failure modes are attributable the same way)
         attack_steps = set()
-        if chaos is not None and chaos.has_attacks:
+        if chaos is not None and (chaos.has_attacks or chaos.has_forgery):
             for sx in range(nb_steps):
-                if chaos.regimes[chaos.regime_at(sx)].attack is not None:
+                regime = chaos.regimes[chaos.regime_at(sx)]
+                if (regime.attack is not None or regime.forge_rate > 0
+                        or regime.tamper_rate > 0):
                     attack_steps.add(sx + 1)
 
         def overlaps_attack(worker):

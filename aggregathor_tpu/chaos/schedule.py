@@ -21,7 +21,17 @@ Known keys:
   "late" this step (i.i.d. per worker, see ``stragglers.py``);
 - ``straggle-mode=MODE``   what a late worker's row becomes: ``drop``
   (whole row NaN — the NaN-aware GARs exclude it) or ``stale`` (the
-  previous-step submission, via the CLEVER ``TrainState.carry``).
+  previous-step submission, via the CLEVER ``TrainState.carry``);
+- ``forge=RATE``           per-step probability that each coalition worker
+  (the first ``nb_real_byz``) submits as an IMPERSONATOR without the
+  session secret: its row is replaced by noise and its submission tag is
+  minted under the wrong key (secure/submit.py).  Under ``--secure`` the
+  aggregator's verification rejects the row (NaN, named ``forgery``
+  evidence); without it the forged row enters aggregation;
+- ``tamper=RATE``          per-step probability that each coalition
+  worker's row is bit-flipped IN TRANSIT, after honest signing — the tag
+  no longer matches the received bytes, so ``--secure`` rejects it;
+  without verification the corrupted row enters aggregation.
 
 A regime named ``calm`` (or any segment's unset keys) means: no attack,
 no loss, no stragglers.  Segments sort by step; the regime starting at
@@ -51,7 +61,7 @@ import numpy as np
 from ..utils import UserException, parse_keyval
 
 #: regime keys the DSL itself consumes; anything else must ride an ``attack=``
-_REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode")
+_REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode", "forge", "tamper")
 
 _CALM = "calm"
 
@@ -59,16 +69,20 @@ _CALM = "calm"
 class Regime:
     """One parsed schedule segment (static Python config, no arrays)."""
 
-    __slots__ = ("start", "spec", "attack", "drop_rate", "straggler_rate", "straggler_stale")
+    __slots__ = ("start", "spec", "attack", "drop_rate", "straggler_rate",
+                 "straggler_stale", "forge_rate", "tamper_rate")
 
     def __init__(self, start, spec, attack=None, drop_rate=0.0,
-                 straggler_rate=0.0, straggler_stale=False):
+                 straggler_rate=0.0, straggler_stale=False,
+                 forge_rate=0.0, tamper_rate=0.0):
         self.start = int(start)
         self.spec = spec
         self.attack = attack
         self.drop_rate = float(drop_rate)
         self.straggler_rate = float(straggler_rate)
         self.straggler_stale = bool(straggler_stale)
+        self.forge_rate = float(forge_rate)
+        self.tamper_rate = float(tamper_rate)
 
 
 def _parse_rate(key, value):
@@ -92,6 +106,8 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
     drop_rate = 0.0
     straggler_rate = None
     straggler_stale = None
+    forge_rate = 0.0
+    tamper_rate = 0.0
     seen = set()
     for setting in text.split(","):
         if "=" not in setting:
@@ -114,6 +130,10 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
             drop_rate = _parse_rate(key, value)
         elif key == "straggle":
             straggler_rate = _parse_rate(key, value)
+        elif key == "forge":
+            forge_rate = _parse_rate(key, value)
+        elif key == "tamper":
+            tamper_rate = _parse_rate(key, value)
         elif key == "straggle-mode":
             if value not in ("drop", "stale"):
                 raise UserException(
@@ -140,10 +160,17 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
                 "coalition has members" % (start, attack_name)
             )
         attack = attack_registry.instantiate(attack_name, nb_workers, nb_real_byz, attack_args)
+    if (forge_rate or tamper_rate) and nb_real_byz < 1:
+        raise UserException(
+            "Chaos regime at step %d sets forge/tamper rates but nb_real_byz "
+            "is 0; pass --nb-real-byz-workers > 0 so the forging coalition "
+            "has members" % start
+        )
     return Regime(
         start, text, attack=attack, drop_rate=drop_rate,
         straggler_rate=straggler_rate or 0.0,
         straggler_stale=bool(straggler_stale),
+        forge_rate=forge_rate, tamper_rate=tamper_rate,
     )
 
 
@@ -198,8 +225,15 @@ class ChaosSchedule:
         self._drop_rates = np.asarray([r.drop_rate for r in regimes], np.float32)
         self._straggler_rates = np.asarray([r.straggler_rate for r in regimes], np.float32)
         self._straggler_stale = np.asarray([r.straggler_stale for r in regimes], np.bool_)
+        self._forge_rates = np.asarray([r.forge_rate for r in regimes], np.float32)
+        self._tamper_rates = np.asarray([r.tamper_rate for r in regimes], np.float32)
         self.has_drop = bool((self._drop_rates > 0).any())
         self.has_stragglers = bool((self._straggler_rates > 0).any())
+        #: any regime forges or tampers submissions — the engines then run
+        #: the submission-forgery pipeline (parallel/engine.py)
+        self.has_forgery = bool(
+            (self._forge_rates > 0).any() or (self._tamper_rates > 0).any()
+        )
         #: stale stragglers re-send the previous submission, so the engine
         #: must thread the CLEVER carry through the step
         self.needs_carry = bool(
@@ -247,6 +281,16 @@ class ChaosSchedule:
         import jax.numpy as jnp
 
         return jnp.asarray(self._straggler_stale)[ridx]
+
+    def forge_rate(self, ridx):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._forge_rates)[ridx]
+
+    def tamper_rate(self, ridx):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._tamper_rates)[ridx]
 
     def apply_local_attacks(self, ridx, grad, key):
         """lax.switch dispatch of the active regime's LOCAL attack (identity

@@ -164,6 +164,32 @@ def build_parser():
              "mpi_rendezvous_mgr.patch:585-627, grpc_channel.patch:70-85)",
     )
     parser.add_argument(
+        "--secure", action="store_true",
+        help="authenticated gradient submission (secure/, docs/security.md): "
+             "every worker's per-step row is digest-tagged under a per-"
+             "(worker, step) HMAC key from --session-secret, verified before "
+             "aggregation; a failed tag becomes a NaN row AND a named "
+             "'forgery' forensics evidence entry (reject-and-name); custody "
+             "manifests are written beside every checkpoint and verified on "
+             "restore; zero added recompiles (requires --session-secret)",
+    )
+    parser.add_argument(
+        "--secure-mask", action="store_true",
+        help="bucket-level additive masking (Bonawitz-style, secure/"
+             "masking.py): individual gradient rows are one-time-padded and "
+             "the pads cancel EXACTLY inside bucket/hier group means — "
+             "requires a mean-inner meta-GAR spec (bucketing:..., or "
+             "hier:inner=average,...) and --session-secret; a worker that "
+             "drops mid-step NaNs its whole group",
+    )
+    parser.add_argument(
+        "--allow-unsigned", action="store_true",
+        help="let a --secure run restore checkpoints that carry NO custody "
+             "manifest (e.g. resuming a directory written before --secure "
+             "was enabled): provenance is then unverified for that restore; "
+             "new snapshots are signed as usual",
+    )
+    parser.add_argument(
         "--no-legacy-checkpoint-tags", action="store_true",
         help="refuse snapshots tagged under the pre-context-separation key "
              "scheme instead of accepting + re-tagging them once; set this "
@@ -387,6 +413,11 @@ def main(argv=None):
 
     run_id = args.run_id if args.run_id else make_run_id()
     registry = obs_metrics.REGISTRY
+    if (args.secure or args.secure_mask) and not args.session_secret:
+        raise UserException(
+            "--secure/--secure-mask derive their per-worker keys and mask "
+            "pads from --session-secret; pass it"
+        )
     if args.forensics and not args.worker_metrics:
         # the ledger's distance evidence rides worker_sq_dist
         info("--forensics implies --worker-metrics: enabling the per-worker "
@@ -613,10 +644,25 @@ def main(argv=None):
             guardian escalation builds a new one; everything else (mesh,
             experiment, chaos schedule, cadences) is immutable."""
 
+        # Bucket-level masking (secure/masking.py): the pad key material
+        # derives from the session secret; spec feasibility (mean-inner
+        # meta-GAR) is validated inside enable_masking at parse time — and
+        # again on every guardian escalation rebuild, so a ladder rung that
+        # swaps to an unmaskable rule is rejected, not silently unmasked.
+        group_masking = None
+        if args.secure_mask:
+            from ..secure import GroupMasking
+
+            group_masking = GroupMasking.from_secret(args.session_secret.encode())
+
         def build_training(ov):
             ts = TrainingStack()
             ts.overrides = ov
             gar = gars.instantiate(ov.gar_name, n, ov.f, list(ov.gar_args))
+            if group_masking is not None:
+                from ..secure import enable_masking
+
+                enable_masking(gar, group_masking)
             if ov.lr_scale != 1.0:
                 # escalation's lr damping composes with the named schedule
                 def schedule(s, _base=base_schedule, _x=ov.lr_scale):
@@ -649,6 +695,7 @@ def main(argv=None):
                     l1_regularize=args.l1_regularize,
                     l2_regularize=args.l2_regularize,
                     chaos=chaos,
+                    secure=args.secure,
                 )
                 loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
 
@@ -677,6 +724,7 @@ def main(argv=None):
                     leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
                     trace_ops=args.trace_ops,
                     chaos=chaos,
+                    secure=args.secure,
                 )
 
                 # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
@@ -801,12 +849,44 @@ def main(argv=None):
             from ..parallel.crypto import SnapshotCipher
 
             ckpt_cipher = SnapshotCipher(args.session_secret.encode())
+    # Authenticated gradient submission (secure/submit.py): the host-side
+    # aggregator role — per-(worker, step) HMAC sign/verify over the
+    # in-graph digests, fed one dispatch behind like the forensics ledger.
+    # Lead-only: the digests are replicated, every process would verify
+    # identical material.
+    secure_auth = None
+    if args.secure and lead:
+        from ..secure import SubmissionAuthenticator
+
+        secure_auth = SubmissionAuthenticator(
+            args.session_secret.encode(), n, registry=registry
+        )
+    # Chain of custody (secure/custody.py): signed lineage manifests beside
+    # every snapshot, verified by this runner's auto-restore and the
+    # guardian rollback restore — the training end of train -> sign -> serve.
+    custody = None
+    if args.secure and args.checkpoint_dir:
+        from ..secure import ChainOfCustody
+        from ..secure.custody import data_digest_for
+
+        identity = "%s|%s|seed=%d|n=%d" % (
+            args.experiment, " ".join(args.experiment_args), args.seed, n,
+        )
+        custody = ChainOfCustody(
+            args.session_secret.encode(), run_id=run_id,
+            experiment=args.experiment,
+            gar_spec=overrides.describe(),
+            data_digest=data_digest_for(experiment, identity),
+            submission=secure_auth,
+            allow_unsigned=args.allow_unsigned,
+        )
     checkpoints = Checkpoints(
         args.checkpoint_dir,
         pick(args.checkpoint_base_name, config.default_checkpoint_base_name),
         args.checkpoint_keep,
         authenticator=ckpt_auth,
         cipher=ckpt_cipher,
+        custody=custody,
         allow_legacy_tags=not args.no_legacy_checkpoint_tags,
         # Serialization + disk I/O run on a writer thread (the host fetch
         # stays synchronous — the step donates the state buffers); wait()
@@ -1222,6 +1302,48 @@ def main(argv=None):
                 diverged = True
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
 
+        # Secure submission feed (secure/submit.py): the host-side HMAC
+        # sign/verify over the previous dispatch's digests — the same
+        # one-dispatch lag as the forensics feed, so the crypto never blocks
+        # the in-flight step.  Verdicts are keyed by step for the forensics
+        # feed to attach as named ``forgery`` evidence.
+        secure_fed = {"start": None}
+        secure_verdicts = {}
+
+        def feed_pending_secure():
+            if secure_auth is None or pending_metrics is None:
+                return
+            if "secure" not in pending_metrics:
+                return
+            if secure_fed["start"] == pending_start:
+                return
+            secure_fed["start"] = pending_start
+            with trace.span("secure.verify", cat="obs"):
+                sec = {
+                    name: np.asarray(jax.device_get(value))
+                    for name, value in pending_metrics["secure"].items()
+                }
+                sent, recv = sec["digest_sent"], sec["digest_recv"]
+                forged, rejected = sec["forged"], sec["rejected"]
+                if sent.ndim == 2:  # single step -> one-step chunk
+                    sent, recv = sent[None], recv[None]
+                    forged, rejected = forged[None], rejected[None]
+                for i in range(sent.shape[0]):
+                    at_step = pending_start + i + 1
+                    ok = secure_auth.process_step(
+                        at_step, sent[i], recv[i], forged=forged[i]
+                    )
+                    if not np.array_equal(~ok, rejected[i].astype(bool)):
+                        # cannot happen by construction (the in-graph
+                        # rejection models exactly the tag-verification
+                        # outcome) — if it does, the simulation drifted
+                        warning(
+                            "secure: host verification disagrees with the "
+                            "in-graph rejection at step %d" % at_step
+                        )
+                    if ledger is not None:
+                        secure_verdicts[at_step] = ~ok
+
         # Forensics feed: one ledger observation per completed step, taken
         # from the PREVIOUS dispatch (the same one-step lag as the NaN-abort
         # check — by feed time the values are materialized, so the fetch
@@ -1269,6 +1391,9 @@ def main(argv=None):
                             chaos.describe(ridx)
                             if (ridx is not None and chaos is not None) else None
                         ),
+                        # named forgery evidence from the submission
+                        # authenticator (reject-and-name, secure/submit.py)
+                        forgery=secure_verdicts.pop(pending_start + i + 1, None),
                     )
 
         def probe_clean(dispatch_metrics):
@@ -1321,6 +1446,11 @@ def main(argv=None):
                     "reason": reason, "from_step": int(at_step),
                     "attempt": attempt,
                 })
+            # abandoned verdicts: the replay window re-verifies its steps
+            # (the tag chain keeps the abandoned timeline — it is an
+            # append-only audit of everything the aggregator verified)
+            secure_verdicts.clear()
+            secure_fed["start"] = None
             rung = guardian.ladder.rung(attempt)
             if rung is not None:
                 try:
@@ -1328,6 +1458,9 @@ def main(argv=None):
                     with Context("escalate"):
                         new_ts = build_training(new_overrides)
                     overrides, ts = new_overrides, new_ts
+                    if custody is not None:
+                        # manifests saved from here on sign the new spec
+                        custody.gar_spec = overrides.describe()
                     info("guardian: escalated — %s (now %s)"
                          % (rung.describe(), overrides.describe()))
                     summaries.event(rstep, "guardian_escalation", {
@@ -1385,6 +1518,7 @@ def main(argv=None):
             Returns True when a rollback happened — the caller discards its
             in-flight results."""
             nonlocal pending_loss, pending_metrics
+            feed_pending_secure()
             feed_pending_forensics()
             if watchdog is None or pending_metrics is None:
                 return False
@@ -1593,6 +1727,7 @@ def main(argv=None):
             # training error.
             aborting = sys.exc_info()[0] is not None
             try:
+                feed_pending_secure()
                 feed_pending_forensics()
                 if ledger is not None:
                     md_path = (

@@ -20,6 +20,14 @@ noise / stale) — the fault-injection hook the smoke script and the serve
 campaign drive to prove the vote masks a corrupted replica in production
 configuration, not just in unit tests.
 
+Chain of custody (docs/security.md): with ``--session-secret``, every
+restored checkpoint's signed lineage manifest (written by ``--secure``
+training) is verified before loading — an unsigned checkpoint is refused
+unless ``--allow-unsigned`` — and ``/healthz`` reports
+``custody_verified``.  ``SIGHUP`` hot-restores the replicas from their
+checkpoint directories through the same verification with zero recompiles
+(requests keep flowing; a bad snapshot keeps the previous weights).
+
 Example::
 
   python -m aggregathor_tpu.cli.serve --experiment digits \
@@ -68,6 +76,13 @@ def build_parser():
                         help="refuse snapshots tagged under the legacy key scheme")
     parser.add_argument("--encrypt-checkpoints", action="store_true",
                         help="snapshots are encrypted at rest (requires --session-secret)")
+    parser.add_argument("--allow-unsigned", action="store_true",
+                        help="serve checkpoints WITHOUT a custody manifest: with "
+                             "--session-secret the chain-of-custody manifest "
+                             "(written by --secure training) is verified before "
+                             "loading and an unsigned checkpoint is REFUSED "
+                             "unless this explicit opt-out is passed "
+                             "(/healthz then reports custody_verified false)")
     # Batching / shedding
     parser.add_argument("--max-batch", type=int, default=64, help="bucket ladder top / batch cap")
     parser.add_argument("--buckets", default=None, metavar="B1,B2,...",
@@ -106,9 +121,14 @@ def build_parser():
 def load_replicas(args, experiment):
     """Resolve the replica parameter sets: checkpoint restores + poison specs.
 
-    Returns ``(replicas, sources)`` — ``sources`` is the human-readable
-    per-replica provenance logged at startup and reported by /healthz's
-    operator story ("which checkpoint is replica 2, and is it poisoned?").
+    Returns ``(replicas, sources, custody_verified)`` — ``sources`` is the
+    human-readable per-replica provenance logged at startup and reported by
+    /healthz's operator story ("which checkpoint is replica 2, and is it
+    poisoned?"); ``custody_verified`` is the chain-of-custody verdict (True
+    = every restored checkpoint's signed lineage manifest verified, False =
+    an unsigned restore was allowed through ``--allow-unsigned``, None =
+    no ``--session-secret``, verification not attempted).  Called again on
+    hot restore (SIGHUP), so a fresh custody tally is built per load.
     """
     from .. import config
     from ..chaos.replica_faults import corrupt_params, parse_poison
@@ -122,12 +142,17 @@ def load_replicas(args, experiment):
     )
     authenticator = None
     cipher = None
+    custody = None
     if args.encrypt_checkpoints and not args.session_secret:
         raise UserException("--encrypt-checkpoints derives its key from --session-secret; pass both")
     if args.session_secret:
         from ..parallel.auth import GradientAuthenticator
+        from ..secure import ChainOfCustody
 
         authenticator = GradientAuthenticator(args.session_secret.encode(), 1, context=b"ckpt")
+        custody = ChainOfCustody(
+            args.session_secret.encode(), allow_unsigned=args.allow_unsigned
+        )
         if args.encrypt_checkpoints:
             from ..parallel.crypto import SnapshotCipher
 
@@ -139,6 +164,7 @@ def load_replicas(args, experiment):
             base_name=args.checkpoint_base_name,
             authenticator=authenticator, cipher=cipher,
             allow_legacy_tags=not args.no_legacy_checkpoint_tags,
+            custody=custody,
         )
 
     dirs = list(args.ckpt_dir)
@@ -194,7 +220,8 @@ def load_replicas(args, experiment):
             else:
                 sources.append("%s@%d" % (directory, step))
         replicas.append(params)
-    return replicas, sources
+    custody_verified = None if custody is None else custody.all_verified
+    return replicas, sources, custody_verified
 
 
 def main(argv=None):
@@ -220,10 +247,16 @@ def main(argv=None):
 
     with Context("load"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
-        replicas, sources = load_replicas(args, experiment)
+        replicas, sources, custody_verified = load_replicas(args, experiment)
         nb_replicas = len(replicas)
         for index, source in enumerate(sources):
             info("replica %d: %s" % (index, source))
+        if custody_verified is not None:
+            info("chain of custody: %s" % (
+                "VERIFIED (every replica's lineage manifest checks out)"
+                if custody_verified else
+                "UNVERIFIED (unsigned checkpoint allowed by --allow-unsigned)"
+            ))
         vote = None
         if args.gar != "none" and nb_replicas > 1:
             f = args.replica_byz if args.replica_byz is not None else (nb_replicas - 1) // 2
@@ -253,6 +286,7 @@ def main(argv=None):
         summaries=summaries,
         request_timeout_s=args.request_timeout,
         flag_threshold=args.flag_threshold,
+        custody_verified=custody_verified,
     )
     host, port = server.server_address[:2]
     if args.ready_file:
@@ -271,9 +305,35 @@ def main(argv=None):
         info("Signal %d: draining and shutting down" % signum)
         threading.Thread(target=server.shutdown, daemon=True).start()
 
+    def hot_restore():
+        """Re-restore every replica from its checkpoint directory and swap
+        the engine's parameter stack in place (zero recompiles, requests
+        keep flowing) — provenance RE-verified through the same custody
+        path as startup, /healthz's custody_verified updated.  ANY failure
+        — custody violation, vanished file, torn or undeserializable
+        snapshot — keeps serving the current weights (the catch is broad by
+        design: a bad snapshot must not take the service down)."""
+        try:
+            fresh, fresh_sources, fresh_custody = load_replicas(args, experiment)
+            engine.swap_replicas(fresh)
+            server.set_custody_verified(fresh_custody)
+            for index, source in enumerate(fresh_sources):
+                info("hot restore: replica %d <- %s" % (index, source))
+        except Exception as exc:
+            info("hot restore REFUSED (still serving previous weights): "
+                 "%s: %s" % (type(exc).__name__, exc))
+
+    def on_reload(signum, frame):
+        # off the signal handler for the same deadlock reason as shutdown
+        import threading
+
+        info("Signal %d: hot checkpoint restore" % signum)
+        threading.Thread(target=hot_restore, daemon=True).start()
+
     previous = {
         signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
         signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
+        signal.SIGHUP: signal.signal(signal.SIGHUP, on_reload),
     }
     try:
         info("Serving %s on http://%s:%d (%d replica(s), vote=%s)"

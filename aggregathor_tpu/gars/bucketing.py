@@ -60,6 +60,10 @@ class BucketingGAR(GAR):
     needs_distances = False  # distances (if any) are over bucket means, computed here
     uses_axis = True
     uses_key = True
+    #: optional ``secure.masking.GroupMasking``: bucket means are computed in
+    #: the exact mod-2^64 masked domain, individual rows one-time-padded
+    #: within their bucket (set via ``secure.masking.enable_masking``)
+    masking = None
     ARG_DEFAULTS = {"s": 2, "inner": "krum"}
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
@@ -92,7 +96,7 @@ class BucketingGAR(GAR):
                 % (self.s, self.nb_workers, type(self.inner).__name__)
             )
 
-    def _buckets(self, block, key):
+    def _buckets(self, block, key, axis_name=None):
         n, s = self.nb_workers, self.s
         perm = (
             jax.random.permutation(key, n)
@@ -104,6 +108,18 @@ class BucketingGAR(GAR):
             pad = jnp.full((self.nb_padded, block.shape[-1]), jnp.nan, block.dtype)
             stack = jnp.concatenate([stack, pad], axis=0)
         grouped = stack.reshape(self.nb_buckets, s, block.shape[-1])
+        if self.masking is not None:
+            # Bucket-level secure aggregation (secure/masking.py): the same
+            # bucket means, computed in the exact masked integer domain —
+            # pairwise pads cancel mod 2^64, a dropped row NaNs its bucket
+            # (uncancelled mask), and the padded ragged bucket was NaN
+            # already.  fold tag 7 inside keeps the pad stream disjoint
+            # from this permutation (raw key) and the inner rule (fold 1).
+            from ..secure.masking import masked_group_mean
+
+            return masked_group_mean(
+                grouped, key, self.masking, axis_name=axis_name
+            ), perm
         return jnp.mean(grouped, axis=1), perm
 
     def _inner_dist2(self, buckets, axis_name):
@@ -120,14 +136,14 @@ class BucketingGAR(GAR):
         return None if key is None else jax.random.fold_in(key, 1)
 
     def aggregate_block(self, block, dist2=None, axis_name=None, key=None):
-        buckets, _ = self._buckets(block, key)
+        buckets, _ = self._buckets(block, key, axis_name=axis_name)
         return self.inner._call_aggregate(
             buckets, self._inner_dist2(buckets, axis_name),
             axis_name=axis_name, key=self._inner_key(key),
         )
 
     def aggregate_block_and_participation(self, block, dist2=None, axis_name=None, key=None):
-        buckets, perm = self._buckets(block, key)
+        buckets, perm = self._buckets(block, key, axis_name=axis_name)
         agg, bucket_part = self.inner.aggregate_block_and_participation(
             buckets, self._inner_dist2(buckets, axis_name),
             axis_name=axis_name, key=self._inner_key(key),

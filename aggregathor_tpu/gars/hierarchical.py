@@ -71,6 +71,10 @@ class HierarchicalGAR(GAR):
     needs_distances = False  # distances (if any) are per level, computed here
     uses_axis = True
     uses_key = True
+    #: optional ``secure.masking.GroupMasking`` (requires ``inner=average``,
+    #: validated by ``secure.masking.enable_masking``): group summaries are
+    #: computed in the exact masked integer domain
+    masking = None
     ARG_DEFAULTS = {"g": 4, "inner": "median", "outer": "krum", "inner_f": -1}
 
     def __init__(self, nb_workers, nb_byz_workers, args=None):
@@ -113,6 +117,18 @@ class HierarchicalGAR(GAR):
     def _inner_call(self, grouped, axis_name, key, with_participation):
         """vmapped inner pass: (n/g, g, d_block) -> (n/g, d_block) summaries
         (+ per-group (n/g, g) participation when requested)."""
+        if self.masking is not None:
+            # Masked group means (secure/masking.py): inner=average computed
+            # in the exact mod-2^64 masked domain — rows one-time-padded
+            # within their group, a dropped row NaNs its group summary and
+            # the NaN-tolerant outer absorbs it.  Participation within a
+            # group is uniform 1/g, exactly like plain average's.
+            from ..secure.masking import masked_group_mean
+
+            summaries = masked_group_mean(
+                grouped, key, self.masking, axis_name=axis_name
+            )
+            return summaries, None
         inner = self.inner
         dist2 = None
         if inner.needs_distances:

@@ -50,12 +50,20 @@ from ..utils import UserException, info, warning
 
 class Checkpoints:
     def __init__(self, directory, base_name="model", max_to_keep=5, authenticator=None,
-                 background=False, allow_legacy_tags=True, cipher=None):
+                 background=False, allow_legacy_tags=True, cipher=None, custody=None):
         self.directory = directory
         self.base_name = base_name
         self.max_to_keep = int(max_to_keep)
         self.authenticator = authenticator
         self.cipher = cipher
+        # Chain of custody (secure/custody.py): when set, every save writes
+        # a signed lineage manifest beside the snapshot (run id, GAR spec,
+        # data digest, submission tag chain) and every restore VERIFIES it
+        # before deserialization — the train -> sign -> serve provenance the
+        # serving restore path also checks.  The lineage fields are
+        # snapshotted on the save caller's thread (``lineage``), so the
+        # background writer signs the chain head as of the save.
+        self.custody = custody
         # One-time migration for snapshots tagged before key derivation
         # gained domain separation: when True, a tag minted under the OLD
         # scheme (same secret) is accepted at restore and the snapshot is
@@ -122,7 +130,8 @@ class Checkpoints:
         Returns the discarded steps."""
         dropped = [s for s in self.steps() if s > step]
         for old in dropped:
-            for path in (self._path(old), self._path(old) + ".tag"):
+            for path in (self._path(old), self._path(old) + ".tag",
+                         self._path(old) + ".manifest.json"):
                 try:
                     os.remove(path)
                 except OSError:
@@ -197,6 +206,12 @@ class Checkpoints:
                         "forged, or a --session-secret mismatch; treat the "
                         "snapshot as untrusted" % (self._path(step),)
                     )
+        if self.custody is not None:
+            # Provenance BEFORE deserialization (after the byte-integrity
+            # tag): the lineage manifest must sign exactly the on-disk
+            # bytes, or the snapshot is refused (secure/custody.py —
+            # fail-closed on a missing manifest unless allow_unsigned).
+            self.custody.verify(self._path(step), step, data)
         if self.cipher is not None:
             data = self.cipher.decrypt(step, data)
         else:
@@ -228,10 +243,16 @@ class Checkpoints:
                 state = state.replace(**{field: None})
         with trace.span("checkpoint.fetch", cat="checkpoint", step=int(step)):
             host_state = jax.device_get(state)
+        # lineage snapshot on the CALLER's thread: the manifest must sign
+        # the tag-chain head as of this save, not of some later step the
+        # background writer drains at
+        lineage = self.custody.lineage(step) if self.custody is not None else None
         if self._pool is not None:
-            self._pending.append(self._pool.submit(self._write, host_state, step))
+            self._pending.append(
+                self._pool.submit(self._write, host_state, step, lineage)
+            )
             return self._path(step)
-        return self._write(host_state, step)
+        return self._write(host_state, step, lineage)
 
     def wait(self, shutdown=False):
         """Join ALL pending background writes, then re-raise the first
@@ -258,7 +279,7 @@ class Checkpoints:
             raise first_error
 
     @trace.span("checkpoint.write", cat="checkpoint")
-    def _write(self, host_state, step):
+    def _write(self, host_state, step, lineage=None):
         # (span runs on the writer thread under background=True — the
         # tracer is thread-safe and the trace shows the write off the
         # critical path, which is the point of the background writer)
@@ -268,6 +289,12 @@ class Checkpoints:
             # exactly the bytes on disk
             data = self.cipher.encrypt(step, data)
         path = self._path(step)
+        if self.custody is not None:
+            # the manifest signs the FINAL on-disk bytes (post-encryption)
+            # and lands before the data rename, like the tag sidecar:
+            # discovery scans .ckpt files, so a manifest without data is
+            # invisible while data without a manifest fails restore
+            self.custody.write(path, step, data, payload=lineage)
         if self.authenticator is not None:
             # Slot 0 = the controller identity; the step binding ties each tag
             # to its snapshot (an attacker with file access can still delete
@@ -289,7 +316,8 @@ class Checkpoints:
                 if old == self._pinned:
                     continue  # last-known-good survives pruning (see pin)
                 os.remove(self._path(old))
-                tag_path = self._path(old) + ".tag"
-                if os.path.exists(tag_path):
-                    os.remove(tag_path)
+                for sidecar in (self._path(old) + ".tag",
+                                self._path(old) + ".manifest.json"):
+                    if os.path.exists(sidecar):
+                        os.remove(sidecar)
         return path

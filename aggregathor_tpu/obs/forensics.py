@@ -87,8 +87,11 @@ def binom_sf(total, successes, p):
 SCHEMA = "aggregathor.obs.forensics.v1"
 
 #: evidence kinds that attribute on their own (``rank`` is weak — it only
-#: attributes through persistence, see :meth:`ForensicsLedger.report`)
-STRONG_EVIDENCE = ("distance", "nan_row", "reputation")
+#: attributes through persistence, see :meth:`ForensicsLedger.report`).
+#: ``forgery`` is the secure submission layer's verdict (secure/submit.py):
+#: the worker's per-step HMAC tag failed verification — cryptographic,
+#: not statistical, so it is strong by construction (reject-and-name).
+STRONG_EVIDENCE = ("distance", "nan_row", "reputation", "forgery")
 
 #: report keys every per-worker record carries
 WORKER_KEYS = (
@@ -147,15 +150,23 @@ class ForensicsLedger:
     # ingestion
 
     def observe(self, step, worker_sq_dist=None, worker_nan=None,
-                reputation=None, regime=None, regime_desc=None):
+                reputation=None, regime=None, regime_desc=None, forgery=None):
         """One completed training step's diagnostics.  Every vector is
         length-n (or None when the engine did not compute it); non-finite
         ``worker_sq_dist`` entries are treated as masked (no ``distance``
-        evidence — the NaN-row flag is the signal for dead rows)."""
+        evidence — the NaN-row flag is the signal for dead rows).
+        ``forgery`` is the submission authenticator's per-worker verdict
+        (True = this worker's tag failed verification this step)."""
         suspects = {}
 
         def mark(worker, kind):
             suspects.setdefault(int(worker), set()).add(kind)
+
+        if forgery is not None:
+            forged = np.asarray(forgery).reshape(-1)
+            self._check_len("forgery", forged)
+            for worker in np.nonzero(forged.astype(bool))[0]:
+                mark(worker, "forgery")
 
         if worker_sq_dist is not None:
             dist = np.asarray(worker_sq_dist, np.float64).reshape(-1)

@@ -119,6 +119,58 @@ class GradientAuthenticator:
         expect = _py_hmac.new(self.keys[worker_index], msg, hashlib.sha256).digest()
         return _py_hmac.compare_digest(expect, bytes(tag))
 
+    def sign_many(self, step, rows):
+        """Vectorized hot-path signing: one (n, d) stack -> (n, 32) uint8 tags.
+
+        Bit-compatible with the single-row API: row ``w``'s tag equals
+        ``sign(w, step, rows[w].tobytes())``.  The per-worker keys were
+        derived ONCE at construction; this path additionally reuses one
+        message buffer across rows (header packed in place, payload copied
+        into the same bytearray), so the per-step cost is n HMAC cores and
+        nothing else — the discipline the secure submission layer
+        (secure/submit.py) leans on every training step."""
+        import numpy as np
+
+        rows = np.ascontiguousarray(rows)
+        if rows.shape[0] != self.nb_workers:
+            raise ValueError(
+                "sign_many got %d rows for %d workers" % (rows.shape[0], self.nb_workers)
+            )
+        row_bytes = rows.nbytes // self.nb_workers if self.nb_workers else 0
+        flat = rows.reshape(self.nb_workers, -1).view(np.uint8).reshape(
+            self.nb_workers, row_bytes
+        )
+        tags = np.empty((self.nb_workers, 32), np.uint8)
+        message = bytearray(16 + row_bytes)
+        use_native = _native_ok()
+        for worker in range(self.nb_workers):
+            struct.pack_into("<qq", message, 0, worker, int(step))
+            message[16:] = flat[worker].tobytes()
+            if use_native:
+                tag = native.hmac_sha256(self.keys[worker], bytes(message))
+            else:
+                tag = _py_hmac.new(
+                    self.keys[worker], bytes(message), hashlib.sha256
+                ).digest()
+            tags[worker] = np.frombuffer(tag, np.uint8)
+        return tags
+
+    def verify_many(self, step, rows, tags):
+        """Vectorized verification: (n, d) stack + (n, 32) tags -> (n,) bool.
+
+        Constant-time per row (``compare_digest`` on the recomputed tag);
+        bit-compatible with ``verify`` row by row."""
+        import numpy as np
+
+        expect = self.sign_many(step, rows)
+        tags = np.ascontiguousarray(tags).reshape(self.nb_workers, -1)
+        ok = np.empty((self.nb_workers,), bool)
+        for worker in range(self.nb_workers):
+            ok[worker] = _py_hmac.compare_digest(
+                expect[worker].tobytes(), tags[worker].tobytes()
+            )
+        return ok
+
     def verify_legacy(self, worker_index, step, payload, tag):
         """Verify under the pre-context-separation key derivation.
 

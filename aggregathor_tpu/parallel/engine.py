@@ -107,11 +107,11 @@ def validate_chaos_args(chaos, attack, lossy_link, nb_workers, nb_real_byz):
             "ChaosSchedule was built for n=%d workers but the engine has %d"
             % (chaos.nb_workers, nb_workers)
         )
-    if chaos.has_attacks:
+    if chaos.has_attacks or getattr(chaos, "has_forgery", False):
         if nb_real_byz == 0:
             raise UserException(
-                "The chaos schedule declares attack regimes; they need "
-                "--nb-real-byz-workers > 0 to have anyone to run them"
+                "The chaos schedule declares attack/forge/tamper regimes; they "
+                "need --nb-real-byz-workers > 0 to have anyone to run them"
             )
         if chaos.nb_real_byz != nb_real_byz:
             # the schedule sized its attacks (e.g. little's z formula) for a
@@ -165,7 +165,7 @@ class RobustEngine:
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
                  granularity="vector", leaf_bucketing="auto", trace_ops=False, chaos=None,
-                 health_probe=True):
+                 health_probe=True, secure=False):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -272,6 +272,15 @@ class RobustEngine:
         self.carries_gradients = (lossy_link is not None and lossy_link.clever) or (
             self.chaos is not None and self.chaos.needs_carry
         )
+        # Authenticated submission (secure/submit.py): every worker's
+        # post-transport row is reduced to a tiny checksum INSIDE the one
+        # compiled step (zero added dispatches/recompiles — the compile
+        # count is identical with secure on or off, asserted by
+        # tests/test_secure.py); rows whose tags cannot verify (chaos
+        # forge/tamper) are masked NaN before stacking, and the digests +
+        # verdicts ride metrics["secure"] to the host where the real HMAC
+        # sign/verify runs one dispatch behind (cli/runner.py).
+        self.secure = bool(secure)
         # jitted slice-concat executables for assemble_batches, per slice count
         self._assemble_cache = {}
 
@@ -292,18 +301,27 @@ class RobustEngine:
         return losses, gvecs, flatmap
 
     def _perturb_local(self, gvecs, key, carry=None, ridx=None):
-        """Apply local attack + lossy link + chaos regime to each local
-        worker's own slot.
+        """Apply local attack + lossy link + chaos regime + the submission-
+        forgery pipeline to each local worker's own slot.
 
-        Returns (perturbed (k, d), new_carry) — ``new_carry`` is the
-        post-transport gradients, i.e. what "the PS received" this step:
-        exactly the stale value a lost packet keeps under CLEVER infill, and
-        the value a stale-mode straggler keeps re-submitting (a worker late
-        k steps in a row re-sends the same gradient k times).
+        Returns (perturbed (k, d), new_carry, secure_info) — ``new_carry``
+        is the post-transport gradients, i.e. what "the PS received" this
+        step: exactly the stale value a lost packet keeps under CLEVER
+        infill, and the value a stale-mode straggler keeps re-submitting (a
+        worker late k steps in a row re-sends the same gradient k times).
+        ``secure_info`` (None unless ``secure``) carries the per-local-
+        worker submitted/received digests and the forge/reject verdicts —
+        what the host-side authenticator signs and verifies one dispatch
+        behind (secure/submit.py).
         """
+        from ..secure.submit import FORGE_SCALE, row_digest, tamper_row
+
         k = self.workers_per_device
         didx = jax.lax.axis_index(worker_axis)
+        chaos_forgery = self.chaos is not None and self.chaos.has_forgery
         out = []
+        carry_rows = []  # post-transport, PRE-forgery (see carry note below)
+        sec = {"digest_sent": [], "digest_recv": [], "forged": [], "rejected": []}
         for j in range(k):
             gidx = didx * k + j
             g = gvecs[j]
@@ -332,9 +350,64 @@ class RobustEngine:
                     g = self.chaos.stragglers.apply(
                         g, late, self.chaos.straggler_stale(ridx), previous=previous
                     )
+            # The carry captures the row HERE — post-transport, PRE-forgery
+            # (the sharded engine's convention): a stale straggler re-sends
+            # the worker's own last submission, not the impostor's noise or
+            # the aggregator's NaN rejection (a rejected step must not leak
+            # extra NaN rows into later steps' f accounting).
+            carry_rows.append(g)
+            # Submission forgery pipeline (docs/security.md).  Order matters:
+            # an impersonator REPLACES the submission (and will sign it with
+            # a key it does not have), the sender-side digest covers what was
+            # submitted, tampering corrupts bits AFTER signing, the receiver
+            # digests what arrived — and under ``secure`` a row whose tag
+            # cannot verify is rejected to NaN before stacking (absorbed by
+            # the GARs within the same f budget as a lossy row).  Fold tags
+            # 5/6 keep the forge/tamper streams disjoint from attack (1),
+            # lossy (2), augment (3) and sampling (4).
+            is_forge = is_tamper = None
+            if chaos_forgery:
+                fkey = jax.random.fold_in(wkey, 5)
+                is_forge = (gidx < self.nb_real_byz) & jax.random.bernoulli(
+                    fkey, self.chaos.forge_rate(ridx)
+                )
+                impostor = jax.random.normal(
+                    jax.random.fold_in(fkey, 1), g.shape, g.dtype
+                ) * jnp.asarray(FORGE_SCALE, g.dtype)
+                g = jnp.where(is_forge, impostor, g)
+            sent_digest = None
+            if self.secure:
+                sent_digest = row_digest(g)
+                sec["digest_sent"].append(sent_digest)
+            if chaos_forgery:
+                tkey = jax.random.fold_in(wkey, 6)
+                is_tamper = (gidx < self.nb_real_byz) & jax.random.bernoulli(
+                    tkey, self.chaos.tamper_rate(ridx)
+                )
+                g = jnp.where(is_tamper, tamper_row(g, jax.random.fold_in(tkey, 1)), g)
+            if self.secure:
+                # without in-transit transforms the received bytes ARE the
+                # submitted bytes — reuse the checksum instead of paying a
+                # second O(d) pass (half the digest tax of the common case)
+                sec["digest_recv"].append(
+                    row_digest(g) if chaos_forgery else sent_digest
+                )
+                forged_flag = is_forge if is_forge is not None else jnp.bool_(False)
+                rejected = forged_flag
+                if is_tamper is not None:
+                    rejected = rejected | is_tamper
+                sec["forged"].append(forged_flag)
+                sec["rejected"].append(rejected)
+                g = jnp.where(rejected, jnp.nan, g)
             out.append(g)
         stacked = jnp.stack(out, axis=0)
-        return stacked, (stacked if self.carries_gradients else None)
+        carry = jnp.stack(carry_rows, axis=0) if self.carries_gradients else None
+        secure_info = None
+        if self.secure:
+            secure_info = {
+                key_: jnp.stack(values) for key_, values in sec.items()
+            }
+        return stacked, carry, secure_info
 
     def _reshard_to_blocks(self, gvecs, d):
         """(k, d) worker-sharded -> (n, d_block) dimension-sharded column block."""
@@ -647,7 +720,9 @@ class RobustEngine:
                 new_momentum = beta * state.momentum + (1.0 - beta) * gvecs
                 new_momentum_steps = state.momentum_steps + 1
                 gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
-            gvecs, new_carry = self._perturb_local(gvecs, key, carry=state.carry, ridx=ridx)
+            gvecs, new_carry, secure_info = self._perturb_local(
+                gvecs, key, carry=state.carry, ridx=ridx
+            )
             d = gvecs.shape[-1]
             if self.granularity == "leaf":
                 agg, participation, wdist, rep_dist = self._aggregate_per_leaf(
@@ -735,6 +810,21 @@ class RobustEngine:
             }
             if probe_fields is not None:
                 metrics[health.PROBE_KEY] = probe_fields
+            if secure_info is not None:
+                # Submission authentication material for the host-side
+                # sign/verify (secure/submit.py): per-worker digests of what
+                # was submitted vs received, plus the forge/reject verdicts.
+                # Gathered worker-major like the probe's NaN flags.
+                def gather_workers(local):
+                    if W > 1:
+                        gathered = jax.lax.all_gather(local, worker_axis)
+                        return gathered.reshape((self.nb_workers,) + local.shape[1:])
+                    return local
+
+                metrics["secure"] = {
+                    name: gather_workers(value)
+                    for name, value in secure_info.items()
+                }
             if ridx is not None:
                 # replicated scalar (a pure function of the replicated step)
                 # — the observability layer's regime column

@@ -82,7 +82,7 @@ class ShardedRobustEngine:
                  exchange_dtype=None, worker_momentum=None, worker_metrics=False,
                  reputation_decay=None, quarantine_threshold=0.0,
                  l1_regularize=None, l2_regularize=None, chaos=None,
-                 health_probe=True, nb_workers=None):
+                 health_probe=True, nb_workers=None, secure=False):
         self.mesh = mesh
         self.gar = gar
         # Logical workers decoupled from mesh slots (the flat engine's
@@ -183,6 +183,12 @@ class ShardedRobustEngine:
         # reported loss.
         self.l1_regularize = float(l1_regularize) if l1_regularize else None
         self.l2_regularize = float(l2_regularize) if l2_regularize else None
+        # Authenticated submission (secure/submit.py), the flat engine's
+        # semantics on sharded leaves: per-worker digests accumulate over
+        # every leaf shard (mod-2^32 lane sums, psum-completed within the
+        # worker group), chaos forge/tamper corrupt whole logical workers,
+        # and rejected submissions NaN every leaf of that worker.
+        self.secure = bool(secure)
 
     # ------------------------------------------------------------------ #
 
@@ -338,6 +344,96 @@ class ShardedRobustEngine:
                 )
         out = flat.reshape(g.shape)
         return out, out
+
+    def _submission_pipeline(self, g_leaves, key, gidx, ridx):
+        """The submission-forgery pipeline on sharded leaves (the flat
+        engine's ``_perturb_local`` tail, see parallel/engine.py): chaos
+        ``forge`` replaces every leaf of a coalition worker with impostor
+        noise, sender digests accumulate over all leaf shards, ``tamper``
+        flips a bit after signing, receiver digests follow, and under
+        ``secure`` a rejected worker's every leaf reads NaN.
+
+        Returns ``(g_leaves, secure_local)`` — ``secure_local`` (None unless
+        ``secure``) holds the per-LOCAL-worker digests (lane sums over this
+        device's shards; the body psum-completes them within the worker
+        group) and the forge/reject verdicts.
+        """
+        from ..secure.submit import (
+            DIGEST_LANES,
+            FORGE_SCALE,
+            row_digest,
+            tamper_row,
+        )
+
+        chaos_forgery = self.chaos is not None and self.chaos.has_forgery
+        if not (self.secure or chaos_forgery):
+            return g_leaves, None
+        k = self.workers_per_device
+        out_leaves = [[] for _ in g_leaves]
+        sent = jnp.zeros((k, DIGEST_LANES), jnp.uint32)
+        recv = jnp.zeros((k, DIGEST_LANES), jnp.uint32)
+        forged_flags, rejected_flags = [], []
+        for j in range(k):
+            widx = gidx * k + j
+            # the 32_000+ offset namespace keeps these per-worker streams
+            # disjoint from the per-(worker, leaf) perturbation parents and
+            # the 30_000+ straggler draws (see the body's key discipline)
+            wkey = jax.random.fold_in(key, 32_000 + widx)
+            is_forge = is_tamper = None
+            if chaos_forgery:
+                fkey = jax.random.fold_in(wkey, 5)
+                is_forge = (widx < self.nb_real_byz) & jax.random.bernoulli(
+                    fkey, self.chaos.forge_rate(ridx)
+                )
+                tkey = jax.random.fold_in(wkey, 6)
+                is_tamper = (widx < self.nb_real_byz) & jax.random.bernoulli(
+                    tkey, self.chaos.tamper_rate(ridx)
+                )
+            forged_flag = is_forge if is_forge is not None else jnp.bool_(False)
+            rejected = forged_flag
+            if is_tamper is not None:
+                rejected = rejected | is_tamper
+            sent_j = jnp.zeros((DIGEST_LANES,), jnp.uint32)
+            recv_j = jnp.zeros((DIGEST_LANES,), jnp.uint32)
+            for i, g in enumerate(g_leaves):
+                flat = g[j].reshape(-1).astype(jnp.float32)
+                if is_forge is not None:
+                    impostor = jax.random.normal(
+                        jax.random.fold_in(jax.random.fold_in(fkey, 1), i),
+                        flat.shape, flat.dtype,
+                    ) * jnp.float32(FORGE_SCALE)
+                    flat = jnp.where(is_forge, impostor, flat)
+                leaf_digest = None
+                if self.secure:
+                    # per-leaf salt: leaves must not alias in the checksum
+                    leaf_digest = row_digest(flat, salt=i * 0x9E3779B1)
+                    sent_j = sent_j + leaf_digest
+                if is_tamper is not None and i == 0:
+                    # one bit flipped in transit (the first leaf's shard)
+                    flat = jnp.where(
+                        is_tamper, tamper_row(flat, jax.random.fold_in(tkey, 1)), flat
+                    )
+                if self.secure:
+                    # no in-transit transform on this leaf -> received bytes
+                    # are the submitted bytes, reuse the checksum
+                    if chaos_forgery and i == 0:
+                        leaf_digest = row_digest(flat, salt=i * 0x9E3779B1)
+                    recv_j = recv_j + leaf_digest
+                    flat = jnp.where(rejected, jnp.nan, flat)
+                out_leaves[i].append(flat.reshape(g[j].shape).astype(g.dtype))
+            sent = sent.at[j].set(sent_j)
+            recv = recv.at[j].set(recv_j)
+            forged_flags.append(forged_flag)
+            rejected_flags.append(rejected)
+        g_leaves = [jnp.stack(rows) for rows in out_leaves]
+        if not self.secure:
+            return g_leaves, None
+        return g_leaves, {
+            "digest_sent": sent,
+            "digest_recv": recv,
+            "forged": jnp.stack(forged_flags),
+            "rejected": jnp.stack(rejected_flags),
+        }
 
     def _leaf_buckets(self, g, spec):
         """Reshape a locally worker-stacked (k, ...) leaf to (k, n_buckets,
@@ -511,6 +607,13 @@ class ShardedRobustEngine:
                 if self.carries_gradients:
                     new_carry = jax.tree_util.tree_unflatten(treedef, post_leaves)
 
+            # (3b) submission forgery + authentication digests (secure/):
+            # impersonated/tampered submissions, sender/receiver checksums
+            # over every leaf shard, reject-to-NaN under ``secure``
+            g_leaves, secure_local = self._submission_pipeline(
+                g_leaves, key, gidx, ridx
+            )
+
             # (4/5) per-bucket robust aggregation over the worker axis
             all_rows = []
             for i, (g, s) in enumerate(zip(g_leaves, s_leaves)):
@@ -680,6 +783,23 @@ class ShardedRobustEngine:
             }
             if probe_fields is not None:
                 metrics[health.PROBE_KEY] = probe_fields
+            if secure_local is not None:
+                # complete each worker's lane sums over its in-group shards
+                # (uint32 psum wraps mod 2^32 — the checksum's own domain),
+                # then gather worker-major like the probe's NaN flags
+                def complete(local, summed):
+                    value = (
+                        jax.lax.psum(local, _IN_GROUP_AXES) if summed else local
+                    )
+                    gathered = jax.lax.all_gather(value, worker_axis)
+                    return gathered.reshape((self.nb_workers,) + value.shape[1:])
+
+                metrics["secure"] = {
+                    "digest_sent": complete(secure_local["digest_sent"], True),
+                    "digest_recv": complete(secure_local["digest_recv"], True),
+                    "forged": complete(secure_local["forged"], False),
+                    "rejected": complete(secure_local["rejected"], False),
+                }
             if ridx is not None:
                 metrics["chaos_regime"] = ridx  # replicated function of step
             if self.worker_metrics:

@@ -1,0 +1,29 @@
+"""Submission-integrity layer: the train -> sign -> serve chain of custody.
+
+The reference's defining systems contribution beyond the GARs was its
+hardened transport: every worker->PS tensor push is signed and verified
+before reassembly, and transport failures degrade into values the rules
+already absorb (mpi_rendezvous_mgr.patch:585-627, SURVEY L1).  This package
+is that layer for the SPMD engines, in three pieces (docs/security.md):
+
+- ``submit``   per-(worker, step) HMAC authentication of gradient
+  submissions: in-graph row digests, host-side sign/verify around the
+  jitted step (zero added recompiles), reject-and-name through the
+  forensics ledger;
+- ``masking``  optional Bonawitz-style pairwise additive masking, cancelled
+  EXACTLY (mod 2^64) inside bucket/hier group means so individual rows stay
+  hidden while group means are unchanged;
+- ``custody``  signed lineage manifests beside every checkpoint, verified
+  by the training auto-restore, the guardian rollback and the serving
+  restore paths — closing the train -> sign -> serve chain.
+"""
+
+from .custody import ChainOfCustody, manifest_path  # noqa: F401
+from .masking import GroupMasking, enable_masking, masked_group_mean  # noqa: F401
+from .submit import (  # noqa: F401
+    DIGEST_LANES,
+    SubmissionAuthenticator,
+    digest_to_bytes,
+    row_digest,
+    tamper_row,
+)

@@ -1,0 +1,207 @@
+"""Chain of custody: signed lineage manifests beside every checkpoint.
+
+The HMAC tag (``obs/checkpoint.py``) proves a snapshot's BYTES are intact;
+it says nothing about where they came from.  The custody manifest carries
+the lineage — run id, step, GAR spec, experiment + data digest, and the
+submission **tag chain** head (``secure/submit.py``) covering every
+verified gradient that flowed into the state — and is itself HMAC-signed
+under a dedicated ``b"custody"`` key family from the session secret.
+
+Writers: the training run (``Checkpoints(custody=...)`` writes a manifest
+in the same atomic dance as the ``.tag`` sidecar).  Verifiers: the training
+auto-restore, the guardian rollback restore, and ``serve/``'s replica
+loading — the full train -> sign -> serve chain.  Verification is
+fail-closed: a missing manifest refuses the restore unless the caller
+explicitly opted out (``allow_unsigned=True`` — serve's ``--allow-unsigned``
+flag), because an attacker with file access could otherwise simply delete
+the manifest.
+
+Schema ``aggregathor.secure.custody.v1``::
+
+    {"schema": ..., "run_id": ..., "step": N, "experiment": ...,
+     "gar": "<spec>", "data_digest": "<sha256 hex of the experiment's
+     training arrays, or of the config identity when the data is not
+     host-addressable>", "snapshot_digest": "<sha256 hex of the on-disk
+     snapshot bytes (post-encryption: digest-then-sign what disk holds)>",
+     "tag_chain": {"head": hex, "steps": N, "nb_workers": n} | null,
+     "created_at": ..., "signature": "<HMAC-SHA256 hex over the canonical
+     JSON of every other field, step-bound>"}
+"""
+
+import hashlib
+import json
+import os
+import time
+
+from ..parallel.auth import GradientAuthenticator
+from ..utils import UserException, warning
+
+SCHEMA = "aggregathor.secure.custody.v1"
+
+
+def manifest_path(ckpt_path):
+    """The lineage manifest sitting beside a snapshot file."""
+    return str(ckpt_path) + ".manifest.json"
+
+
+def data_digest_for(experiment, fallback_identity):
+    """SHA-256 over the experiment's host-addressable training arrays
+    (leaves in sorted key order), or over the config identity string when
+    the data never materializes on host (streaming corpora, host
+    transforms).  The digest pins WHICH data trained the snapshot."""
+    import numpy as np
+
+    arrays = None
+    try:
+        arrays = experiment.train_arrays()
+    except Exception:
+        arrays = None
+    digest = hashlib.sha256()
+    if arrays is not None:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(arrays)
+        digest.update(repr(treedef).encode())
+        for leaf in leaves:
+            host = np.ascontiguousarray(np.asarray(leaf))
+            digest.update(str(host.dtype).encode() + repr(host.shape).encode())
+            digest.update(host.tobytes())
+    else:
+        digest.update(b"config-identity:" + str(fallback_identity).encode())
+    return digest.hexdigest()
+
+
+class ChainOfCustody:
+    """Writes and verifies signed lineage manifests.
+
+    One instance serves both roles: the trainer constructs it with the run's
+    lineage fields and hands it to ``Checkpoints(custody=...)``; a verifier
+    (serve, or a restoring trainer) needs only the session secret (and its
+    ``allow_unsigned`` policy).  ``submission`` is the optional
+    :class:`~aggregathor_tpu.secure.submit.SubmissionAuthenticator` whose
+    live tag chain each manifest snapshots.
+    """
+
+    def __init__(self, session_secret, run_id=None, experiment=None,
+                 gar_spec=None, data_digest=None, submission=None,
+                 allow_unsigned=False):
+        self.auth = GradientAuthenticator(session_secret, 1, context=b"custody")
+        self.run_id = run_id
+        self.experiment = experiment
+        self.gar_spec = gar_spec  # updated by the runner on guardian escalation
+        self.data_digest = data_digest
+        self.submission = submission
+        self.allow_unsigned = bool(allow_unsigned)
+        #: verification tallies (serve's /healthz custody_verified reads them)
+        self.verified = 0
+        self.unsigned = 0
+        self.last_manifest = None
+
+    # ------------------------------------------------------------------ #
+    # write side
+
+    def lineage(self, step):
+        """Snapshot the mutable lineage state for ``step`` — called on the
+        SAVE caller's thread, so a background checkpoint writer signs the
+        chain head as of the save, not of some later step."""
+        return {
+            "schema": SCHEMA,
+            "run_id": self.run_id,
+            "step": int(step),
+            "experiment": self.experiment,
+            "gar": self.gar_spec,
+            "data_digest": self.data_digest,
+            "tag_chain": (
+                self.submission.chain() if self.submission is not None else None
+            ),
+            "created_at": time.time(),
+        }
+
+    @staticmethod
+    def _canonical(payload):
+        return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+
+    def write(self, ckpt_path, step, data, payload=None):
+        """Write the signed manifest beside ``ckpt_path``.  ``data`` is the
+        snapshot's final on-disk bytes (post-encryption: the digest covers
+        exactly what a verifier will read back).  Atomic like the snapshot
+        itself."""
+        payload = dict(payload if payload is not None else self.lineage(step))
+        payload["snapshot_digest"] = hashlib.sha256(bytes(data)).hexdigest()
+        signature = self.auth.sign(0, int(step), self._canonical(payload))
+        payload["signature"] = signature.hex()
+        path = manifest_path(ckpt_path)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fd:
+            json.dump(payload, fd, sort_keys=True, indent=1)
+            fd.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    # ------------------------------------------------------------------ #
+    # verify side
+
+    def verify(self, ckpt_path, step, data):
+        """Verify provenance of a snapshot about to be loaded.
+
+        Fail-closed ``UserException`` on a missing manifest (unless
+        ``allow_unsigned``), a bad signature, a step mismatch, or snapshot
+        bytes that do not match the signed digest.  Returns True when the
+        chain verified, False when an unsigned snapshot was explicitly
+        allowed through.
+        """
+        path = manifest_path(ckpt_path)
+        try:
+            with open(path) as fd:
+                doc = json.load(fd)
+        except OSError:
+            if self.allow_unsigned:
+                warning(
+                    "Checkpoint %r has NO custody manifest — loading it "
+                    "anyway (--allow-unsigned): provenance is unverified"
+                    % (str(ckpt_path),)
+                )
+                self.unsigned += 1
+                return False
+            raise UserException(
+                "Checkpoint %r has no custody manifest: it was saved without "
+                "--secure (or the manifest was deleted). Refusing to load an "
+                "unsigned checkpoint; pass --allow-unsigned to opt out, or "
+                "re-save it from a --secure run" % (str(ckpt_path),)
+            )
+        if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+            raise UserException(
+                "Custody manifest %r is not a %s document" % (path, SCHEMA)
+            )
+        signature = doc.pop("signature", "")
+        try:
+            tag = bytes.fromhex(signature)
+        except ValueError:
+            tag = b""
+        if not self.auth.verify(0, int(step), self._canonical(doc), tag):
+            raise UserException(
+                "Custody manifest %r failed signature verification: forged, "
+                "tampered, or a --session-secret mismatch; treat the "
+                "checkpoint as untrusted" % (path,)
+            )
+        if int(doc.get("step", -1)) != int(step):
+            raise UserException(
+                "Custody manifest %r signs step %r but snapshot step %d was "
+                "restored — a manifest copied between snapshots"
+                % (path, doc.get("step"), int(step))
+            )
+        actual = hashlib.sha256(bytes(data)).hexdigest()
+        if actual != doc.get("snapshot_digest"):
+            raise UserException(
+                "Checkpoint %r does not match its signed custody manifest "
+                "(snapshot digest mismatch): the snapshot was swapped or "
+                "corrupted after signing" % (str(ckpt_path),)
+            )
+        self.verified += 1
+        self.last_manifest = dict(doc)
+        return True
+
+    @property
+    def all_verified(self):
+        """True iff every restore so far verified (and at least one did)."""
+        return self.verified > 0 and self.unsigned == 0

@@ -1,0 +1,238 @@
+"""Bucket-level pairwise additive masking (Bonawitz et al. 2017 style).
+
+Secure aggregation hides individual submissions from the aggregator by
+having workers add pairwise masks that cancel in the SUM.  Robust rules
+break that story — they need per-row structure, not just the sum — so
+masking here composes with the meta-GARs instead (NET-SA, arXiv:2501.01187:
+secure aggregation as an architecture concern): masks are exchanged only
+*within* a bucket (``bucketing``) or hier group whose inner reduction is a
+mean, and cancel inside that group mean.  The aggregator's selection rule
+then operates on group means exactly as before, while any individual row it
+could inspect is one-time-padded.  The privacy unit is the group: what
+leaks per group is its mean (s-anonymity in the Bonawitz sense), which is
+precisely the quantity the meta-GAR consumes anyway.
+
+**Exact cancellation.**  Additive masks in float arithmetic cannot cancel
+bitwise (float addition is not associative), so — like every real secure-
+aggregation protocol — the masked mean runs in modular integer arithmetic:
+each coordinate is encoded as a signed 64-bit fixed-point value (32
+fraction bits, emulated as two uint32 limbs so no x64 mode is needed),
+member ``j`` of a group of ``s`` adds the chain mask ``m_j - m_{(j+1) mod
+s}`` (each ``m`` a fresh uniform draw mod 2^64 — a one-time pad per
+coordinate, shared by the adjacent pair), and the group sum is taken mod
+2^64 where the masks cancel EXACTLY.  The decoded mean is therefore
+bit-identical between a masked run and the same run with masks disabled
+(``GroupMasking(enabled=False)`` — the "unmasked" baseline with the same
+deterministic arithmetic; a plain ``jnp.mean`` differs in low bits because
+float summation rounds differently, which is exactly why the masked path
+needs its own arithmetic).  Encoding quantizes at 2^-32 absolute — orders
+of magnitude below float32 noise at gradient scale; coordinates beyond
++/-2^31 wrap into garbage, which the OUTER rule treats as one more outlier
+group.
+
+**Drop-out semantics.**  A worker whose row drops mid-step (lossy NaN, dead
+straggler, rejected forgery) leaves its pairwise masks uncancelled — the
+real protocol cannot unmask that group sum without a recovery round, so the
+whole group's mean reads NaN here and the NaN-tolerant outer rule absorbs
+it: one dropped worker costs its group, composing with the ragged-bucket
+machinery (the padded bucket was already always-NaN).
+
+**Key flow.**  Pairwise mask seeds derive from the session secret
+(:meth:`GroupMasking.from_secret` — material the aggregator role would not
+hold in a real deployment) folded with a per-step salt drawn from the
+replicated step key, so masks redraw every step and follow the bucketing
+permutation (all parties can compute the permutation: its key is the
+replicated step key, the Bonawitz key-agreement round collapsed by the
+simulation).  Under a sharded ``axis_name`` the device's axis index folds
+in too, so column blocks on different devices never reuse pad material.
+"""
+
+import hashlib
+
+from ..utils import UserException
+
+#: fold tag deriving the mask stream from the rule's per-step key — disjoint
+#: from bucketing's permutation (raw key), inner (fold 1) and outer (fold 2)
+MASK_KEY_TAG = 7
+
+#: fixed-point fraction bits of the masked-mean integer domain
+FRACTION_BITS = 32
+
+
+class GroupMasking:
+    """Masking configuration carried by a mean-inner meta-GAR instance.
+
+    ``enabled=False`` keeps the exact fixed-point group-mean arithmetic but
+    adds no masks — the bit-identity baseline the tests and the smoke
+    compare a masked run against ("unmasked run", same deterministic path).
+    """
+
+    def __init__(self, base_key, enabled=True):
+        self.base_key = base_key
+        self.enabled = bool(enabled)
+
+    @classmethod
+    def from_secret(cls, session_secret, enabled=True):
+        """Derive the pairwise-mask key material from the session secret
+        (domain-separated from every HMAC family)."""
+        import jax
+
+        seed = int.from_bytes(
+            hashlib.sha256(b"pairwise-mask:" + bytes(session_secret)).digest()[:4],
+            "little",
+        )
+        return cls(jax.random.PRNGKey(seed), enabled=enabled)
+
+
+# --------------------------------------------------------------------- #
+# two-limb (uint32 hi/lo) arithmetic mod 2^64 — exact, no x64 mode needed
+
+
+def _neg64(hi, lo):
+    import jax.numpy as jnp
+
+    nlo = (~lo) + jnp.uint32(1)
+    nhi = (~hi) + (nlo == 0).astype(jnp.uint32)
+    return nhi, nlo
+
+
+def _add64(ah, al, bh, bl):
+    import jax.numpy as jnp
+
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _sub64(ah, al, bh, bl):
+    nh, nl = _neg64(bh, bl)
+    return _add64(ah, al, nh, nl)
+
+
+def _encode64(x):
+    """float32 -> signed 64-bit fixed point (FRACTION_BITS), two uint32
+    limbs.  Exact integer/fraction split (Sterbenz: ``x - floor(x)`` is
+    exact in IEEE); the fraction truncates to the 2^-32 grid.  Inputs must
+    be finite (callers zero non-finite values and flag the row)."""
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.float32)
+    ax = jnp.abs(x)
+    hi_f = jnp.floor(ax)
+    frac = ax - hi_f
+    hi = hi_f.astype(jnp.uint32)
+    lo = (frac * jnp.float32(2.0 ** 32)).astype(jnp.uint32)
+    nhi, nlo = _neg64(hi, lo)
+    neg = x < 0
+    return jnp.where(neg, nhi, hi), jnp.where(neg, nlo, lo)
+
+
+def _decode64(hi, lo):
+    """Signed 64-bit fixed point -> float32 (one deterministic rounding)."""
+    import jax.numpy as jnp
+
+    neg = hi >= jnp.uint32(0x80000000)
+    mh, ml = _neg64(hi, lo)
+    mag_hi = jnp.where(neg, mh, hi)
+    mag_lo = jnp.where(neg, ml, lo)
+    mag = (
+        mag_hi.astype(jnp.float32) * jnp.float32(2.0 ** 32)
+        + mag_lo.astype(jnp.float32)
+    )
+    return jnp.where(neg, -mag, mag) * jnp.float32(2.0 ** -FRACTION_BITS)
+
+
+# --------------------------------------------------------------------- #
+
+
+def masked_group_mean(grouped, key, masking, axis_name=None):
+    """(G, s, d) grouped rows -> (G, d) float32 group means with pairwise
+    masks cancelled exactly (mod 2^64); any non-finite row NaNs its whole
+    group (the uncancelled-mask story, module docstring).
+
+    ``key`` is the rule's replicated per-step PRNG key (required: masks must
+    redraw every step); ``axis_name`` folds the device's axis index into the
+    pad stream under sharded execution.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if key is None:
+        raise UserException(
+            "bucket-level masking needs the per-step PRNG key (both engines "
+            "pass it; the keyless dense/oracle tier cannot run masked)"
+        )
+    nb_groups, group_size, dim = grouped.shape
+    x = grouped.astype(jnp.float32)
+    group_ok = jnp.all(jnp.isfinite(x), axis=(1, 2))
+    hi, lo = _encode64(jnp.where(jnp.isfinite(x), x, 0.0))
+    if masking.enabled:
+        salt = jax.random.bits(
+            jax.random.fold_in(key, MASK_KEY_TAG), (), jnp.uint32
+        )
+        pad_key = jax.random.fold_in(masking.base_key, salt)
+        if axis_name is not None:
+            pad_key = jax.random.fold_in(pad_key, jax.lax.axis_index(axis_name))
+        mask_hi = jax.random.bits(
+            jax.random.fold_in(pad_key, 0), (nb_groups, group_size, dim), jnp.uint32
+        )
+        mask_lo = jax.random.bits(
+            jax.random.fold_in(pad_key, 1), (nb_groups, group_size, dim), jnp.uint32
+        )
+        # chain topology: member j holds pad m_j with its successor — adds
+        # m_j, subtracts m_{(j+1) mod s}; the per-group telescoping sum is
+        # ZERO mod 2^64 by construction, any single row is one-time-padded
+        rh, rl = _sub64(
+            mask_hi, mask_lo,
+            jnp.roll(mask_hi, -1, axis=1), jnp.roll(mask_lo, -1, axis=1),
+        )
+        hi, lo = _add64(hi, lo, rh, rl)
+    acc_hi = jnp.zeros((nb_groups, dim), jnp.uint32)
+    acc_lo = jnp.zeros((nb_groups, dim), jnp.uint32)
+    for member in range(group_size):  # static, small s
+        acc_hi, acc_lo = _add64(acc_hi, acc_lo, hi[:, member], lo[:, member])
+    mean = _decode64(acc_hi, acc_lo) / jnp.float32(group_size)
+    return jnp.where(group_ok[:, None], mean, jnp.nan)
+
+
+def enable_masking(gar, masking):
+    """Attach ``masking`` to a meta-GAR instance, validating at parse time
+    that the spec CAN cancel masks: the group reduction must be a mean.
+
+    Accepted: ``bucketing`` (its bucket reduction IS a mean, any inner rule
+    over the bucket means) and ``hier`` with ``inner=average``.  Everything
+    else is rejected here — before any compilation — because a non-mean
+    group reduction would see one-time-padded garbage rows.  Group size
+    must be >= 2 (a group of one hides nothing).  Returns ``gar``.
+    """
+    from ..gars.average import AverageGAR
+    from ..gars.bucketing import BucketingGAR
+    from ..gars.hierarchical import HierarchicalGAR
+
+    if isinstance(gar, BucketingGAR):
+        if gar.s < 2:
+            raise UserException(
+                "masking over buckets of s=%d hides nothing (each row IS its "
+                "bucket mean); use s >= 2" % gar.s
+            )
+    elif isinstance(gar, HierarchicalGAR):
+        if not isinstance(gar.inner, AverageGAR):
+            raise UserException(
+                "bucket-level masking cancels only inside a MEAN group "
+                "reduction: hier needs inner=average (got inner=%s); "
+                "bucketing works with any inner rule (its buckets are means)"
+                % type(gar.inner).__name__
+            )
+        if gar.g < 2:
+            raise UserException(
+                "masking over hier groups of g=%d hides nothing; use g >= 2"
+                % gar.g
+            )
+    else:
+        raise UserException(
+            "bucket-level masking needs a mean-inner meta-GAR spec — "
+            "'bucketing:s=...,inner=...' or 'hier:g=...,inner=average,"
+            "outer=...' — got %s" % type(gar).__name__
+        )
+    gar.masking = masking
+    return gar

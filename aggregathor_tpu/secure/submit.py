@@ -1,0 +1,227 @@
+"""Authenticated gradient submission (the per-step layer of ``secure/``).
+
+The reference signs every worker->PS tensor push with a per-worker key and
+the PS verifies before reassembly (mpi_rendezvous_mgr.patch:585-627); a
+failed signature drops the push, which the NaN-row conventions absorb.  The
+TPU-native mapping splits that protocol across the host/device boundary:
+
+- **In graph** (both engines): each worker's flattened post-transport row is
+  reduced to a tiny position-sensitive checksum (:func:`row_digest`, a few
+  multiply-shift lanes over the float32 bit patterns — one O(d) pass per
+  worker, part of the ONE compiled step, zero added dispatches or
+  recompiles).  Rows whose tags cannot verify (``forge``: the submitter
+  never held the session secret; ``tamper``: bytes flipped after signing)
+  are masked NaN *before stacking*, so the GARs absorb the rejection within
+  the same f budget as a lossy row — and the digests, the coalition mask
+  and the rejection verdict ride the step metrics to the host.
+
+- **On host** (:class:`SubmissionAuthenticator`, driven by the runner one
+  dispatch behind, exactly like the forensics feed): each worker's digest
+  bytes are HMAC-tagged under its per-(worker, step) key derived from the
+  session secret (``parallel/auth.py`` ``derive_worker_key`` — one
+  derivation pass at construction, ``sign_many``/``verify_many`` over the
+  whole stack per step), every tag is verified, failures are counted and
+  handed to the forensics ledger as named ``forgery`` evidence
+  (reject-and-name, never a silent drop), and the verified tags extend a
+  rolling **tag chain** whose head the custody manifest signs — the
+  train->sign->serve lineage (``secure/custody.py``).
+
+What the HMAC buys — and does not — is spelled out in docs/security.md: it
+stops impersonation and in-flight tampering; it does NOT stop a Byzantine
+worker that signs its own poison honestly (that is the GARs' job).
+"""
+
+import hashlib
+import struct
+import time
+
+import numpy as np
+
+from ..parallel.auth import GradientAuthenticator
+
+#: uint32 checksum lanes per row digest (16 bytes of tag material)
+DIGEST_LANES = 4
+
+#: per-lane odd multipliers of the multiply-shift family (position-weighted
+#: modular sums: permuting or editing coordinates moves every lane)
+_LANE_MULT = (0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F, 0x9E3779B1)
+_LANE_ADD = (0x165667B1, 0x5BD1E995, 0x2545F491, 0x61C88647)
+
+#: what a forger without the session secret signs with — ANY key material
+#: other than the real secret behaves identically (the tag cannot verify)
+FORGER_SECRET = b"forger-without-the-session-secret"
+
+#: scale of a forged (impersonated) submission's noise content — what an
+#: UNDEFENDED run accepts into aggregation when the chaos ``forge`` regime
+#: fires without ``--secure``
+FORGE_SCALE = 8.0
+
+
+def row_digest(row, salt=0):
+    """(d,) float32 row -> (DIGEST_LANES,) uint32 checksum, in graph.
+
+    Position-weighted modular sums over the row's float32 bit patterns:
+    lane L = sum_c bits(row[c]) * (A_L * (c + salt) + B_L)  mod 2^32.
+    Cheap (one fused pass), deterministic, order- and value-sensitive — the
+    simulation's stand-in for hashing the row bytes the reference's
+    transport signs.  NOT a cryptographic hash: collision resistance comes
+    from the HMAC over the digest, unforgeability from the per-worker key
+    (an attacker without the key gains nothing from digest collisions it
+    cannot sign).  ``salt`` offsets the position stream (the sharded engine
+    folds a per-leaf constant so leaves do not alias).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bits = jax.lax.bitcast_convert_type(row.astype(jnp.float32), jnp.uint32)
+    idx = jnp.arange(bits.shape[-1], dtype=jnp.uint32) + jnp.uint32(
+        int(salt) & 0xFFFFFFFF
+    )
+    lanes = [
+        jnp.sum(bits * (idx * jnp.uint32(mult) + jnp.uint32(add)),
+                dtype=jnp.uint32)
+        for mult, add in zip(_LANE_MULT, _LANE_ADD)
+    ]
+    return jnp.stack(lanes)
+
+
+def tamper_row(row, key):
+    """In-transit bit corruption (the chaos ``tamper`` mode): flip the
+    lowest EXPONENT bit of one PRNG-chosen coordinate — the value doubles
+    or halves, a corruption subtle enough to slip under distance-outlier
+    thresholds (exactly the class statistical robustness cannot see and
+    cryptographic integrity catches)."""
+    import jax
+    import jax.numpy as jnp
+
+    bits = jax.lax.bitcast_convert_type(row.astype(jnp.float32), jnp.uint32)
+    coord = jax.random.randint(key, (), 0, bits.shape[-1])
+    flipped = bits.at[coord].set(bits[coord] ^ jnp.uint32(1 << 23))
+    return jax.lax.bitcast_convert_type(flipped, jnp.float32)
+
+
+def digest_to_bytes(digest):
+    """One host-side digest row ((DIGEST_LANES,) uint32) -> the 16 bytes the
+    HMAC signs (little-endian, fixed layout on every platform)."""
+    return np.ascontiguousarray(np.asarray(digest, dtype="<u4")).tobytes()
+
+
+class SubmissionAuthenticator:
+    """Host-side sign/verify of per-step submission digests.
+
+    One instance per run (the aggregator role): per-worker keys derive once
+    from the session secret under the ``b"submit"`` context (disjoint from
+    the checkpoint/handshake/custody families), and each completed step's
+    (n, DIGEST_LANES) digest stacks are signed and verified through the
+    vectorized ``sign_many``/``verify_many`` fast path.
+
+    The **forge simulation**: workers flagged in ``forged`` sign under
+    :data:`FORGER_SECRET`-derived keys — the behavior of an impersonator
+    that never held the session secret — so their tags cannot verify.  A
+    *tampered* submission signs under the real key but over the pre-tamper
+    digest, so verification against the received digest fails identically.
+
+    Every verified step extends ``chain()``: head' = SHA-256(head || step ||
+    tags || verdicts), the tag chain the custody manifest signs.
+
+    Cost is measured, not presumed: ``secure_sign_seconds_total`` /
+    ``secure_verify_seconds_total`` accumulate the wall time, and
+    ``secure_forgeries_total{worker=...}`` names every rejected submission
+    on the PR-4 metrics registry.
+    """
+
+    def __init__(self, session_secret, nb_workers, registry=None):
+        self.nb_workers = int(nb_workers)
+        self.auth = GradientAuthenticator(
+            session_secret, self.nb_workers, context=b"submit"
+        )
+        self._forger = GradientAuthenticator(
+            FORGER_SECRET, self.nb_workers, context=b"submit"
+        )
+        self._chain = hashlib.sha256(b"aggregathor-tag-chain-v1").digest()
+        self._chain_steps = 0
+        self._c_sign = self._c_verify = None
+        self._c_submissions = self._c_forgeries = None
+        if registry is not None:
+            self._c_sign = registry.counter(
+                "secure_sign_seconds_total",
+                "Cumulative submission-tag signing wall time",
+            )
+            self._c_verify = registry.counter(
+                "secure_verify_seconds_total",
+                "Cumulative submission-tag verification wall time",
+            )
+            self._c_submissions = registry.counter(
+                "secure_submissions_total", "Worker submissions processed"
+            )
+            self._c_forgeries = registry.counter(
+                "secure_forgeries_total",
+                "Submissions whose tag failed verification",
+                labelnames=("worker",),
+            )
+
+    # ------------------------------------------------------------------ #
+
+    def sign_step(self, step, sent_digests, forged=None):
+        """Tag one step's (n, DIGEST_LANES) submitted digests.
+
+        ``forged`` is an optional (n,) bool mask of workers signing WITHOUT
+        the session secret (the chaos ``forge`` coalition).  Returns the
+        (n, 32) uint8 tag stack.
+        """
+        sent = np.ascontiguousarray(np.asarray(sent_digests, dtype="<u4"))
+        if sent.shape[0] != self.nb_workers:
+            raise ValueError(
+                "sign_step got %d digest rows for %d workers"
+                % (sent.shape[0], self.nb_workers)
+            )
+        begin = time.perf_counter()
+        tags = self.auth.sign_many(step, sent)
+        if forged is not None:
+            for worker in np.nonzero(np.asarray(forged).astype(bool))[0]:
+                tags[worker] = np.frombuffer(
+                    self._forger.sign(
+                        int(worker), step, digest_to_bytes(sent[worker])
+                    ),
+                    np.uint8,
+                )
+        elapsed = time.perf_counter() - begin
+        if self._c_sign is not None:
+            self._c_sign.inc(elapsed)
+            self._c_submissions.inc(self.nb_workers)
+        return tags
+
+    def verify_step(self, step, recv_digests, tags):
+        """Verify one step's tags against the RECEIVED digests.
+
+        Returns the (n,) bool verdict (True = tag verifies) and extends the
+        tag chain.  Failures land on ``secure_forgeries_total``.
+        """
+        recv = np.ascontiguousarray(np.asarray(recv_digests, dtype="<u4"))
+        begin = time.perf_counter()
+        ok = self.auth.verify_many(step, recv, tags)
+        elapsed = time.perf_counter() - begin
+        if self._c_verify is not None:
+            self._c_verify.inc(elapsed)
+            for worker in np.nonzero(~ok)[0]:
+                self._c_forgeries.labels(worker=str(int(worker))).inc()
+        self._chain = hashlib.sha256(
+            self._chain + struct.pack("<q", int(step))
+            + np.ascontiguousarray(tags).tobytes() + ok.tobytes()
+        ).digest()
+        self._chain_steps += 1
+        return ok
+
+    def process_step(self, step, sent_digests, recv_digests, forged=None):
+        """Sign-then-verify one completed step (the runner's per-step feed).
+        Returns the (n,) bool verdict."""
+        tags = self.sign_step(step, sent_digests, forged=forged)
+        return self.verify_step(step, recv_digests, tags)
+
+    def chain(self):
+        """The current tag-chain lineage (what the custody manifest signs)."""
+        return {
+            "head": self._chain.hex(),
+            "steps": self._chain_steps,
+            "nb_workers": self.nb_workers,
+        }

@@ -82,7 +82,7 @@ def choose_bucket(nb_rows, buckets):
 
 def restore_params(experiment, directory, tx, step=None, seed=0,
                    base_name=None, authenticator=None, cipher=None,
-                   allow_legacy_tags=True):
+                   allow_legacy_tags=True, custody=None):
     """Restore a trained checkpoint's parameters for serving.
 
     Deserializes into a freshly-initialized host-side :class:`TrainState`
@@ -92,7 +92,10 @@ def restore_params(experiment, directory, tx, step=None, seed=0,
     optimizer state, and a mismatched treedef fails at deserialization
     instead of silently seeding garbage.  ``authenticator``/``cipher`` honor
     the training-side checkpoint authentication and at-rest encryption
-    (``obs/checkpoint.py``).
+    (``obs/checkpoint.py``); ``custody`` (a
+    ``secure.custody.ChainOfCustody``) additionally verifies the signed
+    lineage manifest before loading — the serving end of the
+    train -> sign -> serve chain (docs/security.md).
     """
     from .. import config
     from ..core.train_state import TrainState
@@ -108,6 +111,7 @@ def restore_params(experiment, directory, tx, step=None, seed=0,
         authenticator=authenticator,
         cipher=cipher,
         allow_legacy_tags=allow_legacy_tags,
+        custody=custody,
     )
     state, at_step = checkpoints.restore(template, step=step)
     return state.params, at_step
@@ -187,6 +191,38 @@ class InferenceEngine:
             return jnp.argmax(voted, axis=-1), voted, disagreement
 
         self._fn = jax.jit(forward, donate_argnums=(1,))
+
+    def swap_replicas(self, replicas):
+        """Hot weight swap: replace the replica parameter stack in place.
+
+        The new replicas must match the serving topology (same count, same
+        treedef, same leaf shapes/dtypes) so every already-compiled bucket
+        executable keeps serving — a swap costs one host->device transfer
+        and ZERO recompiles.  The stacked-pytree assignment is an atomic
+        reference swap: an in-flight forward finishes on the old stack, the
+        next dispatch reads the new one.  Used by the serve CLI's hot
+        restore (SIGHUP) after custody verification (docs/security.md).
+        """
+        if len(replicas) != self.nb_replicas:
+            raise UserException(
+                "swap_replicas got %d replica(s) for a %d-replica engine "
+                "(the vote rule and compiled forwards are sized R=%d)"
+                % (len(replicas), self.nb_replicas, self.nb_replicas)
+            )
+        fresh = jax.device_put(jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *replicas
+        ))
+        old = jax.tree_util.tree_leaves(self._params)
+        new = jax.tree_util.tree_leaves(fresh)
+        if len(old) != len(new) or any(
+            (a.shape, a.dtype) != (b.shape, b.dtype) for a, b in zip(old, new)
+        ):
+            raise UserException(
+                "swap_replicas: the new checkpoints do not match the serving "
+                "topology (leaf shape/dtype mismatch) — restart to change it"
+            )
+        self._params = fresh
+        return self.compile_count
 
     @property
     def compile_count(self):

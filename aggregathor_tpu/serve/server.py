@@ -168,11 +168,18 @@ class InferenceServer(ThreadingHTTPServer):
 
     def __init__(self, engine, host="127.0.0.1", port=0, max_latency_s=0.010,
                  queue_bound=256, summaries=None, request_timeout_s=60.0,
-                 flag_threshold=None, clock=None, registry=None):
+                 flag_threshold=None, clock=None, registry=None,
+                 custody_verified=None):
         import time
 
         super().__init__((host, int(port)), _Handler)
         self.engine = engine
+        # Chain-of-custody verdict of the served checkpoints (cli/serve.py):
+        # True = every replica's lineage manifest verified, False = at least
+        # one unsigned/unverified restore was explicitly allowed through,
+        # None = no --session-secret (verification not attempted).  Updated
+        # on hot restore (set_custody_verified), surfaced by /healthz.
+        self.custody_verified = custody_verified
         self.clock = clock if clock is not None else time.monotonic
         self.summaries = summaries
         self.request_timeout_s = float(request_timeout_s)
@@ -310,6 +317,10 @@ class InferenceServer(ThreadingHTTPServer):
                 suspects.append(index)
         return suspects
 
+    def set_custody_verified(self, verdict):
+        """Update the provenance verdict after a hot restore."""
+        self.custody_verified = verdict
+
     def health_payload(self):
         return {
             "status": "ok",
@@ -317,6 +328,7 @@ class InferenceServer(ThreadingHTTPServer):
             "vote": type(self.engine.gar).__name__ if self.engine.gar else None,
             "buckets": list(self.engine.buckets),
             "suspect_replicas": self.suspect_replicas(),
+            "custody_verified": self.custody_verified,
         }
 
     def metrics_payload(self):

@@ -96,6 +96,109 @@ def test_backends_interoperate(monkeypatch):
     assert a_py.verify(1, 3, b"blob", tag)
 
 
+def test_sign_many_verify_many_bit_compatible(backend):
+    """The vectorized hot path (secure/submit.py rides it every step) mints
+    byte-identical tags to the single-row API and verifies row by row."""
+    auth = GradientAuthenticator(b"session-secret", nb_workers=4)
+    rows = np.arange(4 * 8, dtype="<u4").reshape(4, 8)
+    tags = auth.sign_many(11, rows)
+    assert tags.shape == (4, 32) and tags.dtype == np.uint8
+    for worker in range(4):
+        assert tags[worker].tobytes() == auth.sign(worker, 11, rows[worker].tobytes())
+        assert auth.verify(worker, 11, rows[worker].tobytes(), tags[worker].tobytes())
+    assert auth.verify_many(11, rows, tags).all()
+    # a single corrupted tag fails exactly its row
+    tags[2, 0] ^= 1
+    assert auth.verify_many(11, rows, tags).tolist() == [True, True, False, True]
+    # step binding holds for the whole stack
+    assert not auth.verify_many(12, rows, auth.sign_many(11, rows)).any()
+    # zero-length rows (empty payload edge) stay bit-compatible
+    empty = np.empty((4, 0), np.uint8)
+    assert auth.sign_many(0, empty)[1].tobytes() == auth.sign(1, 0, b"")
+    # row-count mismatch fails loudly instead of truncating
+    with pytest.raises(ValueError):
+        auth.sign_many(0, rows[:2])
+
+
+def test_is_encrypted_on_truncated_blobs():
+    """``is_encrypted`` must answer, not crash, on blobs shorter than the
+    container tag — the discovery path probes arbitrary on-disk bytes."""
+    from aggregathor_tpu.parallel.crypto import _MAGIC, SnapshotCipher
+
+    for blob in (b"", b"A", _MAGIC[:3], _MAGIC[:-1] + b"X"):
+        assert SnapshotCipher.is_encrypted(blob) is False
+    assert SnapshotCipher.is_encrypted(_MAGIC) is True  # tag alone: encrypted
+    cipher = SnapshotCipher(b"secret")
+    blob = cipher.encrypt(3, b"payload")
+    for cut in (1, 4, 5):
+        assert SnapshotCipher.is_encrypted(blob[:cut]) is (cut >= 5)
+
+
+def test_wrong_step_decrypt_each_direction():
+    """Step binding seasons the keystream: a blob encrypted at step s fails
+    at s±1 and at 0 — in BOTH directions (replaying an old snapshot as a
+    newer step and vice versa)."""
+    from aggregathor_tpu.parallel.crypto import SnapshotCipher
+    from aggregathor_tpu.utils import UserException
+
+    cipher = SnapshotCipher(b"secret")
+    blob = cipher.encrypt(5, b"state bytes")
+    for wrong in (4, 6, 0):
+        with pytest.raises(UserException):
+            cipher.decrypt(wrong, blob)
+    # empty payload keeps the binding too
+    empty = cipher.encrypt(9, b"")
+    with pytest.raises(UserException):
+        cipher.decrypt(8, empty)
+    assert cipher.decrypt(9, empty) == b""
+
+
+def test_encrypt_then_mac_ordering_guarantee(tmp_path):
+    """obs/checkpoint.py's encrypt-then-MAC contract: on a tampered blob the
+    restore dies at TAG verification and never derives a keystream byte —
+    asserted by instrumenting the cipher, not just by the error message."""
+    import flax.struct
+    import jax.numpy as jnp
+
+    from aggregathor_tpu.obs import Checkpoints
+    from aggregathor_tpu.parallel.crypto import SnapshotCipher
+    from aggregathor_tpu.utils import UserException
+
+    @flax.struct.dataclass
+    class S:
+        step: object
+        value: object
+
+    class CountingCipher(SnapshotCipher):
+        decrypt_calls = 0
+
+        def decrypt(self, step, blob):
+            CountingCipher.decrypt_calls += 1
+            return super().decrypt(step, blob)
+
+    auth = GradientAuthenticator(b"secret", 1, context=b"ckpt")
+    cipher = CountingCipher(b"secret")
+    ckpt = Checkpoints(str(tmp_path), authenticator=auth, cipher=cipher)
+    state = S(step=jnp.int32(5), value=jnp.arange(4.0))
+    path = ckpt.save(state)
+
+    # the tag covers exactly the on-disk ciphertext (MAC over ciphertext)
+    with open(path, "rb") as fd:
+        on_disk = fd.read()
+    with open(path + ".tag", "rb") as fd:
+        assert auth.verify(0, 5, on_disk, fd.read())
+
+    with open(path, "r+b") as fd:
+        fd.seek(40)
+        fd.write(b"\xff")
+    CountingCipher.decrypt_calls = 0
+    with pytest.raises(UserException):
+        ckpt.restore(S(step=jnp.int32(0), value=jnp.zeros(4)))
+    assert CountingCipher.decrypt_calls == 0, (
+        "decrypt ran on a tag-rejected blob: MAC-then-decrypt violated"
+    )
+
+
 def test_checkpoint_authentication(tmp_path):
     """Tagged snapshots restore; tampered or untagged ones are rejected."""
     import flax.struct

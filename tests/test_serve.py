@@ -374,8 +374,9 @@ def test_train_checkpoint_serve_round_trip(tmp_path):
         "--poison-replica", "1:nan", "--max-batch", "8",
     ])
     experiment = models.instantiate("digits", ["batch-size:16"])
-    replicas, sources = serve_cli.load_replicas(args, experiment)
+    replicas, sources, custody_verified = serve_cli.load_replicas(args, experiment)
     assert len(replicas) == 3 and "poisoned: nan" in sources[1]
+    assert custody_verified is None  # no --session-secret: not attempted
 
     vote = gars.instantiate("median", 3, 1)
     engine = InferenceEngine(experiment, replicas, gar=vote, max_batch=8)
