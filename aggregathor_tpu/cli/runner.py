@@ -22,6 +22,7 @@ Example::
 """
 
 import argparse
+import contextlib
 import os
 import signal
 import sys
@@ -267,7 +268,70 @@ def build_parser():
         "--metrics-file", default=None, metavar="PATH",
         help="dump the process-wide metrics registry as Prometheus text "
              "exposition here at every summary fire and at exit (the "
-             "training-side counterpart of serve's /metrics endpoint)",
+             "training-side counterpart of serve's /metrics endpoint); the "
+             "final flush runs on normal exit, SIGTERM and divergence alike",
+    )
+    parser.add_argument(
+        "--flight", type=int, default=0, metavar="CAPACITY",
+        help="flight recorder (obs/flight.py, docs/observability.md): carry "
+             "a CAPACITY-row ring of per-step telemetry lanes (loss, update "
+             "norm, probe flags, per-worker distances/NaN rows, chaos "
+             "regime, secure verdicts) as a device-side TrainState buffer "
+             "written INSIDE the jitted scan, fetched once per summary fire "
+             "and dumped post-mortem on rollback/crash; zero added "
+             "recompiles; 0 disables",
+    )
+    parser.add_argument(
+        "--flight-dump", default=None, metavar="JSON",
+        help="write the flight-recorder window here on guardian rollback or "
+             "crash (schema aggregathor.obs.flight.v1) — exact per-step "
+             "evidence for the window that killed the run; rollback dumps "
+             "suffix .rollback-<step> before the extension (requires "
+             "--flight)",
+    )
+    parser.add_argument(
+        "--xprof", default=None, metavar="A:B",
+        help="programmatic jax.profiler device capture over steps [A, B) "
+             "into --trace-dir (obs/profiler.py): dispatches inside the "
+             "window carry StepTraceAnnotations so the host span trace "
+             "joins the device timeline per step; under --unroll the "
+             "window lands on chunk boundaries (mutually exclusive with "
+             "--trace)",
+    )
+    parser.add_argument(
+        "--live-port", type=int, default=None, metavar="PORT",
+        help="serve a live exporter for THIS training run (obs/live.py): "
+             "/metrics (Prometheus text of the one registry), /status "
+             "(step progress, steps/s, the latest flight window, the SLO "
+             "verdict), /healthz; 0 binds an ephemeral port; lead process "
+             "only",
+    )
+    parser.add_argument(
+        "--live-host", default="127.0.0.1", metavar="HOST",
+        help="bind address of the live exporter",
+    )
+    parser.add_argument(
+        "--live-ready-file", default=None, metavar="PATH",
+        help="write 'host port' here once the live exporter is bound (the "
+             "smoke scripts' handshake; requires --live-port)",
+    )
+    parser.add_argument(
+        "--slo-baseline", default=None, metavar="JSON",
+        help="regression sentinel (obs/slo.py): load this baseline document "
+             "(schema aggregathor.obs.slo.v1, seeded via --slo-capture on a "
+             "healthy run) and emit a PASS/REGRESS verdict on steps/s, "
+             "gar_seconds_total and input_overlap_fraction at run end (an "
+             "slo_verdict summary event + info line)",
+    )
+    parser.add_argument(
+        "--slo-verdict", default=None, metavar="JSON",
+        help="also write the sentinel verdict document here (requires "
+             "--slo-baseline)",
+    )
+    parser.add_argument(
+        "--slo-capture", default=None, metavar="JSON",
+        help="capture THIS run's end-state throughput metrics as a fresh "
+             "SLO baseline document here (what --slo-baseline loads)",
     )
     parser.add_argument(
         "--run-id", default=None, metavar="ID",
@@ -403,7 +467,11 @@ def main(argv=None):
         SummaryWriter,
         trace,
     )
+    from ..obs import flight as obs_flight
+    from ..obs import live as obs_live
     from ..obs import metrics as obs_metrics
+    from ..obs import profiler as obs_profiler
+    from ..obs import slo as obs_slo
     from ..obs.summaries import make_run_id
     from ..parallel import RobustEngine, attacks, make_mesh
     from ..parallel.lossy import LossyLink
@@ -418,6 +486,51 @@ def main(argv=None):
             "--secure/--secure-mask derive their per-worker keys and mask "
             "pads from --session-secret; pass it"
         )
+    if args.flight < 0:
+        raise UserException("--flight wants a nonnegative ring capacity")
+    if args.flight_dump and not args.flight:
+        raise UserException("--flight-dump needs --flight CAPACITY")
+    if args.live_ready_file and args.live_port is None:
+        raise UserException("--live-ready-file needs --live-port")
+    if args.slo_verdict and not args.slo_baseline:
+        raise UserException("--slo-verdict needs --slo-baseline")
+    if args.xprof and args.trace:
+        raise UserException(
+            "--xprof and --trace both drive the jax.profiler; pick one"
+        )
+    # Sentinel baseline loads AT STARTUP: a missing/garbled document must
+    # fail before an hour of training, not at the verdict.
+    sentinel = obs_slo.Sentinel(args.slo_baseline) if args.slo_baseline else None
+
+    # Stop handlers install FIRST (satellite: preempted runs must not exit
+    # empty-handed): a SIGTERM during backend init, graph build or the
+    # first compile sets the flag, the loop exits at its next check, and
+    # the shutdown path flushes --metrics-file/forensics/trace like any
+    # normal exit.  The originals are restored at shutdown; a failure
+    # before the train loop leaves this benign flag-setter installed only
+    # while the process unwinds.
+    stop = {"requested": False}
+
+    def on_signal(signum, frame):
+        if stop["requested"]:
+            # second signal: force-exit escalation — with handlers now
+            # installed before backend init, a hung init/compile would
+            # otherwise be un-interruptible short of SIGKILL
+            warning("Interrupted twice: aborting now")
+            raise KeyboardInterrupt
+        stop["requested"] = True
+        warning("Interrupted: finishing current step then shutting down "
+                "(interrupt again to abort immediately)")
+
+    try:
+        previous_handlers = {
+            signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
+            signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
+        }
+    except ValueError:
+        # not the main thread (an embedded runner — tests, notebooks):
+        # signal handling stays with the host application
+        previous_handlers = {}
     if args.forensics and not args.worker_metrics:
         # the ledger's distance evidence rides worker_sq_dist
         info("--forensics implies --worker-metrics: enabling the per-worker "
@@ -558,6 +671,30 @@ def main(argv=None):
     )
     unroll = max(1, args.unroll)
 
+    # Flight recorder (obs/flight.py): the ring's lane set mirrors exactly
+    # what the engine will compute (validated again by the engine itself).
+    # Constructed once and shared across guardian rebuilds — the layout is
+    # immutable; the BUFFERS are per-state and re-init on every rollback.
+    flight_rec = None
+    if args.flight:
+        flight_rec = obs_flight.FlightRecorder(
+            args.flight, n, probe=True, worker_metrics=args.worker_metrics,
+            chaos=bool(args.chaos), secure=args.secure,
+        )
+        if args.flight < unroll:
+            warning(
+                "--flight capacity %d < --unroll %d: a summary fetch cannot "
+                "cover the whole last chunk; size the ring to at least the "
+                "unroll (ideally the summary delta)" % (args.flight, unroll)
+            )
+    # Programmatic profiler window (--xprof A:B): parsed up front so a bad
+    # spec fails before any compilation.
+    xprof = None
+    if args.xprof:
+        xprof = obs_profiler.ProfilerWindow(
+            args.xprof, args.trace_dir, registry=registry
+        )
+
     with Context("graph"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
         attack = attacks.instantiate(args.attack, n, r, args.attack_args) if args.attack else None
@@ -696,6 +833,7 @@ def main(argv=None):
                     l2_regularize=args.l2_regularize,
                     chaos=chaos,
                     secure=args.secure,
+                    flight=flight_rec,
                 )
                 loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
 
@@ -725,6 +863,7 @@ def main(argv=None):
                     trace_ops=args.trace_ops,
                     chaos=chaos,
                     secure=args.secure,
+                    flight=flight_rec,
                 )
 
                 # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
@@ -906,6 +1045,40 @@ def main(argv=None):
     if args.forensics and lead:
         ledger = ForensicsLedger(n, run_id=run_id)
 
+    # Compile observability (obs/profiler.py): every compile-cache miss of
+    # a wrapped executable becomes a named counter + a tagged summary event
+    # carrying the offending abstract shapes; jax.monitoring additionally
+    # counts every backend compile in the process.  Host-side polling only
+    # — the jitted programs are never touched.
+    compile_watch = obs_profiler.CompileWatch(
+        # ``step`` is the train loop's local below; the provider only runs
+        # when a wrapped dispatch fires, by which point it is assigned
+        registry, summaries=summaries, step_provider=lambda: step
+    )
+    obs_profiler.install_compile_listener(registry)
+    nb_mem_devices = obs_profiler.install_memory_gauges(registry)
+    if nb_mem_devices:
+        info("Device memory gauges live on %d device(s)" % nb_mem_devices)
+
+    def instrument_stack(stack):
+        """Wrap a TrainingStack's dispatches in the compile watch (called
+        on the initial stack and on every guardian escalation rebuild)."""
+        stack.step_fn = compile_watch.wrap("train_step", stack.step_fn)
+        if stack.multi_fn is not None:
+            stack.multi_fn = compile_watch.wrap("train_multi_step", stack.multi_fn)
+        if stack.eval_fn is not None:
+            stack.eval_fn = compile_watch.wrap("eval_step", stack.eval_fn)
+        if stack.eval_loss_fn is not None:
+            stack.eval_loss_fn = compile_watch.wrap("eval_loss", stack.eval_loss_fn)
+        if stack.sampled_tail is not None:
+            inner_tail = stack.sampled_tail
+            stack.sampled_tail = lambda nb: compile_watch.wrap(
+                "train_sampled_tail[%d]" % nb, inner_tail(nb)
+            )
+        return stack
+
+    instrument_stack(ts)
+
     def dump_metrics_file():
         if not args.metrics_file or not lead:
             return
@@ -1080,17 +1253,6 @@ def main(argv=None):
 
     reset_input(offstep)
 
-    stop = {"requested": False}
-
-    def on_signal(signum, frame):
-        stop["requested"] = True
-        warning("Interrupted: finishing current step then shutting down")
-
-    previous_handlers = {
-        signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
-        signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
-    }
-
     def fold_metric_sums(sums, folded):
         """Accumulate one batch's (total, count) metric sums."""
         if sums is None:
@@ -1153,6 +1315,36 @@ def main(argv=None):
         return metrics
 
     perf = PerfReport(registry=registry)
+    # Live view shared by the exporter's /status and the flight fetches —
+    # plain dict writes under the GIL; scrape threads only read.
+    live_state = {"step": offstep, "flight": None, "slo": None}
+    live = None
+    if args.live_port is not None and lead:
+
+        def live_status():
+            return {
+                "step": live_state["step"],
+                "max_step": max_step,
+                "steps_per_s": perf.steps_per_s_excl_first(),
+                "overrides": overrides.describe(),
+                "flight": live_state["flight"],
+                "slo": live_state["slo"],
+            }
+
+        live = obs_live.LiveExporter(
+            registry=registry, status_provider=live_status, run_id=run_id,
+            host=args.live_host, port=args.live_port,
+        )
+        live_addr = live.serve_background()
+        if args.live_ready_file:
+            # atomic publish, like serve's --ready-file handshake
+            ready_dir = os.path.dirname(args.live_ready_file)
+            if ready_dir:
+                os.makedirs(ready_dir, exist_ok=True)
+            tmp = args.live_ready_file + ".tmp"
+            with open(tmp, "w") as fd:
+                fd.write("%s %d\n" % live_addr)
+            os.replace(tmp, args.live_ready_file)
     # Training gauges on the process-wide registry (obs/metrics.py): the
     # same values the summary stream carries, updated at every summary fire
     # and dumped as Prometheus text by --metrics-file.
@@ -1191,6 +1383,17 @@ def main(argv=None):
     )
     g_recoveries = registry.counter(
         "guardian_recoveries_total", "Guardian diverged-then-recovered verdicts"
+    )
+    # flight-recorder fetch accounting (obs/flight.py): one amortized host
+    # copy per summary fire instead of per-dispatch pulls
+    c_flight_fetches = registry.counter(
+        "flight_fetches_total", "Flight-recorder ring fetches"
+    )
+    g_flight_rows = registry.gauge(
+        "flight_window_steps", "Rows in the last fetched flight window"
+    )
+    g_flight_last = registry.gauge(
+        "flight_last_step", "Completed step of the newest fetched flight row"
     )
     metrics = {}
     diverged = False
@@ -1268,6 +1471,20 @@ def main(argv=None):
                 scalars["chaos_regime"] = int(jax.device_get(metrics["chaos_regime"]))
             if args.gar_probe:
                 scalars["gar_seconds"] = time_gar_probe(step)
+            if flight_rec is not None:
+                # ONE amortized ring fetch per summary fire: the last
+                # dispatch already materialized the state, so this is a
+                # host copy, not a device sync (the recorder's whole
+                # host-side cost).
+                with trace.span("flight.fetch", cat="obs"):
+                    window = flight_rec.fetch(state.flight)
+                c_flight_fetches.inc()
+                nb_rows = int(window["step"].size)
+                g_flight_rows.set(nb_rows)
+                if nb_rows:
+                    g_flight_last.set(int(window["step"][-1]) + 1)
+                live_state["flight"] = obs_flight.summarize_window(window)
+                scalars["flight_rows"] = nb_rows
             # mirror into the registry — one metrics surface (obs/metrics.py)
             g_loss.set(scalars["total_loss"])
             g_grad_norm.set(scalars["grad_norm"])
@@ -1301,6 +1518,39 @@ def main(argv=None):
                     return  # the guardian owns divergence: rollback, not abort
                 diverged = True
                 raise UserException("Training diverged (non-finite loss around step %d)" % step)
+
+        def flight_postmortem(reason, at_step):
+            """Fetch + dump the in-scan ring: exact per-step evidence for
+            the window that killed the run (obs/flight.py), attached to the
+            forensics report.  Called on guardian rollback and on
+            crash/divergence, BEFORE the state is discarded."""
+            if flight_rec is None:
+                return None
+            try:
+                window = flight_rec.fetch(state.flight)
+            except Exception as exc:
+                warning("flight: post-mortem fetch failed: %s" % exc)
+                return None
+            summary = obs_flight.summarize_window(window)
+            path = None
+            if args.flight_dump and lead:
+                path = args.flight_dump
+                if reason == "guardian_rollback":
+                    # every rollback keeps its own dump; the final
+                    # crash/divergence dump owns the bare path
+                    root, ext = os.path.splitext(path)
+                    path = "%s.rollback-%d%s" % (root, int(at_step), ext or ".json")
+                obs_flight.dump_window(
+                    path, window, run_id=run_id, reason=reason,
+                    capacity=flight_rec.capacity,
+                    extra={"at_step": int(at_step)},
+                )
+                info("Flight post-mortem (%s) -> %r (%d row(s))"
+                     % (reason, path, summary.get("rows", 0)))
+            if ledger is not None:
+                ledger.attach_flight(at_step, reason, path=path,
+                                     window_summary=summary)
+            return path
 
         # Secure submission feed (secure/submit.py): the host-side HMAC
         # sign/verify over the previous dispatch's digests — the same
@@ -1436,6 +1686,9 @@ def main(argv=None):
                 "attempt": attempt, "restored_snapshot": target is not None,
             })
             g_rollbacks.inc()
+            # the ring still holds the diverged timeline's per-step rows —
+            # dump them before the restore wipes the state
+            flight_postmortem("guardian_rollback", at_step)
             if ledger is not None:
                 # the replay window re-observes the truncated steps; the
                 # rollback event (stamped at the restore step so it survives
@@ -1456,7 +1709,7 @@ def main(argv=None):
                 try:
                     new_overrides = rung.apply(overrides)
                     with Context("escalate"):
-                        new_ts = build_training(new_overrides)
+                        new_ts = instrument_stack(build_training(new_overrides))
                     overrides, ts = new_overrides, new_ts
                     if custody is not None:
                         # manifests saved from here on sign the new spec
@@ -1589,6 +1842,11 @@ def main(argv=None):
 
                     trace_ctx = jax.profiler.trace(args.trace_dir)
                     trace_ctx.__enter__()
+                if xprof is not None:
+                    # programmatic device capture over an explicit step
+                    # window; under --unroll the boundary lands on the
+                    # chunk boundary (a compiled scan is never split)
+                    xprof.maybe_start(step)
                 chunk = 1
                 if ts.multi_fn is not None and max_step - step >= unroll and trace_ctx is None:
                     # Unrolled dispatch: K distinct batches, one executable
@@ -1603,7 +1861,8 @@ def main(argv=None):
                             device_chunk = ts.engine.shard_batches(next_chunk())
                     gap_close()
                     perf.step_begin()
-                    state, many = ts.multi_fn(state, device_chunk)
+                    with xprof.annotate(step) if xprof is not None else contextlib.nullcontext():
+                        state, many = ts.multi_fn(state, device_chunk)
                     if observe_pending():
                         continue  # previous chunk diverged: this one is abandoned
                     check_divergence()
@@ -1628,7 +1887,8 @@ def main(argv=None):
                     tail_fn = ts.sampled_tail(nb_steps)
                     gap_close()
                     perf.step_begin()
-                    state, many = tail_fn(state, ts.device_dataset)
+                    with xprof.annotate(step) if xprof is not None else contextlib.nullcontext():
+                        state, many = tail_fn(state, ts.device_dataset)
                     if observe_pending():
                         continue  # previous chunk diverged: this one is abandoned
                     check_divergence()
@@ -1650,7 +1910,8 @@ def main(argv=None):
                         batch = next(prefetcher) if prefetcher is not None else ts.engine.shard_batch(next(train_iter))
                     gap_close()
                     perf.step_begin()
-                    state, metrics = ts.step_fn(state, batch)
+                    with xprof.annotate(step) if xprof is not None else contextlib.nullcontext():
+                        state, metrics = ts.step_fn(state, batch)
                     if observe_pending():
                         continue  # previous step diverged: this one is abandoned
                     check_divergence()
@@ -1660,6 +1921,9 @@ def main(argv=None):
                     pending_metrics = metrics
                     pending_start = step
                 step += chunk
+                live_state["step"] = step
+                if xprof is not None:
+                    xprof.maybe_stop(step)
                 if chaos is not None:
                     regime_now = chaos.regime_at(step)
                     if regime_now != chaos_regime_seen:
@@ -1703,6 +1967,9 @@ def main(argv=None):
                 signal.signal(signum, handler)
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
+            if xprof is not None:
+                xprof.close()
+            aborting = sys.exc_info()[0] is not None
             # Final fire of every daemon (reference: runner.py:356-494 at
             # stop) — skipped on divergence (evaluating or checkpointing the
             # NaN state would poison the next run's auto-restore) and when
@@ -1714,6 +1981,30 @@ def main(argv=None):
                     checkpoints.save(state, step)
                 if metrics and summary_trigger.last_step != step:
                     summaries.scalars(step, summary_scalars(step, metrics))
+            if step > offstep and not diverged and not aborting:
+                # Regression sentinel at run end (obs/slo.py): judge the
+                # run's measured throughput metrics against the stored
+                # baseline, and/or capture a fresh baseline.  Before
+                # summaries.close() — the verdict is a summary event too.
+                if sentinel is not None or args.slo_capture:
+                    slo_current = obs_slo.collect_current(registry, perf)
+                if sentinel is not None:
+                    verdict = sentinel.verdict(slo_current, run_id=run_id)
+                    live_state["slo"] = verdict
+                    info(obs_slo.describe_verdict(verdict))
+                    summaries.event(step, "slo_verdict", {
+                        "verdict": verdict["verdict"],
+                        "regressed": verdict["regressed"],
+                        "checks": verdict["checks"],
+                    })
+                    if args.slo_verdict and lead:
+                        obs_slo.save_verdict(args.slo_verdict, verdict)
+                        info("SLO verdict -> %r" % args.slo_verdict)
+                if args.slo_capture and lead:
+                    doc = obs_slo.capture(args.slo_capture, slo_current,
+                                          run_id=run_id)
+                    info("SLO baseline -> %r (metrics: %s)" % (
+                        args.slo_capture, ", ".join(sorted(doc["metrics"]))))
             if prefetcher is not None:
                 prefetcher.close()
             if chunk_pipeline is not None:
@@ -1722,14 +2013,37 @@ def main(argv=None):
             summaries.close()
             gap_close()
             # Telemetry flush — last observations (a diverged tail IS
-            # evidence), attribution report, metrics dump, trace.  Best-
-            # effort: a telemetry write failure must not mask a propagating
-            # training error.
-            aborting = sys.exc_info()[0] is not None
-            try:
-                feed_pending_secure()
-                feed_pending_forensics()
-                if ledger is not None:
+            # evidence), attribution report, metrics dump, trace.  Every
+            # step is INDEPENDENT: a failing ledger save must not skip the
+            # metrics dump (a preempted run must never exit with an empty
+            # --metrics-file), and during an abort no flush failure may
+            # mask the propagating training error.
+            flush_errors = []
+
+            def flush(label, fn):
+                try:
+                    fn()
+                except Exception as exc:
+                    # always LOGGED here (a later cleanup failure must not
+                    # erase the record); re-raised at the very end unless
+                    # an exception is already propagating
+                    warning("Telemetry flush (%s) failed: %s" % (label, exc))
+                    if not aborting:
+                        flush_errors.append((label, exc))
+
+            if aborting or diverged:
+                # the ring holds the exact per-step window that killed the
+                # run — dump it before anything else can fail
+                flush("flight-postmortem", lambda: flight_postmortem(
+                    "divergence" if diverged else "crash", step))
+            # Drain the lagged feeds BEFORE the report is written: the
+            # final dispatch's evidence — and its secure verdict lane —
+            # must reach the ledger (they sit one dispatch behind by
+            # design, so shutdown is the only place they can land).
+            flush("secure-drain", feed_pending_secure)
+            flush("forensics-drain", feed_pending_forensics)
+            if ledger is not None:
+                def save_forensics():
                     md_path = (
                         args.forensics[:-5] + ".md"
                         if args.forensics.endswith(".json") else args.forensics + ".md"
@@ -1741,28 +2055,40 @@ def main(argv=None):
                         "Byzantine worker(s): %s" % ", ".join(map(str, suspects))
                         if suspects else "no worker attributed Byzantine",
                     ))
-                dump_metrics_file()
-                if args.trace_file:
+
+                flush("forensics-report", save_forensics)
+            flush("metrics-file", dump_metrics_file)
+            if args.trace_file:
+                def save_span_trace():
                     written = trace.uninstall(save=True)
                     if written:
                         info("Span trace -> %r (run_id %s)" % (written, run_id))
-            except Exception as exc:
-                if not aborting:
-                    raise
-                warning("Telemetry flush failed during abort: %s" % exc)
+
+                flush("trace", save_span_trace)
+            if live is not None:
+                flush("live-exporter", live.shutdown_all)
             perf.report()
             if checkpoints is not None:
                 # LAST cleanup step, so a flush failure can no longer skip
                 # the closes/report above: a returned run is fully flushed
                 # to disk.  If an exception is already propagating, the
                 # flush failure must not mask it — log it instead.
-                if sys.exc_info()[0] is None:
-                    checkpoints.wait(shutdown=True)
-                else:
+                if aborting:
                     try:
                         checkpoints.wait(shutdown=True)
                     except Exception as exc:
                         warning("Checkpoint write failed during abort: %s" % exc)
+                else:
+                    checkpoints.wait(shutdown=True)
+            if flush_errors:
+                # surfaced LAST so a telemetry write failure can no longer
+                # skip the report or the checkpoint flush (it still fails
+                # the run: silent telemetry loss is how evidence vanishes)
+                label, exc = flush_errors[0]
+                if len(flush_errors) > 1:
+                    warning("%d more telemetry flush step(s) failed after %r"
+                            % (len(flush_errors) - 1, label))
+                raise exc
     return 0
 
 

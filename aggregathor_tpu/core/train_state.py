@@ -53,6 +53,12 @@ class TrainState:
     #: after restore so a rollback never judges recovery against a
     #: poisoned reference
     loss_ema: object = None
+    #: replicated flight-recorder ring buffers (obs/flight.py): a dict of
+    #: fixed-size per-step telemetry lanes written in-scan by the step
+    #: body.  A side buffer like carry/momentum — never serialized; a
+    #: restore or rollback re-initializes an empty ring (stale rows from
+    #: an abandoned timeline must not masquerade as fresh evidence)
+    flight: object = None
 
     @classmethod
     def create(cls, params, tx, rng=None, carry=None, momentum=None):

@@ -24,13 +24,31 @@ The telemetry pillars (docs/observability.md):
   Prometheus text exposition (``MetricsRegistry``, default ``REGISTRY``)
 - ``ForensicsLedger`` per-worker suspicion timeline -> Byzantine
   attribution report (schema ``aggregathor.obs.forensics.v1``)
+
+The device-side layer (docs/observability.md "Device-side observability"):
+
+- ``flight``          in-scan flight-recorder rings: per-step telemetry
+  lanes written inside the jitted scan, fetched once per summary fire,
+  dumped post-mortem (schema ``aggregathor.obs.flight.v1``)
+- ``profiler``        step-windowed ``jax.profiler`` captures (``--xprof``),
+  compile-cache-miss observability, device memory gauges
+- ``live``            ``LiveExporter`` — the training run's own
+  ``/metrics`` + ``/status`` HTTP endpoint
+- ``slo``             regression sentinel: baseline documents (schema
+  ``aggregathor.obs.slo.v1``) judged PASS/REGRESS at run end
 """
 
+from . import flight  # noqa: F401
+from . import live  # noqa: F401
 from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
+from . import slo  # noqa: F401
 from . import trace  # noqa: F401
 from .cadence import CadenceTrigger  # noqa: F401
 from .checkpoint import Checkpoints  # noqa: F401
 from .evalfile import EvalFile  # noqa: F401
+from .flight import FlightRecorder  # noqa: F401
 from .forensics import ForensicsLedger  # noqa: F401
+from .live import LiveExporter  # noqa: F401
 from .summaries import SummaryWriter  # noqa: F401
 from .perf import LatencyHistogram, PerfReport  # noqa: F401

@@ -1,0 +1,322 @@
+"""Flight recorder: bounded per-step telemetry rings written IN-SCAN.
+
+The PR-4 telemetry pillars are host-bound: every dispatch that wants
+per-step evidence must pull full metrics to the host (the forensics feed),
+and under ``--unroll`` the summary stream only ever sees the LAST sub-step
+of each chunk.  The flight recorder is the device-side half: a fixed-size
+ring of per-step lanes carried as a non-serialized ``TrainState`` side
+buffer and written inside the jitted step body itself (``parallel/
+engine.py`` / ``parallel/sharded_engine.py``), so every scanned step leaves
+a row on the accelerator at zero host cost.  The host fetches the whole
+ring ONCE at summary cadence (one amortized copy instead of per-dispatch
+pulls) and dumps it post-mortem on guardian rollback or crash — exact
+per-step evidence for the window that killed the run, like an aircraft
+flight recorder.
+
+Hard constraints (asserted by tests/test_flight.py):
+
+- **zero added recompiles** — the ring is state carried through the same
+  one compiled program; the compile count with the recorder on equals the
+  recorder-off run (1 steady-state executable either way);
+- **bit-identical lanes** — every lane stores the SAME traced value the
+  metrics dictionary returns, so ring rows are bit-identical to the
+  per-dispatch metrics at any ``--unroll``;
+- **bounded memory** — capacity ``C`` rows of a handful of scalars plus up
+  to three ``(C, n)`` vectors; a 256-row ring at n=8 is a few KB of HBM.
+
+Lanes (each present only when the engine computes the source metric):
+
+====================  ========  ==========================================
+lane                  shape     source
+====================  ========  ==========================================
+``step``              (C,)      in-graph step counter (slot validity tag)
+``loss``              (C,)      ``metrics["total_loss"]``
+``update_norm``       (C,)      ``metrics["grad_norm"]``
+``spike``             (C,)      probe spike score (guardian/probe.py)
+``loss_finite``       (C,)      probe finite-loss flag
+``worker_nan``        (C, n)    probe post-transport NaN-row flags
+``worker_sq_dist``    (C, n)    per-worker squared distance (worker_metrics)
+``chaos_regime``      (C,)      active chaos regime index
+``secure_rejected``   (C, n)    secure submission verdict lanes
+====================  ========  ==========================================
+
+Slot ``step % C`` holds step ``step``'s row; the ``step`` lane (init -1)
+makes every slot self-identifying, so a fetched ring needs no host-side
+cursor — stale slots (pre-wraparound, or zeroed by a rollback re-init)
+are recognized and dropped by :meth:`FlightRecorder.fetch`.
+
+The post-mortem document serializes under schema
+``aggregathor.obs.flight.v1`` (:func:`dump_window`); non-finite floats are
+encoded as the strings ``"nan"`` / ``"inf"`` / ``"-inf"`` (strict JSON has
+no tokens for them, and for a divergence post-mortem the NaN *is* the
+evidence — ``null`` would erase its sign and kind).
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import UserException
+
+SCHEMA = "aggregathor.obs.flight.v1"
+
+#: lanes shaped (C,) — name -> (dtype, fill value)
+_SCALAR_LANES = {
+    "step": (jnp.int32, -1),
+    "loss": (jnp.float32, jnp.nan),
+    "update_norm": (jnp.float32, jnp.nan),
+}
+_PROBE_SCALAR_LANES = {
+    "spike": (jnp.float32, jnp.nan),
+    "loss_finite": (jnp.int32, -1),
+}
+
+
+class FlightRecorder:
+    """Static ring configuration + the traced write and host fetch.
+
+    One instance describes the ring LAYOUT (capacity and which lanes) and
+    is shared by the engine (``init_buffers``/``record`` run under jit) and
+    the host loop (``fetch``).  Lane flags must match what the engine
+    actually computes — :meth:`validate_for` is called by both engines'
+    constructors and fails loudly on a lane whose source metric the engine
+    will not produce.
+
+    Args:
+      capacity: ring rows (>= 1).  Rows older than the last ``capacity``
+        steps are overwritten; size the ring to at least the summary
+        cadence (and ``--unroll``) to fetch every step exactly once.
+      nb_workers: n — the width of the per-worker lanes.
+      probe: record the health-probe lanes (spike / loss_finite /
+        worker_nan); needs the engine's ``health_probe``.
+      worker_metrics: record ``worker_sq_dist``; needs ``worker_metrics``.
+      chaos: record the regime-index lane; needs a chaos schedule.
+      secure: record the submission-verdict lane; needs ``secure``.
+    """
+
+    def __init__(self, capacity, nb_workers, probe=True, worker_metrics=False,
+                 chaos=False, secure=False):
+        self.capacity = int(capacity)
+        self.nb_workers = int(nb_workers)
+        if self.capacity < 1:
+            raise UserException(
+                "FlightRecorder wants capacity >= 1 (got %d)" % self.capacity
+            )
+        if self.nb_workers < 1:
+            raise UserException(
+                "FlightRecorder wants nb_workers >= 1 (got %d)" % self.nb_workers
+            )
+        self.probe = bool(probe)
+        self.worker_metrics = bool(worker_metrics)
+        self.chaos = bool(chaos)
+        self.secure = bool(secure)
+
+    # ------------------------------------------------------------------ #
+    # engine side (traced)
+
+    def validate_for(self, nb_workers, probe, worker_metrics, chaos, secure):
+        """Fail loudly when a configured lane's source metric is absent
+        from the engine this recorder is being attached to."""
+        if nb_workers != self.nb_workers:
+            raise UserException(
+                "FlightRecorder was sized for n=%d workers but the engine "
+                "has %d" % (self.nb_workers, nb_workers)
+            )
+        for lane, wanted, have in (
+            ("probe", self.probe, probe),
+            ("worker_sq_dist", self.worker_metrics, worker_metrics),
+            ("chaos_regime", self.chaos, chaos),
+            ("secure_rejected", self.secure, secure),
+        ):
+            if wanted and not have:
+                raise UserException(
+                    "FlightRecorder records the %r lane but the engine does "
+                    "not compute its source metric" % lane
+                )
+
+    def lane_shapes(self):
+        """{name: (shape, dtype, fill)} for every configured lane."""
+        C, n = self.capacity, self.nb_workers
+        lanes = {
+            name: ((C,), dtype, fill)
+            for name, (dtype, fill) in _SCALAR_LANES.items()
+        }
+        if self.probe:
+            lanes.update({
+                name: ((C,), dtype, fill)
+                for name, (dtype, fill) in _PROBE_SCALAR_LANES.items()
+            })
+            lanes["worker_nan"] = ((C, n), jnp.int32, -1)
+        if self.worker_metrics:
+            lanes["worker_sq_dist"] = ((C, n), jnp.float32, jnp.nan)
+        if self.chaos:
+            lanes["chaos_regime"] = ((C,), jnp.int32, -1)
+        if self.secure:
+            lanes["secure_rejected"] = ((C, n), jnp.int32, -1)
+        return lanes
+
+    def init_buffers(self):
+        """Fresh (host-buildable) ring pytree, every slot invalid."""
+        return {
+            name: jnp.full(shape, fill, dtype)
+            for name, (shape, dtype, fill) in self.lane_shapes().items()
+        }
+
+    def record(self, buffers, step, metrics):
+        """(traced) Write step ``step``'s row into slot ``step % C``.
+
+        Every lane stores the exact traced value the ``metrics`` dict
+        carries — the ring IS the metrics stream, ring-buffered — so a
+        fetched row is bit-identical to the per-step metrics by
+        construction.  Runs inside the jitted step body (both engines);
+        all recorded values are replicated there, so the replicated ring
+        stays replicated."""
+        from ..guardian.probe import PROBE_KEY
+
+        slot = jax.lax.rem(
+            jnp.asarray(step, jnp.int32), jnp.int32(self.capacity)
+        )
+        out = dict(buffers)
+
+        def put(name, value):
+            buf = buffers[name]
+            out[name] = jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.asarray(value).astype(buf.dtype), slot, 0
+            )
+
+        put("step", step)
+        put("loss", metrics["total_loss"])
+        put("update_norm", metrics["grad_norm"])
+        if self.probe:
+            probe = metrics[PROBE_KEY]
+            put("spike", probe["spike"])
+            put("loss_finite", probe["loss_finite"])
+            put("worker_nan", probe["worker_nan_rows"])
+        if self.worker_metrics:
+            put("worker_sq_dist", metrics["worker_sq_dist"])
+        if self.chaos:
+            put("chaos_regime", metrics["chaos_regime"])
+        if self.secure:
+            put("secure_rejected", metrics["secure"]["rejected"])
+        return out
+
+    # ------------------------------------------------------------------ #
+    # host side
+
+    def fetch(self, buffers):
+        """One fetched ring -> the valid window, ordered by step.
+
+        ``buffers`` is the (device or host) ring pytree; the ONE
+        ``jax.device_get`` here is the recorder's whole host cost per
+        summary fire.  Returns ``{lane: np.ndarray}`` with rows sorted by
+        the ``step`` lane ascending, slots never written (step -1)
+        dropped.  The ``step`` lane holds IN-GRAPH step indices: row
+        ``s`` describes the step that took the counter from ``s`` to
+        ``s + 1`` (the summary stream's "completed step" ``s + 1``)."""
+        host = {
+            name: np.asarray(value)
+            for name, value in jax.device_get(buffers).items()
+        }
+        steps = host["step"]
+        order = np.argsort(steps, kind="stable")
+        order = order[steps[order] >= 0]
+        return {name: value[order] for name, value in host.items()}
+
+
+def summarize_window(window, tail=5):
+    """Small JSON-able view of a fetched window (the live ``/status``
+    payload): step range, row count, and the last ``tail`` rows of the
+    scalar lanes."""
+    steps = window.get("step")
+    if steps is None or steps.size == 0:
+        return {"rows": 0}
+    out = {
+        "rows": int(steps.size),
+        "first_step": int(steps[0]),
+        "last_step": int(steps[-1]),
+    }
+    for lane in ("loss", "update_norm", "spike", "chaos_regime"):
+        if lane in window:
+            out[lane] = [_json_value(v) for v in window[lane][-int(tail):]]
+    if "worker_nan" in window:
+        out["worker_nan_rows_last"] = [
+            int(v) for v in np.asarray(window["worker_nan"][-1]).reshape(-1)
+        ]
+    return out
+
+
+def _json_value(value):
+    """Strict-JSON scalar: non-finite floats become tagged strings (a
+    post-mortem must keep the difference between NaN and ±inf)."""
+    if isinstance(value, (np.integer, int)):
+        return int(value)
+    value = float(value)
+    if np.isfinite(value):
+        return value
+    if np.isnan(value):
+        return "nan"
+    return "inf" if value > 0 else "-inf"
+
+
+def dump_window(path, window, run_id=None, reason=None, capacity=None,
+                extra=None):
+    """Write one fetched window as a post-mortem document (atomic write).
+
+    Schema ``aggregathor.obs.flight.v1``: per-lane row lists in step
+    order, non-finite floats encoded per :func:`_json_value`.  Returns the
+    document dict."""
+    lanes = {}
+    for name, values in window.items():
+        arr = np.asarray(values)
+        if arr.ndim <= 1:
+            lanes[name] = [_json_value(v) for v in arr]
+        else:
+            lanes[name] = [[_json_value(v) for v in row] for row in arr]
+    steps = window.get("step")
+    doc = {
+        "schema": SCHEMA,
+        "run_id": run_id,
+        "reason": reason,
+        "written_at": time.time(),
+        "capacity": capacity,
+        "rows": int(steps.size) if steps is not None else 0,
+        "step_range": (
+            [int(steps[0]), int(steps[-1])]
+            if steps is not None and steps.size else None
+        ),
+        "lanes": lanes,
+    }
+    if extra:
+        doc["extra"] = dict(extra)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fd:
+        json.dump(doc, fd, indent=1)
+        fd.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
+def load_window(path):
+    """Load + schema-check a post-mortem document (tests, smoke)."""
+    with open(path) as fd:
+        doc = json.load(fd)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            "expected schema %r, got %r" % (SCHEMA, doc.get("schema"))
+        )
+    if not isinstance(doc.get("lanes"), dict) or "step" not in doc["lanes"]:
+        raise ValueError("flight document wants a lanes dict with a step lane")
+    nb = len(doc["lanes"]["step"])
+    for name, rows in doc["lanes"].items():
+        if len(rows) != nb:
+            raise ValueError(
+                "lane %r has %d rows, step lane has %d" % (name, len(rows), nb)
+            )
+    return doc

@@ -144,6 +144,10 @@ class ForensicsLedger:
         self._timeline = []
         #: [(step, kind, payload)] guardian verdicts (rollback/escalation/...)
         self._guardian = []
+        #: flight-recorder post-mortems (obs/flight.py) attached at
+        #: rollback/crash: {at_step, reason, path, window} references the
+        #: exact per-step evidence for the window that killed the run
+        self._flight = []
         self._steps_observed = 0
 
     # ------------------------------------------------------------------ #
@@ -210,6 +214,20 @@ class ForensicsLedger:
         ``recovered``) — the recovery layer's contribution to the audit
         trail."""
         self._guardian.append((int(step), str(kind), dict(payload or {})))
+
+    def attach_flight(self, at_step, reason, path=None, window_summary=None):
+        """Reference a flight-recorder post-mortem dump (obs/flight.py) in
+        the report: the in-scan ring holds EXACT per-step evidence for the
+        window around a rollback or crash — including the final dispatch's
+        sub-steps that a cadenced feed would summarize away.  Post-mortems
+        survive ``truncate_after`` (like the rollback event itself, they
+        are the audit trail of the abandoned timeline)."""
+        self._flight.append({
+            "at_step": int(at_step),
+            "reason": str(reason),
+            "path": path,
+            "window": dict(window_summary or {}),
+        })
 
     def truncate_after(self, step):
         """Drop observations and guardian events beyond ``step`` — the
@@ -321,6 +339,7 @@ class ForensicsLedger:
                 {"step": step, "kind": kind, "payload": payload}
                 for step, kind, payload in self._guardian
             ],
+            "flight_postmortems": list(self._flight),
         }
 
     @staticmethod

@@ -1,0 +1,308 @@
+"""Device-side profiling: step-windowed traces, compile + memory telemetry.
+
+Three instruments, all host-side plumbing around ``jax.profiler`` /
+``jax.monitoring`` (the jitted programs are never touched — the PR-4
+zero-recompile discipline):
+
+- :class:`ProfilerWindow` — a programmatic ``jax.profiler`` capture over an
+  explicit step window (``--xprof A:B`` on the runner): the device trace
+  starts when the step counter reaches ``A`` and stops at ``B``, and every
+  dispatch inside the window is wrapped in a
+  ``jax.profiler.StepTraceAnnotation`` so the PR-4 host spans join the
+  device timeline on the profiler's step axis.  Under ``--unroll`` the
+  boundaries land on chunk boundaries (the window is never allowed to
+  split a compiled scan).
+- :class:`CompileWatch` — compile observability: wrapped executables are
+  polled for jit-cache growth after every call (one host attribute read);
+  a cache miss becomes a named ``compile_cache_misses_total{executable=}``
+  counter increment plus a tagged ``compile_cache_miss`` summary event
+  carrying WHICH executable retraced and the abstract shapes of the
+  dispatch that triggered it — the first diagnostic anyone needs when
+  steps/s falls off a cliff.  :func:`install_compile_listener` additionally
+  taps ``jax.monitoring`` for backend-compile totals (catching compiles of
+  executables nobody thought to wrap).
+- :func:`install_memory_gauges` — live/peak device memory bytes from
+  ``Device.memory_stats()`` as scrape-time registry gauges (absent on
+  backends that do not report, e.g. XLA:CPU).
+"""
+
+import contextlib
+import threading
+
+import jax
+
+from ..utils import UserException, info
+
+
+# --------------------------------------------------------------------- #
+# step-windowed device traces
+
+
+class ProfilerWindow:
+    """One ``jax.profiler`` capture over steps ``[begin, end)``.
+
+    ``spec`` is the CLI form ``"A:B"`` (ints, ``A < B``).  The runner calls
+    :meth:`maybe_start` before each dispatch and :meth:`maybe_stop` after
+    the step counter advances; :meth:`annotate` wraps the dispatch in a
+    ``StepTraceAnnotation`` while the capture is live (and is a no-op
+    ``nullcontext`` otherwise, so the inactive path costs one attribute
+    read).  :meth:`close` stops a capture left open at shutdown."""
+
+    def __init__(self, spec, trace_dir, registry=None):
+        try:
+            begin, _, end = str(spec).partition(":")
+            self.begin, self.end = int(begin), int(end)
+        except ValueError:
+            raise UserException("--xprof wants A:B step integers (got %r)" % (spec,))
+        if not 0 <= self.begin < self.end:
+            raise UserException(
+                "--xprof wants 0 <= A < B (got %d:%d)" % (self.begin, self.end)
+            )
+        self.trace_dir = trace_dir
+        self.active = False
+        self.done = False
+        if registry is not None:
+            registry.gauge(
+                "profiler_window_active",
+                "1 while a --xprof device capture is recording",
+            ).set_function(lambda: 1.0 if self.active else 0.0)
+
+    def maybe_start(self, step):
+        """Open the capture when ``step`` enters the window (idempotent;
+        never reopens a finished window)."""
+        if self.active or self.done or step < self.begin or step >= self.end:
+            return False
+        jax.profiler.start_trace(self.trace_dir)
+        self.active = True
+        info("Profiler window open at step %d -> %r (steps %d:%d)"
+             % (step, self.trace_dir, self.begin, self.end))
+        return True
+
+    def maybe_stop(self, step):
+        """Close the capture once ``step`` passed the window end."""
+        if not self.active or step < self.end:
+            return False
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        info("Profiler window closed at step %d (device trace in %r)"
+             % (step, self.trace_dir))
+        return True
+
+    def annotate(self, step):
+        """Context manager for one dispatch: a ``StepTraceAnnotation``
+        inside the live window (joining host spans to the device timeline
+        per step), a free ``nullcontext`` outside it."""
+        if not self.active:
+            return contextlib.nullcontext()
+        return jax.profiler.StepTraceAnnotation("train", step_num=int(step))
+
+    def close(self):
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+        elif not self.done:
+            from ..utils import warning
+
+            # e.g. the whole window fell inside one unrolled chunk, or
+            # before the resume offset — an empty trace dir with no
+            # diagnostic would read as a silent success
+            warning(
+                "--xprof window %d:%d never opened (steps advance in "
+                "chunk strides and must LAND inside the window; widen it "
+                "past the unroll, or move it past the resume step)"
+                % (self.begin, self.end)
+            )
+
+
+# --------------------------------------------------------------------- #
+# compile observability
+
+#: the jax.monitoring duration event emitted once per backend compile
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_monitor = {"installed": False, "count": 0, "seconds": 0.0}
+_monitor_lock = threading.Lock()
+
+
+def _monitor_listener(event, duration, **kwargs):
+    if event == BACKEND_COMPILE_EVENT:
+        with _monitor_lock:
+            _monitor["count"] += 1
+            _monitor["seconds"] += float(duration)
+
+
+def install_compile_listener(registry):
+    """Count EVERY backend compile in this process (jax.monitoring) into
+    scrape-time gauges ``compile_backend_total`` /
+    ``compile_backend_seconds_total``.  The listener itself installs once
+    per process (jax.monitoring has no per-listener removal); repeated
+    calls only re-point the gauges at the shared accumulator."""
+    with _monitor_lock:
+        if not _monitor["installed"]:
+            jax.monitoring.register_event_duration_secs_listener(_monitor_listener)
+            _monitor["installed"] = True
+    registry.gauge(
+        "compile_backend_total",
+        "Backend compiles observed by jax.monitoring in this process",
+    ).set_function(lambda: float(_monitor["count"]))
+    registry.gauge(
+        "compile_backend_seconds_total",
+        "Wall time jax.monitoring attributes to backend compiles",
+    ).set_function(lambda: _monitor["seconds"])
+
+
+def describe_abstract(args, kwargs=(), limit=12):
+    """Compact abstract-shape descriptors (``f32[8,16,784]``-style) for the
+    leaves of a dispatch's arguments — what a compile-miss event records as
+    the offending shapes.  Truncated to ``limit`` leaves (the full pytree
+    of a train state is hundreds of leaves; the batch and the first few
+    state leaves identify the retrace)."""
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    out = []
+    for leaf in leaves[:limit]:
+        dtype = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if dtype is None or shape is None:
+            out.append(type(leaf).__name__)
+        else:
+            out.append("%s[%s]" % (
+                jax.dtypes.canonicalize_dtype(dtype).name
+                if hasattr(jax.dtypes, "canonicalize_dtype") else str(dtype),
+                ",".join(str(d) for d in shape),
+            ))
+    if len(leaves) > limit:
+        out.append("... +%d leaves" % (len(leaves) - limit))
+    return out
+
+
+class _WatchedCallable:
+    """Attribute-fallthrough wrapper (the ``TracedCallable`` idiom): every
+    call compares the wrapped executable's jit-cache size before/after and
+    reports growth to the owning :class:`CompileWatch`.  The wrapped
+    callable is never modified — zero added recompiles by construction."""
+
+    __slots__ = ("inner", "_watch", "_name")
+
+    def __init__(self, watch, name, fn):
+        object.__setattr__(self, "inner", fn)
+        object.__setattr__(self, "_watch", watch)
+        object.__setattr__(self, "_name", name)
+
+    def _cache_len(self):
+        probe = getattr(self.inner, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        before = self._cache_len()
+        out = self.inner(*args, **kwargs)
+        after = self._cache_len()
+        if before is not None and after is not None and after > before:
+            self._watch.note_miss(self._name, after, args, kwargs)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+class CompileWatch:
+    """Names compile-cache misses of the executables it wraps.
+
+    ``wrap(name, fn)`` returns the watched callable (idempotent per
+    ``(name, fn)`` pair — re-wrapping after a guardian rebuild reuses the
+    name).  On a miss the watch increments
+    ``compile_cache_misses_total{executable=name}`` and, when a
+    ``SummaryWriter`` is attached, emits a tagged ``compile_cache_miss``
+    event carrying the executable name, the new cache size and the
+    abstract shapes of the triggering dispatch — so "why did step 512
+    stall" is answered by the summary stream, not a profiler session."""
+
+    def __init__(self, registry, summaries=None, step_provider=None):
+        self._counter = registry.counter(
+            "compile_cache_misses_total",
+            "Jit-cache growth observed per wrapped executable "
+            "(the first compile of each executable counts once)",
+            labelnames=("executable",),
+        )
+        self.summaries = summaries
+        self.step_provider = step_provider
+        self.misses = []  # [(name, cache_size, shapes)] — tests / postmortems
+
+    def wrap(self, name, fn):
+        if isinstance(fn, _WatchedCallable) and fn._watch is self:
+            return fn
+        return _WatchedCallable(self, str(name), fn)
+
+    def note_miss(self, name, cache_size, args, kwargs):
+        shapes = describe_abstract(args, kwargs)
+        self.misses.append((name, int(cache_size), shapes))
+        self._counter.labels(executable=name).inc()
+        if int(cache_size) <= 1:
+            # the FIRST compile of an executable is expected — it counts
+            # (the smoke asserts a nonzero compile counter) but does not
+            # alarm; the summary event is reserved for true RETRACES, the
+            # "steps/s fell off a cliff" diagnostic
+            return
+        if self.summaries is not None:
+            step = 0
+            if self.step_provider is not None:
+                try:
+                    step = int(self.step_provider())
+                except Exception:
+                    step = 0
+            self.summaries.event(step, "compile_cache_miss", {
+                "executable": name,
+                "cache_size": int(cache_size),
+                "arg_shapes": shapes,
+            })
+
+
+# --------------------------------------------------------------------- #
+# device memory gauges
+
+
+def install_memory_gauges(registry, devices=None):
+    """Scrape-time live/peak device-memory gauges from
+    ``Device.memory_stats()``.
+
+    Registered per device that actually reports stats (TPU/GPU; XLA:CPU
+    returns None and registers nothing).  Returns the number of devices
+    instrumented.  The callbacks re-read ``memory_stats()`` at every
+    scrape — live views, no writer loop, like serve's queue gauges."""
+    devices = jax.devices() if devices is None else devices
+    instrumented = 0
+    live = registry.gauge(
+        "device_memory_live_bytes", "Bytes currently allocated on the device",
+        labelnames=("device",),
+    )
+    peak = registry.gauge(
+        "device_memory_peak_bytes", "Peak bytes ever allocated on the device",
+        labelnames=("device",),
+    )
+    for index, device in enumerate(devices):
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+
+        def read(dev, key, fallback=0.0):
+            def value():
+                try:
+                    return float((dev.memory_stats() or {}).get(key, fallback))
+                except Exception:
+                    return fallback
+            return value
+
+        label = str(index)
+        live.labels(device=label).set_function(read(device, "bytes_in_use"))
+        peak.labels(device=label).set_function(read(device, "peak_bytes_in_use"))
+        instrumented += 1
+    return instrumented

@@ -165,7 +165,7 @@ class RobustEngine:
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
                  granularity="vector", leaf_bucketing="auto", trace_ops=False, chaos=None,
-                 health_probe=True, secure=False):
+                 health_probe=True, secure=False, flight=None):
         self.mesh = mesh
         self.gar = gar
         self.nb_workers = int(nb_workers)
@@ -281,6 +281,19 @@ class RobustEngine:
         # verdicts ride metrics["secure"] to the host where the real HMAC
         # sign/verify runs one dispatch behind (cli/runner.py).
         self.secure = bool(secure)
+        # Flight recorder (obs/flight.py): per-step telemetry lanes written
+        # in-scan into a ring carried as a TrainState side buffer, fetched
+        # by the host only at summary cadence.  Same compiled program shape
+        # discipline as the probe: the ring rides the one executable, so
+        # the compile count equals the recorder-off run (tests/
+        # test_flight.py asserts).
+        self.flight = flight
+        if flight is not None:
+            flight.validate_for(
+                nb_workers=self.nb_workers, probe=self.health_probe,
+                worker_metrics=self.worker_metrics,
+                chaos=self.chaos is not None, secure=self.secure,
+            )
         # jitted slice-concat executables for assemble_batches, per slice count
         self._assemble_cache = {}
 
@@ -674,6 +687,7 @@ class RobustEngine:
             momentum_steps=P() if self.worker_momentum is not None else None,
             reputation=P() if self.reputation_decay is not None else None,
             loss_ema=P() if self.health_probe else None,
+            flight=P() if self.flight is not None else None,
         )
 
     def _make_body(self, loss_fn, tx):
@@ -845,6 +859,14 @@ class RobustEngine:
                                 self.gar.nb_byz_workers,
                             ).astype(jnp.int32)
                         )
+            if self.flight is not None:
+                # In-scan flight-recorder write (obs/flight.py): each lane
+                # stores the exact traced value the metrics dict carries,
+                # so ring rows are bit-identical to per-step metrics by
+                # construction.
+                new_state = new_state.replace(
+                    flight=self.flight.record(state.flight, state.step, metrics)
+                )
             return new_state, metrics
 
         return body
@@ -1157,5 +1179,10 @@ class RobustEngine:
 
             state = state.replace(
                 loss_ema=self.replicate(jnp.float32(EMA_UNSET))
+            )
+        if self.flight is not None:
+            # empty ring, every slot tagged invalid (step -1)
+            state = state.replace(
+                flight=self.replicate(self.flight.init_buffers())
             )
         return state

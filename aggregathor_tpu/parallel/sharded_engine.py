@@ -82,7 +82,7 @@ class ShardedRobustEngine:
                  exchange_dtype=None, worker_momentum=None, worker_metrics=False,
                  reputation_decay=None, quarantine_threshold=0.0,
                  l1_regularize=None, l2_regularize=None, chaos=None,
-                 health_probe=True, nb_workers=None, secure=False):
+                 health_probe=True, nb_workers=None, secure=False, flight=None):
         self.mesh = mesh
         self.gar = gar
         # Logical workers decoupled from mesh slots (the flat engine's
@@ -189,6 +189,18 @@ class ShardedRobustEngine:
         # worker group), chaos forge/tamper corrupt whole logical workers,
         # and rejected submissions NaN every leaf of that worker.
         self.secure = bool(secure)
+        # Flight recorder (obs/flight.py), the flat engine's semantics: the
+        # per-step ring is a replicated TrainState side buffer written at
+        # the end of the step body — every recorded value is already
+        # replicated (psum/all_gather-completed), so the write keeps
+        # replication and the compile count equals the recorder-off run.
+        self.flight = flight
+        if flight is not None:
+            flight.validate_for(
+                nb_workers=self.nb_workers, probe=self.health_probe,
+                worker_metrics=self.worker_metrics,
+                chaos=self.chaos is not None, secure=self.secure,
+            )
 
     # ------------------------------------------------------------------ #
 
@@ -250,6 +262,7 @@ class ShardedRobustEngine:
             )()
 
         momentum = momentum_steps = carry = reputation = loss_ema = None
+        flight = None
         if self.worker_momentum is not None:
             momentum = per_worker_zeros()
             momentum_steps = jax.device_put(jnp.zeros((), jnp.int32), rep)
@@ -261,6 +274,9 @@ class ShardedRobustEngine:
             from ..guardian.probe import EMA_UNSET
 
             loss_ema = jax.device_put(jnp.float32(EMA_UNSET), rep)
+        if self.flight is not None:
+            # empty replicated ring, every slot tagged invalid (step -1)
+            flight = jax.device_put(self.flight.init_buffers(), rep)
         state = TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
@@ -271,6 +287,7 @@ class ShardedRobustEngine:
             momentum_steps=momentum_steps,
             reputation=reputation,
             loss_ema=loss_ema,
+            flight=flight,
         )
         # Remember the layout for put_state (checkpoint restore re-sharding).
         self._state_shardings = jax.tree.map(lambda a: a.sharding, state)
@@ -819,6 +836,14 @@ class ShardedRobustEngine:
                                 gar.nb_byz_workers,
                             ).astype(jnp.int32)
                         )
+            if self.flight is not None:
+                # In-scan flight-recorder write (obs/flight.py): each lane
+                # stores the exact traced value the metrics dict carries,
+                # so ring rows are bit-identical to per-step metrics by
+                # construction.
+                new_state = new_state.replace(
+                    flight=self.flight.record(state.flight, state.step, metrics)
+                )
             return new_state, metrics
 
         return body

@@ -58,6 +58,12 @@ def build_parser():
                         help="enabled-mode median overhead bar, percent")
     parser.add_argument("--bar-disabled", type=float, default=2.0,
                         help="disabled-mode median overhead bar, percent")
+    parser.add_argument("--flight-capacity", type=int, default=64,
+                        help="flight-recorder ring rows for the paired "
+                             "recorder-on/off cell (0 skips the cell)")
+    parser.add_argument("--bar-flight", type=float, default=2.0,
+                        help="recorder-on median overhead bar, percent "
+                             "(the ISSUE 9 acceptance bar)")
     parser.add_argument("--output", default=None, metavar="JSON")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
     return parser
@@ -226,6 +232,88 @@ def main(argv=None):
         and step_level_ok("enabled", args.bar)
         and step_level_ok("disabled", args.bar_disabled)
     )
+
+    # ---- paired flight-recorder cell (ISSUE 9): recorder-on vs -off ---- #
+    # The in-scan ring is IN-GRAPH cost (unlike the host-side span
+    # wrapper), so the on/off cells are two different executables over the
+    # same experiment/batch — interleaved per repeat so drift hits both,
+    # overhead estimated per repeat like the tracer modes.  The bar is
+    # measured, not presumed: <= --bar-flight percent of step time (or the
+    # box's own noise floor on a loaded CI core).
+    if args.flight_capacity > 0:
+        from aggregathor_tpu.obs.flight import FlightRecorder
+
+        recorder = FlightRecorder(args.flight_capacity, n)
+        engine_on = RobustEngine(make_mesh(nb_workers=1), gar, nb_workers=n,
+                                 flight=recorder)
+        step_on = engine_on.build_step(experiment.loss, tx)
+        cells = {
+            "flight_off": (step.inner, state),
+            "flight_on": (
+                step_on.inner,
+                engine_on.init_state(
+                    experiment.init(jax.random.PRNGKey(args.seed)), tx,
+                    seed=args.seed + 1,
+                ),
+            ),
+        }
+        cell_states = {name: st for name, (_, st) in cells.items()}
+        for name, (fn, _) in cells.items():  # warm: compile excluded
+            cell_states[name], m = fn(cell_states[name], batch)
+            jax.block_until_ready(m["total_loss"])
+        flight_samples = {name: [] for name in cells}
+        flight_repeat_medians = {name: [] for name in cells}
+        for repeat in range(args.repeats):
+            for name, (fn, _) in cells.items():
+                chunk = []
+                for _ in range(args.steps):
+                    t0 = time.perf_counter()
+                    cell_states[name], m = fn(cell_states[name], batch)
+                    jax.block_until_ready(m["total_loss"])
+                    chunk.append(time.perf_counter() - t0)
+                flight_samples[name] += chunk
+                flight_repeat_medians[name].append(float(np.median(chunk)))
+        compile_counts = {
+            "flight_off": int(step._cache_size()),
+            "flight_on": int(step_on._cache_size()),
+        }
+        assert compile_counts["flight_on"] == compile_counts["flight_off"] == 1, (
+            "the recorder changed the compile count: %r" % compile_counts
+        )
+        per_repeat = [
+            (on - off) / off * 100.0
+            for on, off in zip(flight_repeat_medians["flight_on"],
+                               flight_repeat_medians["flight_off"])
+        ]
+        flight_overhead = float(np.median(per_repeat))
+        flight_noise = np.asarray(flight_repeat_medians["flight_off"])
+        flight_noise_pct = float(
+            (flight_noise.max() - flight_noise.min()) / 2.0
+            / np.median(flight_noise) * 100.0
+        )
+        flight_ok = (
+            flight_overhead <= args.bar_flight
+            or flight_overhead <= flight_noise_pct
+        )
+        doc["flight"] = {
+            "capacity": args.flight_capacity,
+            "modes": {name: stats(values)
+                      for name, values in flight_samples.items()},
+            "overhead_pct": round(flight_overhead, 3),
+            "overhead_pct_per_repeat": [round(v, 3) for v in per_repeat],
+            "noise_pct": round(flight_noise_pct, 3),
+            "bar_pct": args.bar_flight,
+            "compile_count": compile_counts,
+            "within_bar": bool(flight_ok),
+        }
+        print("flight recorder (capacity %d): on %+.2f%% vs off "
+              "(bar %.1f%%, box noise ±%.1f%%, compile %d==%d): %s"
+              % (args.flight_capacity, flight_overhead, args.bar_flight,
+                 flight_noise_pct, compile_counts["flight_on"],
+                 compile_counts["flight_off"],
+                 "OK" if flight_ok else "EXCEEDED"))
+        ok = ok and flight_ok
+
     doc["within_bar"] = bool(ok)
     print(json.dumps(doc))
     if args.output:

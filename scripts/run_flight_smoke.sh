@@ -1,0 +1,125 @@
+#!/usr/bin/env bash
+# Flight-recorder smoke on CPU (<45 s), docs/observability.md "Device-side
+# observability": a real CLI training run with the in-scan flight recorder
+# and the live exporter on — then assert
+#   1. a mid-run scrape of the LIVE training process answers /metrics
+#      (strict Prometheus round-trip) and /status (flight window rows),
+#   2. nonzero flight_fetches_total and a compile-event counter
+#      (compile_cache_misses_total names the step executable),
+#   3. the regression sentinel loads the baseline seeded by a first capture
+#      run and emits a verdict (slo_verdict summary event + document),
+#   4. the final --metrics-file flush parses after the process exits.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_flight}"
+run_id="flsmoke01"
+rm -rf "$out"
+mkdir -p "$out/sum"
+
+base=(--experiment mnist --experiment-args batch-size:16
+      --aggregator median --nb-workers 4 --nb-decl-byz-workers 1
+      --learning-rate-args initial-rate:0.05 --prefetch 0
+      --evaluation-delta -1 --evaluation-period -1)
+
+# ---- seed the SLO baseline from a fresh capture run ------------------- #
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner "${base[@]}" \
+  --max-step 24 --unroll 4 --summary-delta 8 \
+  --flight 16 --slo-capture "$out/slo.json"
+test -s "$out/slo.json" || { echo "no SLO baseline captured"; exit 1; }
+
+# ---- the main run: recorder + live exporter + sentinel, scraped LIVE -- #
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner "${base[@]}" \
+  --max-step 400 --unroll 4 --summary-delta 8 \
+  --flight 16 --run-id "$run_id" \
+  --live-port 0 --live-ready-file "$out/ready" \
+  --slo-baseline "$out/slo.json" --slo-verdict "$out/verdict.json" \
+  --summary-dir "$out/sum" --metrics-file "$out/train.prom" \
+  >"$out/train.log" 2>&1 &
+train_pid=$!
+
+python - "$out" "$run_id" <<'EOF'
+import json, os, sys, time, urllib.request
+
+from aggregathor_tpu.obs.metrics import parse_prometheus
+
+out, run_id = sys.argv[1], sys.argv[2]
+
+addr = None
+for _ in range(600):  # the ready-file handshake (exporter binds pre-compile)
+    try:
+        addr = open(os.path.join(out, "ready")).read().split()
+        break
+    except OSError:
+        time.sleep(0.1)
+assert addr, "live exporter never published its address"
+base = "http://%s:%s" % (addr[0], addr[1])
+
+# ---- mid-run scrape: /metrics + /status from the TRAINING process ----- #
+parsed = status = None
+for _ in range(2000):
+    try:
+        text = urllib.request.urlopen(base + "/metrics", timeout=5).read().decode()
+        candidate = parse_prometheus(text)            # strict round-trip
+        fetches = dict((n, v) for n, l, v in
+                       candidate.get("flight_fetches_total", {}).get("samples", []))
+        if fetches.get("flight_fetches_total", 0.0) >= 1.0:
+            parsed = candidate
+            status = json.loads(urllib.request.urlopen(
+                base + "/status", timeout=5).read())
+            break
+    except OSError:
+        pass
+    time.sleep(0.02)
+assert parsed is not None, "never scraped a nonzero flight fetch mid-run"
+assert status["run_id"] == run_id and status["step"] > 0, status
+assert status["flight"]["rows"] >= 1, status["flight"]
+
+# nonzero ring fetches + the compile-event counter naming the executable
+fetches = dict((n, v) for n, l, v in parsed["flight_fetches_total"]["samples"])
+assert fetches["flight_fetches_total"] >= 1.0, fetches
+compiles = parsed["compile_cache_misses_total"]["samples"]
+by_exec = dict((l["executable"], v) for n, l, v in compiles)
+assert by_exec.get("train_multi_step", 0.0) >= 1.0, by_exec
+backend = dict((n, v) for n, l, v in parsed["compile_backend_total"]["samples"])
+assert backend["compile_backend_total"] >= 1.0, backend
+print("live scrape OK: step %d, %d flight row(s), compile events %r"
+      % (status["step"], status["flight"]["rows"], by_exec))
+EOF
+
+wait "$train_pid" || { echo "training run failed"; tail "$out/train.log"; exit 1; }
+
+python - "$out" "$run_id" <<'EOF'
+import json, os, sys
+
+from aggregathor_tpu.obs.metrics import parse_prometheus
+from aggregathor_tpu.obs import slo
+
+out, run_id = sys.argv[1], sys.argv[2]
+
+# ---- sentinel verdict: document + summary event ----------------------- #
+verdict = json.load(open(os.path.join(out, "verdict.json")))
+assert verdict["schema"] == slo.SCHEMA + ".verdict", verdict["schema"]
+assert verdict["verdict"] in ("PASS", "REGRESS"), verdict
+checked = [c for c in verdict["checks"] if c["status"] != "skipped"]
+assert checked, "sentinel checked nothing"
+events = [json.loads(line)
+          for name in os.listdir(os.path.join(out, "sum"))
+          for line in open(os.path.join(out, "sum", name))]
+slo_events = [e for e in events if e.get("event") == "slo_verdict"]
+assert slo_events and slo_events[0]["verdict"] == verdict["verdict"]
+assert all(e.get("run_id") == run_id for e in events)
+print("sentinel OK: %s on %s" % (
+    verdict["verdict"], [c["metric"] for c in checked]))
+
+# ---- final --metrics-file flush after process exit -------------------- #
+parsed = parse_prometheus(open(os.path.join(out, "train.prom")).read())
+steps = dict((n, v) for n, l, v in parsed["train_steps_total"]["samples"])
+assert steps["train_steps_total"] >= 400.0, steps
+last = dict((n, v) for n, l, v in parsed["flight_last_step"]["samples"])
+assert last["flight_last_step"] == 400.0, last
+print("final exposition OK: %d families, flight_last_step %d"
+      % (len(parsed), last["flight_last_step"]))
+EOF
+
+echo "flight smoke OK: $out"
