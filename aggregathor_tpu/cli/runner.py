@@ -149,6 +149,31 @@ def build_parser():
              "engine, single process",
     )
     parser.add_argument(
+        "--step-deadline", type=float, default=None, metavar="SECONDS",
+        help="bounded-wait aggregation (parallel/bounded.py, docs/engine.md): "
+             "dispatch each worker's gradient as its own async submission "
+             "and close every round at this host-side deadline — workers "
+             "that miss it contribute NaN rows within the same declared-f "
+             "budget as Byzantine rows (timeouts + attacks <= f), land as "
+             "straggler_timeout forensics evidence, and sustained "
+             "over-budget timeouts are a guardian escalation input.  Needs "
+             "the flat engine, --unroll 1, a NaN-tolerant rule, and no "
+             "in-graph transport simulation (--UDP/non-straggler --chaos)",
+    )
+    parser.add_argument(
+        "--straggler-stall", type=float, default=0.0, metavar="SECONDS",
+        help="bounded-wait straggler injection: a worker drawn late holds "
+             "its submission this long before dispatching (the chaos "
+             "straggler regimes' wall-clock twin; with --chaos the per-"
+             "regime straggle rates schedule WHO is late, otherwise "
+             "--straggler-rate does)",
+    )
+    parser.add_argument(
+        "--straggler-rate", type=float, default=0.0, metavar="P",
+        help="bounded-wait: flat per-(step, worker) lateness probability "
+             "when no --chaos schedule provides regime rates",
+    )
+    parser.add_argument(
         "--backend-timeout", type=float, default=300.0, metavar="SECONDS",
         help="fail loudly if the accelerator backend does not initialize in "
              "this many seconds (a wedged chip otherwise hangs forever); "
@@ -671,6 +696,11 @@ def main(argv=None):
     )
     unroll = max(1, args.unroll)
 
+    # Bounded-wait mode flag (parallel/bounded.py), needed before the
+    # flight-recorder lane set: under a deadline the chaos schedule moves
+    # to the host clock, so the in-graph regime lane does not exist.
+    bounded_wait = args.step_deadline is not None or args.straggler_stall > 0
+
     # Flight recorder (obs/flight.py): the ring's lane set mirrors exactly
     # what the engine will compute (validated again by the engine itself).
     # Constructed once and shared across guardian rebuilds — the layout is
@@ -679,7 +709,7 @@ def main(argv=None):
     if args.flight:
         flight_rec = obs_flight.FlightRecorder(
             args.flight, n, probe=True, worker_metrics=args.worker_metrics,
-            chaos=bool(args.chaos), secure=args.secure,
+            chaos=bool(args.chaos) and not bounded_wait, secure=args.secure,
         )
         if args.flight < unroll:
             warning(
@@ -775,6 +805,56 @@ def main(argv=None):
                         "input stream; use --input-source stream" % args.experiment
                     )
 
+        # Bounded-wait aggregation (--step-deadline, parallel/bounded.py):
+        # per-worker async submissions against a host deadline; stalls
+        # without a deadline drive the SYNCHRONOUS baseline the straggler
+        # sweep compares against.  Validated before any compilation.
+        straggler_model = None
+        if bounded_wait:
+            from ..parallel.bounded import BoundedWaitStep, HostStragglerModel
+
+            if mesh_axes is not None:
+                raise UserException(
+                    "--step-deadline needs the flat engine (a sharded logical "
+                    "worker is a collective submesh; its submission cannot "
+                    "complete independently)"
+                )
+            if unroll > 1:
+                raise UserException(
+                    "--step-deadline closes every round on the host clock; "
+                    "a scanned --unroll chunk cannot be interrupted — use "
+                    "--unroll 1"
+                )
+            if args.input_source == "device":
+                raise UserException(
+                    "--step-deadline dispatches per-worker host batches; use "
+                    "--input-source stream"
+                )
+            if args.secure or args.secure_mask:
+                raise UserException(
+                    "--step-deadline + --secure is not implemented yet "
+                    "(digests would ride the per-worker submissions)"
+                )
+            if args.udp > 0:
+                raise UserException(
+                    "--step-deadline replaces the simulated lossy transport; "
+                    "drop --UDP (real timeouts produce the NaN rows)"
+                )
+            if args.worker_momentum is not None:
+                raise UserException(
+                    "--step-deadline does not carry worker momentum yet"
+                )
+            if jax.process_count() > 1:
+                raise UserException(
+                    "--step-deadline is single-process (the submission "
+                    "threads poll one host's device streams)"
+                )
+            if args.straggler_stall > 0 or args.straggler_rate > 0 or chaos is not None:
+                straggler_model = HostStragglerModel(
+                    n, args.straggler_stall, rate=args.straggler_rate,
+                    chaos=chaos, seed=args.seed,
+                )
+
         class TrainingStack:
             """The rebuildable half of the run: engine + jitted step/eval
             programs + optimizer, derived from an Overrides record.  A
@@ -810,16 +890,16 @@ def main(argv=None):
             ts.gar, ts.schedule, ts.tx = gar, schedule, tx
             ts.device_dataset = None
             ts.sampled_tail = None
+            ts.bounded_step = None
             if mesh_axes is not None:
-                # ---- fully-sharded engine (per-layer GAR on sharded grads) ----
-                from ..parallel.sharded_engine import ShardedRobustEngine
-
+                # ---- sharded mode of the ONE engine (per-layer GAR on
+                # sharded grads; docs/engine.md) ----
                 # ``vector`` (the flat default) means whole-vector selection,
-                # which the sharded engine spells ``global`` (one global (n, n)
+                # which the sharded mode spells ``global`` (one global (n, n)
                 # distance matrix accumulated across shards).
                 gran = "global" if args.granularity == "vector" else args.granularity
-                engine = ShardedRobustEngine(
-                    mesh, gar, nb_workers=n,
+                engine = RobustEngine(
+                    mesh, gar, nb_workers=n, sharding="sharded",
                     nb_real_byz=r, attack=attack, lossy_link=lossy,
                     granularity=gran, exchange_dtype=args.exchange_dtype,
                     worker_momentum=args.worker_momentum,
@@ -828,7 +908,7 @@ def main(argv=None):
                     quarantine_threshold=ov.quarantine_threshold,
                     # The sharded loss is a LOCAL PARTIAL under shard_map, so
                     # the engine applies l1/l2 analytically on the completed
-                    # gradients instead of wrapping the loss (see sharded_engine)
+                    # gradients instead of wrapping the loss (docs/engine.md)
                     l1_regularize=args.l1_regularize,
                     l2_regularize=args.l2_regularize,
                     chaos=chaos,
@@ -861,7 +941,9 @@ def main(argv=None):
                     granularity=args.granularity,
                     leaf_bucketing={"auto": "auto", "on": True, "off": False}[args.leaf_bucketing],
                     trace_ops=args.trace_ops,
-                    chaos=chaos,
+                    # under bounded-wait the straggler schedule moved to the
+                    # HOST clock (straggler_model); in-graph chaos is off
+                    chaos=None if bounded_wait else chaos,
                     secure=args.secure,
                     flight=flight_rec,
                 )
@@ -886,7 +968,18 @@ def main(argv=None):
                     )
 
                 state0 = make_fresh_state()
-                ts.step_fn = engine.build_step(loss_fn, tx)
+                if bounded_wait:
+                    # per-worker async submissions + deadline-closed rounds
+                    # (the guardian rebuild path constructs this exactly
+                    # like the fused step: one stack, one engine)
+                    ts.bounded_step = BoundedWaitStep(
+                        engine, loss_fn, tx, state0.params,
+                        deadline=args.step_deadline,
+                        straggler_model=straggler_model, registry=registry,
+                    )
+                    ts.step_fn = ts.bounded_step
+                else:
+                    ts.step_fn = engine.build_step(loss_fn, tx)
                 if args.input_source == "device":
                     # The whole train split lives on the accelerator; the
                     # unrolled branch dispatches the in-graph sampling trainer
@@ -1469,6 +1562,9 @@ def main(argv=None):
                 scalars["nb_quarantined"] = int(jax.device_get(metrics["nb_quarantined"]))
             if "chaos_regime" in metrics:
                 scalars["chaos_regime"] = int(jax.device_get(metrics["chaos_regime"]))
+            if "nb_timeouts" in metrics:
+                # bounded-wait deadline verdicts for this dispatch's step
+                scalars["straggler_timeouts"] = int(jax.device_get(metrics["nb_timeouts"]))
             if args.gar_probe:
                 scalars["gar_seconds"] = time_gar_probe(step)
             if flight_rec is not None:
@@ -1614,6 +1710,7 @@ def main(argv=None):
                 dist = fetch(pending_metrics.get("worker_sq_dist"))
                 rep = fetch(pending_metrics.get("worker_reputation"))
                 regime = fetch(pending_metrics.get("chaos_regime"))
+                timeouts = fetch(pending_metrics.get("straggler_timeout"))
                 probe = pending_metrics.get(health.PROBE_KEY)
                 nan_rows = (
                     fetch(probe.get("worker_nan_rows")) if probe is not None else None
@@ -1625,10 +1722,14 @@ def main(argv=None):
                         return None
                     return vector[None] if vector.ndim == 1 else vector
                 dist, rep, nan_rows = rows(dist), rows(rep), rows(nan_rows)
+                timeouts = rows(timeouts)
                 regime = None if regime is None else np.atleast_1d(regime)
                 nb = max(
-                    v.shape[0] for v in (dist, rep, nan_rows, regime) if v is not None
-                ) if any(v is not None for v in (dist, rep, nan_rows, regime)) else 0
+                    v.shape[0] for v in (dist, rep, nan_rows, regime, timeouts)
+                    if v is not None
+                ) if any(
+                    v is not None for v in (dist, rep, nan_rows, regime, timeouts)
+                ) else 0
                 for i in range(nb):
                     ridx = None if regime is None else int(regime[min(i, regime.shape[0] - 1)])
                     ledger.observe(
@@ -1644,6 +1745,9 @@ def main(argv=None):
                         # named forgery evidence from the submission
                         # authenticator (reject-and-name, secure/submit.py)
                         forgery=secure_verdicts.pop(pending_start + i + 1, None),
+                        # bounded-wait deadline verdicts (straggler_timeout
+                        # evidence; explains the timed-out rows' NaN flags)
+                        timeout=None if timeouts is None else timeouts[i],
                     )
 
         def probe_clean(dispatch_metrics):
@@ -1710,6 +1814,8 @@ def main(argv=None):
                     new_overrides = rung.apply(overrides)
                     with Context("escalate"):
                         new_ts = instrument_stack(build_training(new_overrides))
+                    if ts.bounded_step is not None:
+                        ts.bounded_step.close()  # retire the old pool
                     overrides, ts = new_overrides, new_ts
                     if custody is not None:
                         # manifests saved from here on sign the new spec
@@ -1778,6 +1884,9 @@ def main(argv=None):
             with trace.span("block.probe_fetch", cat="guardian"):
                 view = health.host_view(pending_metrics)
                 losses = np.atleast_1d(np.asarray(jax.device_get(pending_loss)))
+                timeouts = pending_metrics.get("nb_timeouts")
+                if timeouts is not None:
+                    timeouts = np.atleast_1d(np.asarray(jax.device_get(timeouts)))
             start = pending_start
             pending_loss = pending_metrics = None
             if view is None:  # engine built without the probe
@@ -1788,6 +1897,13 @@ def main(argv=None):
                 action = watchdog.observe(
                     start + i + 1, float(losses[i]), bool(finite[i]), float(spikes[i])
                 )
+                if action is None and timeouts is not None:
+                    # bounded-wait escalation input: timeouts beyond the
+                    # declared budget, sustained, roll back and climb the
+                    # ladder (f+K re-sizes the budget for the observed tail)
+                    action = watchdog.observe_timeouts(
+                        start + i + 1, int(timeouts[i]), overrides.f
+                    )
                 if action == "recovered":
                     info("guardian: recovered — %d healthy step(s) since the "
                          "last rollback" % guardian.recover_after)
@@ -2009,6 +2125,8 @@ def main(argv=None):
                 prefetcher.close()
             if chunk_pipeline is not None:
                 chunk_pipeline.close()
+            if ts.bounded_step is not None:
+                ts.bounded_step.close()
             eval_file.close()
             summaries.close()
             gap_close()

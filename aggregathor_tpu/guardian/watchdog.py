@@ -85,6 +85,7 @@ class Watchdog:
         self.recovering = False    # between a rollback and its recovery call
         self.cooldown_until = -1   # spike triggers suppressed below this step
         self.last_reason = None    # human-readable cause of the last rollback
+        self.timeout_streak = 0    # consecutive steps with timeouts beyond f
 
     @property
     def healthy(self):
@@ -129,6 +130,29 @@ class Watchdog:
             return "rollback"
         return None
 
+    def observe_timeouts(self, step, nb_timeouts, budget):
+        """Bounded-wait escalation input (parallel/bounded.py): timeouts
+        BEYOND the declared-f budget spend guarantee the rule does not
+        have — sustained for ``patience`` steps (and outside the rollback
+        cooldown, like the spike trigger) that is a rollback decision, and
+        the ladder's ``f+K`` rung re-sizes the budget for the observed
+        tail.  Timeouts within budget are the protocol working as designed
+        and reset the streak."""
+        if nb_timeouts <= budget:
+            self.timeout_streak = 0
+            return None
+        self.timeout_streak += 1
+        if step >= self.cooldown_until and self.timeout_streak >= self.config.patience:
+            self.last_reason = (
+                "straggler timeouts (%d) beyond the declared budget f=%d "
+                "sustained %d steps" % (nb_timeouts, budget, self.timeout_streak)
+            )
+            trace.instant("guardian.rollback_decision", cat="guardian",
+                          step=int(step), reason="straggler_timeouts",
+                          nb_timeouts=int(nb_timeouts), budget=int(budget))
+            return "rollback"
+        return None
+
     def note_rollback(self, restore_step):
         """Record that the runner executed a rollback landing at
         ``restore_step``; returns the 0-based attempt index (= the
@@ -139,6 +163,7 @@ class Watchdog:
         self.attempts += 1
         self.unhealthy_streak = 0
         self.healthy_streak = 0
+        self.timeout_streak = 0
         self.recovering = True
         grace = math.ceil(self.config.patience * self.config.backoff ** self.attempts)
         self.cooldown_until = restore_step + grace

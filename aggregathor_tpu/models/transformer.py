@@ -19,7 +19,7 @@ first-class citizens of the framework:
   autodiff flows backwards through the same ring (transpose of ppermute).
 
 Everything is written to run *inside* ``jax.shard_map`` (see
-parallel/sharded_engine.py) and degrades to plain single-device math when the
+parallel/engine.py, sharded mode) and degrades to plain single-device math when the
 mesh axes have size 1 — the same code path serves the 8-device CPU test mesh
 and a multi-host TPU pod.
 

@@ -6,7 +6,7 @@ and under ``--unroll`` the summary stream only ever sees the LAST sub-step
 of each chunk.  The flight recorder is the device-side half: a fixed-size
 ring of per-step lanes carried as a non-serialized ``TrainState`` side
 buffer and written inside the jitted step body itself (``parallel/
-engine.py`` / ``parallel/sharded_engine.py``), so every scanned step leaves
+engine.py``, both dataflows), so every scanned step leaves
 a row on the accelerator at zero host cost.  The host fetches the whole
 ring ONCE at summary cadence (one amortized copy instead of per-dispatch
 pulls) and dumps it post-mortem on guardian rollback or crash — exact

@@ -126,7 +126,8 @@ class ForensicsLedger:
 
     def __init__(self, nb_workers, run_id=None, distance_factor=4.0,
                  reputation_threshold=0.5, byzantine_fraction=0.5,
-                 rank_fraction=0.8, window=8, rank_alpha=0.005):
+                 rank_fraction=0.8, window=8, rank_alpha=0.005,
+                 straggler_fraction=0.25):
         if nb_workers < 1:
             raise ValueError("ForensicsLedger wants nb_workers >= 1")
         self.nb_workers = int(nb_workers)
@@ -137,6 +138,7 @@ class ForensicsLedger:
         self.rank_fraction = float(rank_fraction)
         self.window = int(window)
         self.rank_alpha = float(rank_alpha)
+        self.straggler_fraction = float(straggler_fraction)
         if self.window < 1:
             raise ValueError("ForensicsLedger wants window >= 1")
         #: [(step, {worker: set(evidence)}, regime, regime_desc)] — sparse:
@@ -154,18 +156,31 @@ class ForensicsLedger:
     # ingestion
 
     def observe(self, step, worker_sq_dist=None, worker_nan=None,
-                reputation=None, regime=None, regime_desc=None, forgery=None):
+                reputation=None, regime=None, regime_desc=None, forgery=None,
+                timeout=None):
         """One completed training step's diagnostics.  Every vector is
         length-n (or None when the engine did not compute it); non-finite
         ``worker_sq_dist`` entries are treated as masked (no ``distance``
         evidence — the NaN-row flag is the signal for dead rows).
         ``forgery`` is the submission authenticator's per-worker verdict
-        (True = this worker's tag failed verification this step)."""
+        (True = this worker's tag failed verification this step).
+        ``timeout`` is the bounded-wait protocol's deadline verdict
+        (parallel/bounded.py): a timed-out worker gets ``straggler_timeout``
+        evidence, and its NaN row is EXPLAINED by the timeout — it does not
+        double as ``nan_row`` strong evidence (late is not Byzantine; the
+        stragglers surface in the report's own ``stragglers`` list)."""
         suspects = {}
+        timed_out = None
+        if timeout is not None:
+            timed_out = np.asarray(timeout).reshape(-1).astype(bool)
+            self._check_len("timeout", timed_out)
 
         def mark(worker, kind):
             suspects.setdefault(int(worker), set()).add(kind)
 
+        if timed_out is not None:
+            for worker in np.nonzero(timed_out)[0]:
+                mark(worker, "straggler_timeout")
         if forgery is not None:
             forged = np.asarray(forgery).reshape(-1)
             self._check_len("forgery", forged)
@@ -195,6 +210,9 @@ class ForensicsLedger:
         if worker_nan is not None:
             nan_rows = np.asarray(worker_nan).reshape(-1)
             self._check_len("worker_nan", nan_rows)
+            if timed_out is not None:
+                # a timeout's NaN infill is accounted above, not as nan_row
+                nan_rows = nan_rows.astype(bool) & ~timed_out
             for worker in np.nonzero(nan_rows.astype(bool))[0]:
                 mark(worker, "nan_row")
         if reputation is not None:
@@ -313,6 +331,10 @@ class ForensicsLedger:
                     or rank_rate >= self.rank_fraction
                     or rank_p_value <= self.rank_alpha
                 )),
+                "timeout_rate": (
+                    evidence_counts.get("straggler_timeout", 0) / observed
+                    if observed else 0.0
+                ),
                 "evidence": evidence_counts,
                 "intervals": intervals,
             })
@@ -332,8 +354,16 @@ class ForensicsLedger:
                 "rank_fraction": self.rank_fraction,
                 "window": self.window,
                 "rank_alpha": self.rank_alpha,
+                "straggler_fraction": self.straggler_fraction,
             },
             "suspects": [w["worker"] for w in workers if w["byzantine"]],
+            # bounded-wait deadline offenders (parallel/bounded.py): named
+            # separately from Byzantine suspects — late is a capacity
+            # problem, not an integrity one, but both spend the f budget
+            "stragglers": [
+                w["worker"] for w in workers
+                if w["timeout_rate"] >= self.straggler_fraction
+            ],
             "workers": workers,
             "guardian_events": [
                 {"step": step, "kind": kind, "payload": payload}
@@ -418,6 +448,13 @@ def render_markdown(report):
                      % ", ".join(str(w) for w in suspects))
     else:
         lines.append("**No worker attributed Byzantine.**")
+    stragglers = report.get("stragglers", [])
+    if stragglers:
+        lines.append("")
+        lines.append(
+            "**Deadline offenders (bounded-wait): worker(s) %s.**"
+            % ", ".join(str(w) for w in stragglers)
+        )
     lines += [
         "",
         "| worker | suspect/observed | rate | verdict | evidence | intervals |",

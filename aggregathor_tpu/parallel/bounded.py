@@ -1,0 +1,281 @@
+"""Bounded-wait robust aggregation: never wait on the slowest worker.
+
+The fused SPMD step (``engine.py``) is synchronous by construction — one
+compiled program, one dispatch, the step takes as long as the slowest
+worker's gradient.  That is exactly the failure mode AggregaThor's robust
+GARs make unnecessary: a rule sized for ``f`` Byzantine rows absorbs a
+missing row for free (a lost UDP packet becomes a NaN row, SURVEY L1), so
+the aggregator may close the round on a DEADLINE instead of on the last
+submission (OptiReduce's tail-optimal allreduce, arXiv:2310.06993;
+"Efficient AllReduce with Stragglers", arXiv:2505.23523).
+
+:class:`BoundedWaitStep` is that protocol, host-orchestrated over the
+unified engine's two bounded-wait executables:
+
+1. ``engine.build_worker_grad``: ONE jitted per-worker submission
+   executable, dispatched n times per step on its own submission thread —
+   per-worker async device streams; each thread's dispatch returns
+   immediately and the submission "arrives" when its row materializes.
+2. The host polls arrivals against ``deadline`` seconds
+   (``concurrent.futures.wait``).  Workers that miss it are marked timed
+   out; their slot in the (n, d) submission buffer is garbage the
+   aggregator masks to NaN IN GRAPH — the same row the chaos straggler
+   simulation produced, now produced by the real clock.
+3. ``engine.build_bounded_aggregate``: one jitted aggregate+update
+   executable (omniscient attacks, quarantine, GAR, optax, probe, flight —
+   the fused step's shared code paths) consuming the submission buffer and
+   the arrival mask.
+
+**f-accounting** (docs/engine.md): timeout rows spend the same declared-f
+budget as attack rows.  With ``t`` timeouts and ``b`` Byzantine rows the
+rule's guarantee holds iff ``t + b <= f`` — size ``f`` for BOTH tails.
+A worker whose previous submission is still in flight when a new round
+opens is skipped for that round (an immediate timeout): the per-worker
+stream never queues more than one outstanding submission, which is what
+bounds memory AND models a genuinely slow worker missing consecutive
+rounds.
+
+Straggler injection (:class:`HostStragglerModel`) maps a chaos schedule's
+straggler regimes — or an explicit rate — to real wall-clock submission
+delays, which is how the chaos/ simulation becomes the thing the protocol
+is measured against (benchmarks/straggler_sweep.py).
+"""
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+import jax
+import numpy as np
+
+from ..obs import trace
+from ..utils import UserException
+
+
+class HostStragglerModel:
+    """Per-(step, worker) wall-clock submission delays.
+
+    Deterministic in (seed, step, worker) like every chaos stream: a worker
+    is late with the regime's ``straggler_rate`` (from ``chaos`` — a
+    schedule whose ONLY adversity is straggler regimes — or the flat
+    ``rate``), and a late worker sleeps ``stall_seconds`` before
+    dispatching.  ``nb_eligible`` restricts lateness to the first K global
+    workers (the schedule's ``straggle-workers`` knob / the --UDP first-k
+    convention)."""
+
+    def __init__(self, nb_workers, stall_seconds, rate=0.0, chaos=None,
+                 nb_eligible=0, seed=0):
+        self.nb_workers = int(nb_workers)
+        self.stall_seconds = float(stall_seconds)
+        self.rate = float(rate)
+        self.chaos = chaos
+        self.nb_eligible = int(nb_eligible)
+        self.seed = int(seed)
+        if chaos is not None:
+            if chaos.has_attacks or chaos.has_drop or chaos.has_forgery:
+                raise UserException(
+                    "bounded-wait consumes ONLY straggler regimes from the "
+                    "schedule (attack/drop/forge/tamper still need the "
+                    "in-graph simulation of the synchronous step)"
+                )
+            if not chaos.has_stragglers:
+                raise UserException(
+                    "the schedule has no straggler regime; drop --chaos or "
+                    "add one (e.g. '0:straggle=0.3')"
+                )
+            self.nb_eligible = chaos.stragglers.nb_eligible
+        if self.stall_seconds < 0.0:
+            raise UserException("straggler stall must be >= 0 seconds")
+        if not 0.0 <= self.rate <= 1.0:
+            raise UserException("straggler rate must lie in [0, 1]")
+        if self.stall_seconds == 0.0 and (self.rate > 0.0 or chaos is not None):
+            # a schedule/rate without a stall would silently inject nothing
+            # — the one misconfiguration on this path that wouldn't be loud
+            raise UserException(
+                "a straggler rate/schedule needs --straggler-stall > 0 "
+                "seconds to actually delay anyone"
+            )
+
+    def _rate_at(self, step):
+        if self.chaos is not None:
+            return float(self.chaos._straggler_rates[self.chaos.regime_at(step)])
+        return self.rate
+
+    def delay(self, step, worker):
+        """Seconds worker ``worker`` holds its step-``step`` submission."""
+        rate = self._rate_at(step)
+        if rate <= 0.0 or self.stall_seconds <= 0.0:
+            return 0.0
+        if self.nb_eligible and worker >= self.nb_eligible:
+            return 0.0
+        # counter-based draw: reproducible and order-independent across the
+        # submission threads (one Generator shared by n threads would be
+        # neither)
+        u = np.random.default_rng(
+            (self.seed, int(step), int(worker))
+        ).random()
+        return self.stall_seconds if u < rate else 0.0
+
+
+class BoundedWaitStep:
+    """Host-orchestrated bounded-wait training step over a flat engine.
+
+    ``step(state, batch) -> (state, metrics)`` — the same contract as the
+    fused ``engine.build_step`` product, so the runner's train loop,
+    divergence lag, forensics feed and guardian plumbing consume it
+    unchanged.  ``deadline=None`` degrades to the synchronous protocol
+    (wait for every submission) — the baseline the straggler sweep
+    measures against.
+    """
+
+    def __init__(self, engine, loss_fn, tx, params_template, deadline=None,
+                 straggler_model=None, registry=None):
+        if deadline is not None and deadline <= 0.0:
+            raise UserException("--step-deadline must be > 0 seconds")
+        self.engine = engine
+        self.nb_workers = engine.nb_workers
+        self.deadline = deadline
+        self.model = straggler_model
+        self.grad_fn = engine.build_worker_grad(loss_fn)
+        self.agg_fn = engine.build_bounded_aggregate(tx, params_template)
+        self.pool = ThreadPoolExecutor(
+            max_workers=self.nb_workers, thread_name_prefix="bw-submit"
+        )
+        # one outstanding submission per worker: a worker still in flight
+        # when a new round opens is skipped (= an immediate timeout)
+        self._in_flight = [None] * self.nb_workers
+        self._round = 0
+        self._round_lock = threading.Lock()
+        # the deadline engages from the SECOND round: the first dispatch
+        # compiles both executables, and charging the compile against the
+        # deadline would time out every worker of step 0 (the perf report
+        # excludes the compile step for the same reason)
+        self._warm = False
+        # one committed NaN row + zero loss reused for every missing slot
+        d = sum(
+            int(np.prod(np.shape(leaf)))
+            for leaf in jax.tree_util.tree_leaves(params_template)
+        )
+        row_dtype = np.dtype(engine.exchange_dtype or np.float32)
+        self._nan_template = (
+            np.zeros((), np.float32), np.full((d,), np.nan, row_dtype),
+        )
+        self.timeouts_total = np.zeros((self.nb_workers,), np.int64)
+        self._c_timeouts = self._c_rounds = self._g_deadline = None
+        self._c_late = None
+        if registry is not None:
+            self._c_timeouts = registry.counter(
+                "straggler_timeouts_total",
+                "Worker submissions that missed the step deadline",
+                labelnames=("worker",),
+            )
+            self._c_late = registry.counter(
+                "straggler_skipped_rounds_total",
+                "Rounds skipped because the worker's previous submission "
+                "was still in flight",
+                labelnames=("worker",),
+            )
+            self._c_rounds = registry.counter(
+                "bounded_wait_rounds_total", "Bounded-wait aggregation rounds"
+            )
+            self._g_deadline = registry.gauge(
+                "bounded_wait_deadline_seconds", "Configured step deadline"
+            )
+            if deadline is not None:
+                self._g_deadline.set(float(deadline))
+
+    # ------------------------------------------------------------------ #
+
+    def _submit_one(self, round_id, step_idx, worker, params, rng, worker_batch):
+        """Submission-thread body: injected stall, then dispatch + drain.
+        Returns (worker, loss, row) or None when the round already closed
+        (the dispatch would read donated buffers)."""
+        if self.model is not None:
+            stall = self.model.delay(step_idx, worker)
+            if stall:
+                time.sleep(stall)
+        with self._round_lock:
+            if round_id != self._round:
+                return None  # round closed while we stalled: don't dispatch
+            out = self.grad_fn(params, worker_batch, rng, step_idx, worker)
+        try:
+            loss, row = jax.block_until_ready(out)
+        except Exception:
+            return None  # buffers reclaimed under a concurrently-closed round
+        return worker, loss, row
+
+    def __call__(self, state, batch):
+        n = self.nb_workers
+        # the previous dispatch materialized the step counter; this read is
+        # a host copy, not a device sync
+        step_idx = int(jax.device_get(state.step))
+        params, rng = state.params, state.rng
+        futures, skipped = {}, []
+        for w in range(n):
+            prev = self._in_flight[w]
+            if prev is not None and not prev.done():
+                # still submitting a previous round: this worker misses the
+                # current one outright (bounded queue, see module docstring)
+                skipped.append(w)
+                continue
+            self._in_flight[w] = self.pool.submit(
+                self._submit_one, self._round, step_idx, w, params, rng,
+                jax.tree_util.tree_map(lambda x, _w=w: x[_w], batch),
+            )
+            futures[w] = self._in_flight[w]
+        deadline = self.deadline if self._warm else None
+        self._warm = True
+        with trace.span("bounded_wait.collect", cat="train"):
+            pending = set(futures.values())
+            if deadline is None:
+                if pending:
+                    wait(pending)
+            else:
+                deadline_at = time.monotonic() + deadline
+                while pending:
+                    remaining = deadline_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    done, pending = wait(
+                        pending, timeout=remaining, return_when=FIRST_COMPLETED
+                    )
+        # close the round: submissions that wake up from now on must not
+        # dispatch against buffers the aggregate below will donate
+        with self._round_lock:
+            self._round += 1
+        arrived = np.zeros((n,), bool)
+        losses, rows = [], []
+        for w in range(n):
+            fut = futures.get(w)
+            result = fut.result() if (fut is not None and fut.done()) else None
+            if result is not None:
+                arrived[w] = True
+                losses.append(result[1])
+                rows.append(result[2])
+            else:
+                losses.append(self._nan_template[0])
+                rows.append(self._nan_template[1])
+        self.timeouts_total += ~arrived
+        if self._c_timeouts is not None:
+            for w in np.nonzero(~arrived)[0]:
+                self._c_timeouts.labels(worker=str(int(w))).inc()
+            for w in skipped:
+                self._c_late.labels(worker=str(int(w))).inc()
+            self._c_rounds.inc()
+        import jax.numpy as jnp
+
+        return self.agg_fn(
+            state, jnp.stack(rows), jnp.stack(losses),
+            jnp.asarray(arrived),
+        )
+
+    def _cache_size(self):
+        """Compile-count surface for the zero-recompile assertions AND the
+        runner's CompileWatch: the MAX over the two bounded-wait
+        executables, so steady state reads 1 like every fused step (a sum
+        would read 2 and trip the watch's cache_size > 1 retrace alarm on
+        the expected first compile)."""
+        return max(self.grad_fn._cache_size(), self.agg_fn._cache_size())
+
+    def close(self):
+        self.pool.shutdown(wait=False, cancel_futures=True)

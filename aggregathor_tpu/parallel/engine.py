@@ -36,7 +36,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 import optax
 
 from .. import config
@@ -46,7 +46,34 @@ from ..gars.common import centered_gram_sq_distances
 from ..obs import trace
 from ..utils import UserException
 from ..utils import compat
-from .mesh import worker_axis
+from .mesh import model_axis, pipe_axis, worker_axis
+
+#: the in-group (within one logical worker's submesh) mesh axes of the
+#: leafwise-sharded mode — collectives over these complete replicated-leaf
+#: gradients and per-bucket distances; both are size 1 in flat mode
+_IN_GROUP_AXES = (pipe_axis, model_axis)
+
+
+def _is_spec(x):
+    return x is None or isinstance(x, P)
+
+
+def _spec_axis_names(spec):
+    names = set()
+    for entry in spec or ():
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.update(entry)
+        else:
+            names.add(entry)
+    return names
+
+
+def _replication_axes(spec):
+    """In-group mesh axes over which a leaf with this spec is replicated."""
+    names = _spec_axis_names(spec)
+    return tuple(a for a in _IN_GROUP_AXES if a not in names)
 
 
 def validate_reputation_args(gar, reputation_decay, quarantine_threshold):
@@ -159,15 +186,82 @@ def _partial_pairwise_sq_distances(block):
 
 
 class RobustEngine:
-    """Builds jitted robust train/eval steps over a (worker, model) mesh."""
+    """The ONE sharding-polymorphic robust engine (docs/engine.md).
 
-    def __init__(self, mesh, gar, nb_workers, nb_real_byz=0, attack=None, lossy_link=None,
+    Two gradient dataflows behind one constructor, selected by ``sharding``:
+
+    - ``"flat"`` (default on a trivial in-group mesh): one logical worker =
+      one vmapped slot on the ``worker`` axis, gradients flattened to (k, d)
+      rows, all_to_all reshard to dimension-sharded column blocks, blockwise
+      GAR — the module-docstring dataflow.  Granularities ``vector``/``leaf``.
+    - ``"sharded"``: one logical worker = a (pipe x model) submesh running a
+      pipelined/tensor-parallel replica; robust aggregation runs per
+      parameter bucket directly on the *sharded* gradients, the (n, d)
+      matrix never materialized.  Granularities ``layer``/``leaf``/``global``.
+
+    Everything that is not the gradient dataflow — knob validation, the
+    chaos schedule, reputation/quarantine, worker momentum, the CLEVER
+    carry, authenticated submission, the health probe, the flight recorder,
+    and the whole step epilogue (``_finalize_step``) — exists ONCE and is
+    shared by both bodies.  The two perturbation/submission pipelines stay
+    separate on purpose: their PRNG stream layouts differ (flat folds per
+    worker over the flattened row; sharded folds per (worker, leaf)), and
+    bit-compatibility with existing runs pins both.
+    """
+
+    def __init__(self, mesh, gar, nb_workers=None, nb_real_byz=0, attack=None, lossy_link=None,
                  exchange_dtype=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
-                 granularity="vector", leaf_bucketing="auto", trace_ops=False, chaos=None,
-                 health_probe=True, secure=False, flight=None):
+                 granularity=None, leaf_bucketing="auto", trace_ops=False, chaos=None,
+                 health_probe=True, secure=False, flight=None,
+                 l1_regularize=None, l2_regularize=None, sharding=None):
         self.mesh = mesh
         self.gar = gar
+        # Mode resolution: explicit ``sharding`` wins; otherwise a mesh with
+        # nontrivial in-group (pipe/model) axes means the leafwise-sharded
+        # dataflow (a flat engine cannot use those devices at all).
+        if sharding is None:
+            sharding = (
+                "sharded"
+                if mesh.shape[pipe_axis] * mesh.shape[model_axis] > 1 else "flat"
+            )
+        if sharding not in ("flat", "sharded"):
+            raise UserException(
+                "sharding must be 'flat' or 'sharded' (got %r)" % (sharding,)
+            )
+        self.sharded = sharding == "sharded"
+        if granularity is None:
+            granularity = "layer" if self.sharded else "vector"
+        if self.sharded:
+            if granularity not in ("layer", "leaf", "global"):
+                raise UserException(
+                    "sharded granularity must be layer, leaf or global (got %r)"
+                    % (granularity,)
+                )
+            if batch_transform is not None:
+                raise UserException(
+                    "batch_transform is a flat-engine feature (the sharded "
+                    "batches flow through the pipeline stages)"
+                )
+            if trace_ops:
+                raise UserException(
+                    "trace_ops narrates the flat step body only; use --trace "
+                    "for a profiler window on the sharded engine"
+                )
+        else:
+            if granularity not in ("vector", "leaf"):
+                raise UserException(
+                    "granularity must be vector or leaf (got %r); layer/global "
+                    "need the sharded mode (sharding='sharded')" % (granularity,)
+                )
+            if l1_regularize or l2_regularize:
+                raise UserException(
+                    "the flat engine takes l1/l2 inside loss_fn (the per-worker "
+                    "loss is global there); l1_regularize/l2_regularize are the "
+                    "sharded engine's analytic equivalent"
+                )
+        if nb_workers is None:
+            nb_workers = mesh.shape[worker_axis]
         self.nb_workers = int(nb_workers)
         self.nb_real_byz = int(nb_real_byz)
         self.attack = attack
@@ -213,15 +307,44 @@ class RobustEngine:
         self.reputation_decay, self.quarantine_threshold = validate_reputation_args(
             gar, reputation_decay, quarantine_threshold
         )
-        # granularity:leaf applies the rule PER PARAMETER LEAF (per-layer
-        # selection — the sharded engine's semantics on a plain worker mesh,
-        # including n vmapped workers on one chip).  Memory shifts from the
-        # dimension-sharded O(d) blocks to one (n, d_leaf) gather at a time,
-        # and distance work is replicated per device instead of sharded —
-        # the price of letting every layer pick its own honest set.
-        if granularity not in ("vector", "leaf"):
-            raise UserException("granularity must be vector or leaf (got %r)" % (granularity,))
+        # Flat granularity:leaf applies the rule PER PARAMETER LEAF (per-
+        # layer selection — the sharded mode's semantics on a plain worker
+        # mesh, including n vmapped workers on one chip).  Memory shifts
+        # from the dimension-sharded O(d) blocks to one (n, d_leaf) gather
+        # at a time, and distance work is replicated per device instead of
+        # sharded — the price of letting every layer pick its own honest
+        # set.  Sharded granularities were validated above.
         self.granularity = granularity
+        if self.sharded:
+            if granularity == "global" and (gar.uses_axis or gar.uses_key) and not gar.needs_distances:
+                # The global path concatenates DISTANCES across leaves;
+                # iterative rules would need their per-iteration row norms
+                # accumulated across every leaf instead, which the per-leaf
+                # loop cannot do — refuse rather than silently degrade to
+                # per-leaf semantics.
+                raise UserException(
+                    "granularity:global is not supported for %s (whole-vector "
+                    "norms across leaves are not implemented); use "
+                    "granularity:layer" % type(gar).__name__
+                )
+            if gar.nb_workers != self.nb_workers:
+                raise UserException(
+                    "GAR was built for n=%d but the mesh worker axis is %d"
+                    % (gar.nb_workers, self.nb_workers)
+                )
+        # l1/l2 regularization (reference: graph.py:125-139).  The flat
+        # engine wraps the per-worker loss; under the sharded shard_map the
+        # loss is a LOCAL PARTIAL, so a parameter-norm term in the loss
+        # would be counted once per replicating device.  The sharded body
+        # instead applies the reg gradient ANALYTICALLY (l1*sign(p) +
+        # 2*l2*p, elementwise on each shard) to the psum-completed
+        # gradients — exact, shard-local, no double counting — and adds the
+        # correctly replication-scaled norm to the reported loss.
+        self.l1_regularize = float(l1_regularize) if l1_regularize else None
+        self.l2_regularize = float(l2_regularize) if l2_regularize else None
+        # Captured by the sharded init_state for put_state (checkpoint
+        # restore re-sharding).
+        self._state_shardings = None
         # Two numerically-equivalent leaf implementations (identical
         # selections and PRNG keys; values agree to float tolerance —
         # vmapped reductions need not lower bit-exactly), dispatched by backend
@@ -255,7 +378,11 @@ class RobustEngine:
         # float32 normalizes to None (no quantization path compiled in).
         dt = jnp.dtype(exchange_dtype) if exchange_dtype else None
         self.exchange_dtype = None if dt == jnp.float32 else dt
-        self.nb_devices = mesh.shape[worker_axis]
+        # Logical workers are decoupled from worker-axis slots in BOTH
+        # modes: k = n/W workers are vmapped per slot (flat: per device;
+        # sharded: per (pipe x model) submesh).  ``nb_mesh_workers`` is the
+        # historical sharded-mode name for the same axis size.
+        self.nb_devices = self.nb_mesh_workers = mesh.shape[worker_axis]
         if self.nb_workers % self.nb_devices != 0:
             raise UserException(
                 "nb_workers (%d) must be a multiple of the worker mesh axis (%d)"
@@ -673,6 +800,90 @@ class RobustEngine:
         return agg, participation, wdist, rep_dist
 
     # ------------------------------------------------------------------ #
+    # the step epilogue — ONE implementation for both dataflows
+
+    def _finalize_step(self, state, *, params, opt_state, new_carry,
+                       new_momentum, new_momentum_steps, total_loss,
+                       update_norm, worker_nan, rep_dist, wdist,
+                       participation, secure_metrics, ridx):
+        """Everything after the optimizer update, shared by the flat and the
+        sharded step bodies (and the bounded-wait aggregator): reputation
+        EMA, health probe, the metrics dict, and the flight-recorder write.
+        Callers pass values that are already replicated/psum-completed for
+        their dataflow; this method adds no collectives."""
+        new_reputation = state.reputation
+        if self.reputation_decay is not None:
+            # Rank signal on the RAW submissions (post-ALL-attacks,
+            # pre-quarantine): 1 if among the n-f closest to the applied
+            # aggregate AND finite — NaN-infilled lossy rows read +inf
+            # -> signal 0 (the finiteness gate stops +inf index-ties
+            # from boosting low-index dead workers).
+            from ..gars.common import nonfinite_to_inf, smallest_k_mask
+
+            signal = smallest_k_mask(
+                nonfinite_to_inf(rep_dist),
+                self.nb_workers - self.gar.nb_byz_workers,
+            ).astype(jnp.float32) * jnp.isfinite(rep_dist).astype(jnp.float32)
+            beta = self.reputation_decay
+            new_reputation = beta * state.reputation + (1.0 - beta) * signal
+        new_loss_ema = state.loss_ema
+        probe_fields = None
+        if self.health_probe:
+            from ..guardian import probe as health
+
+            probe_fields = health.probe_metrics(
+                total_loss, update_norm,
+                health.spike_score(total_loss, state.loss_ema), worker_nan,
+            )
+            new_loss_ema = health.update_loss_ema(state.loss_ema, total_loss)
+        new_state = state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state,
+            carry=new_carry, momentum=new_momentum,
+            momentum_steps=new_momentum_steps,
+            reputation=new_reputation, loss_ema=new_loss_ema,
+        )
+        metrics = {
+            "total_loss": total_loss,
+            "grad_norm": update_norm,
+        }
+        if probe_fields is not None:
+            from ..guardian import probe as health
+
+            metrics[health.PROBE_KEY] = probe_fields
+        if secure_metrics is not None:
+            metrics["secure"] = secure_metrics
+        if ridx is not None:
+            # replicated scalar (a pure function of the replicated step)
+            # — the observability layer's regime column
+            metrics["chaos_regime"] = ridx
+        if self.worker_metrics:
+            # Suspicion diagnostics: squared distance of each worker's
+            # gradient to the aggregate (universal), plus the rule's own
+            # per-worker participation weight when it selects by worker.
+            metrics["worker_sq_dist"] = wdist
+            if participation is not None:
+                metrics["worker_participation"] = participation
+            if self.reputation_decay is not None:
+                metrics["worker_reputation"] = new_reputation
+                if self.quarantine_threshold:
+                    metrics["nb_quarantined"] = jnp.sum(
+                        quarantine_mask(
+                            state.reputation, self.quarantine_threshold,
+                            self.gar.nb_byz_workers,
+                        ).astype(jnp.int32)
+                    )
+        if self.flight is not None:
+            # In-scan flight-recorder write (obs/flight.py): each lane
+            # stores the exact traced value the metrics dict carries,
+            # so ring rows are bit-identical to per-step metrics by
+            # construction.
+            new_state = new_state.replace(
+                flight=self.flight.record(state.flight, state.step, metrics)
+            )
+        return new_state, metrics
+
+    # ------------------------------------------------------------------ #
+    # the flat dataflow
 
     def _state_spec(self):
         """PartitionSpec prefix tree for TrainState: everything replicated
@@ -690,7 +901,7 @@ class RobustEngine:
             flight=P() if self.flight is not None else None,
         )
 
-    def _make_body(self, loss_fn, tx):
+    def _make_flat_body(self, loss_fn, tx):
         """The per-step SPMD body shared by build_step and build_multi_step."""
         W = self.nb_devices
 
@@ -769,21 +980,6 @@ class RobustEngine:
                     rep_dist = jnp.sum(rdiff * rdiff, axis=1)
                     if W > 1:
                         rep_dist = jax.lax.psum(rep_dist, worker_axis)
-            new_reputation = state.reputation
-            if self.reputation_decay is not None:
-                # Rank signal on the RAW submissions (post-ALL-attacks,
-                # pre-quarantine): 1 if among the n-f closest to the applied
-                # aggregate AND finite — NaN-infilled lossy rows read +inf
-                # -> signal 0 (the finiteness gate stops +inf index-ties
-                # from boosting low-index dead workers).
-                from ..gars.common import nonfinite_to_inf, smallest_k_mask
-
-                signal = smallest_k_mask(
-                    nonfinite_to_inf(rep_dist),
-                    self.nb_workers - self.gar.nb_byz_workers,
-                ).astype(jnp.float32) * jnp.isfinite(rep_dist).astype(jnp.float32)
-                beta = self.reputation_decay
-                new_reputation = beta * state.reputation + (1.0 - beta) * signal
             mark("aggregate done: |agg| {g}", g=jnp.linalg.norm(agg))
             agg_tree = flatmap.inflate(agg)
             updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
@@ -791,12 +987,8 @@ class RobustEngine:
             mark("apply done: |p0| {p}",
                  p=jnp.linalg.norm(jax.tree_util.tree_leaves(params)[0]))
             total_loss = jax.lax.psum(jnp.sum(losses), worker_axis) if W > 1 else jnp.sum(losses)
-            update_norm = jnp.linalg.norm(agg)
-            new_loss_ema = state.loss_ema
-            probe_fields = None
+            worker_nan = None
             if self.health_probe:
-                from ..guardian import probe as health
-
                 # Per-worker NaN-row flags measure the POST-TRANSPORT
                 # submissions (what the aggregation actually received:
                 # lossy NaN infill, dropped stragglers, inf attacks) —
@@ -808,22 +1000,7 @@ class RobustEngine:
                     )
                 else:
                     worker_nan = local_bad
-                probe_fields = health.probe_metrics(
-                    total_loss, update_norm,
-                    health.spike_score(total_loss, state.loss_ema), worker_nan,
-                )
-                new_loss_ema = health.update_loss_ema(state.loss_ema, total_loss)
-            new_state = state.replace(
-                step=state.step + 1, params=params, opt_state=opt_state,
-                carry=new_carry, momentum=new_momentum, momentum_steps=new_momentum_steps,
-                reputation=new_reputation, loss_ema=new_loss_ema,
-            )
-            metrics = {
-                "total_loss": total_loss,
-                "grad_norm": update_norm,
-            }
-            if probe_fields is not None:
-                metrics[health.PROBE_KEY] = probe_fields
+            secure_metrics = None
             if secure_info is not None:
                 # Submission authentication material for the host-side
                 # sign/verify (secure/submit.py): per-worker digests of what
@@ -835,43 +1012,22 @@ class RobustEngine:
                         return gathered.reshape((self.nb_workers,) + local.shape[1:])
                     return local
 
-                metrics["secure"] = {
+                secure_metrics = {
                     name: gather_workers(value)
                     for name, value in secure_info.items()
                 }
-            if ridx is not None:
-                # replicated scalar (a pure function of the replicated step)
-                # — the observability layer's regime column
-                metrics["chaos_regime"] = ridx
-            if self.worker_metrics:
-                # Suspicion diagnostics: squared distance of each worker's
-                # gradient to the aggregate (universal), plus the rule's own
-                # per-worker participation weight when it selects by worker.
-                metrics["worker_sq_dist"] = wdist
-                if participation is not None:
-                    metrics["worker_participation"] = participation
-                if self.reputation_decay is not None:
-                    metrics["worker_reputation"] = new_reputation
-                    if self.quarantine_threshold:
-                        metrics["nb_quarantined"] = jnp.sum(
-                            quarantine_mask(
-                                state.reputation, self.quarantine_threshold,
-                                self.gar.nb_byz_workers,
-                            ).astype(jnp.int32)
-                        )
-            if self.flight is not None:
-                # In-scan flight-recorder write (obs/flight.py): each lane
-                # stores the exact traced value the metrics dict carries,
-                # so ring rows are bit-identical to per-step metrics by
-                # construction.
-                new_state = new_state.replace(
-                    flight=self.flight.record(state.flight, state.step, metrics)
-                )
-            return new_state, metrics
+            return self._finalize_step(
+                state, params=params, opt_state=opt_state, new_carry=new_carry,
+                new_momentum=new_momentum, new_momentum_steps=new_momentum_steps,
+                total_loss=total_loss, update_norm=jnp.linalg.norm(agg),
+                worker_nan=worker_nan, rep_dist=rep_dist, wdist=wdist,
+                participation=participation, secure_metrics=secure_metrics,
+                ridx=ridx,
+            )
 
         return body
 
-    def build_step(self, loss_fn, tx):
+    def _flat_build_step(self, loss_fn, tx):
         """Build the jitted robust training step.
 
         Args:
@@ -881,7 +1037,7 @@ class RobustEngine:
           step(state, batch) -> (state, metrics) with ``batch`` pytrees of
           leading dimension nb_workers (worker-major), sharded over the mesh.
         """
-        body = self._make_body(loss_fn, tx)
+        body = self._make_flat_body(loss_fn, tx)
         sharded = compat.shard_map(
             body,
             mesh=self.mesh,
@@ -897,7 +1053,7 @@ class RobustEngine:
             "train_step.dispatch", jax.jit(sharded, donate_argnums=(0,)), cat="train"
         )
 
-    def build_multi_step(self, loss_fn, tx, repeat_steps=None):
+    def _flat_build_multi_step(self, loss_fn, tx, repeat_steps=None):
         """Build a jitted K-step trainer: one dispatch runs a whole scan.
 
         Per-step host dispatch dominates wall time for small models (the
@@ -912,7 +1068,7 @@ class RobustEngine:
           device-resident worker-major batch for K steps (no K-fold host
           transfer; what the throughput bench uses).
         """
-        step_body = self._make_body(loss_fn, tx)
+        step_body = self._make_flat_body(loss_fn, tx)
 
         if repeat_steps is None:
 
@@ -969,7 +1125,7 @@ class RobustEngine:
         same key.  Device-side augmentation (``batch_transform``) composes
         unchanged: it runs inside the step body on the sampled batch.
         """
-        step_body = self._make_body(loss_fn, tx)
+        step_body = self._make_flat_body(loss_fn, tx)
         k = self.workers_per_device
         nb_steps = int(repeat_steps)
         batch_size = int(batch_size)
@@ -1007,7 +1163,7 @@ class RobustEngine:
             jax.jit(sharded, donate_argnums=(0,)), cat="train",
         )
 
-    def build_gar_probe(self, d, seed=0):
+    def _flat_build_gar_probe(self, d, seed=0):
         """Jitted GAR-only executable at the engine's exact (n, d) and
         sharding — the measurement instrument behind the runner's
         ``gar_seconds_total`` / ``gar.aggregate`` telemetry.
@@ -1090,7 +1246,7 @@ class RobustEngine:
         )
         return trace.traced("eval_step.dispatch", jax.jit(sharded), cat="eval")
 
-    def build_eval(self, metric_fn):
+    def _flat_build_eval(self, metric_fn):
         """Like ``build_eval_sums`` but divides, returning per-batch means."""
         eval_sums = self.build_eval_sums(metric_fn)
 
@@ -1143,7 +1299,7 @@ class RobustEngine:
             return jax.device_put(array_or_none, spec)
         return jax.jit(lambda: jnp.zeros((self.nb_workers, d), jnp.float32), out_shardings=spec)()
 
-    def put_state(self, state):
+    def _flat_put_state(self, state):
         """Device_put a TrainState with the engine's state sharding — fully
         replicated except the worker-sharded side buffers (restore path)."""
         carry, momentum = state.carry, state.momentum
@@ -1154,7 +1310,7 @@ class RobustEngine:
             momentum = self._worker_sharded(momentum)
         return placed.replace(carry=carry, momentum=momentum)
 
-    def init_state(self, params, tx, seed=0):
+    def _flat_init_state(self, params, tx, seed=0):
         """Create a replicated TrainState, plus zeroed worker-sharded side
         buffers when enabled: the CLEVER carry (packets lost before any
         gradient was received read as zero contributions, like the
@@ -1186,3 +1342,981 @@ class RobustEngine:
                 flight=self.replicate(self.flight.init_buffers())
             )
         return state
+
+    # ------------------------------------------------------------------ #
+    # the leafwise-sharded dataflow (logical worker = (pipe x model) submesh)
+
+    def _sharded_init_state(self, init_fn, specs, tx, seed=0):
+        """Create the sharded TrainState.
+
+        Args:
+          init_fn: key -> global parameter pytree (e.g. transformer.init_params).
+          specs:   matching pytree of PartitionSpecs (transformer.param_specs).
+          tx:      optax GradientTransformation.
+        """
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_is_spec)
+        params = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(seed))
+        rep = NamedSharding(self.mesh, P())
+        # Optimizer state must come out with EXPLICIT NamedShardings: optax
+        # buffers that mirror the params (adam's mu/nu, momentum's trace —
+        # they share the params' treedef) take the params' layouts, every
+        # other allocation (schedule counts etc.) replicates.  Relying on
+        # ambient-mesh propagation instead is version-fragile: on older JAX
+        # there is no ambient mesh and jit commits fresh outputs to a single
+        # device, which the spec-deriving build_step cannot consume.
+        opt_shapes = jax.eval_shape(tx.init, params)
+        params_treedef = jax.tree_util.tree_structure(params)
+        param_shardings = jax.tree.map(lambda p: p.sharding, params)
+
+        def params_like(node):
+            try:
+                return jax.tree_util.tree_structure(node) == params_treedef
+            except TypeError:
+                return False
+
+        if params_treedef.num_leaves == 1:
+            # a single-leaf treedef would "match" every leaf, so identify
+            # the params-mirroring buffers by shape/dtype identity instead
+            only = jax.tree_util.tree_leaves(params)[0]
+            opt_shardings = jax.tree.map(
+                lambda s: only.sharding
+                if (s.shape, s.dtype) == (only.shape, only.dtype) else rep,
+                opt_shapes,
+            )
+        else:
+            opt_shardings = jax.tree.map(
+                lambda node: param_shardings if params_like(node) else rep,
+                opt_shapes, is_leaf=params_like,
+            )
+        with compat.set_mesh(self.mesh):  # new-JAX path also wants the mesh ambient
+            opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
+
+        def per_worker_zeros():
+            m_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, P(worker_axis, *tuple(s))),
+                specs, is_leaf=_is_spec,
+            )
+            return jax.jit(
+                lambda: jax.tree.map(
+                    lambda p: jnp.zeros((self.nb_workers,) + p.shape, jnp.float32), params
+                ),
+                out_shardings=m_shardings,
+            )()
+
+        momentum = momentum_steps = carry = reputation = loss_ema = None
+        flight = None
+        if self.worker_momentum is not None:
+            momentum = per_worker_zeros()
+            momentum_steps = jax.device_put(jnp.zeros((), jnp.int32), rep)
+        if self.carries_gradients:
+            carry = per_worker_zeros()
+        if self.reputation_decay is not None:
+            reputation = jax.device_put(jnp.ones((self.nb_workers,), jnp.float32), rep)
+        if self.health_probe:
+            from ..guardian.probe import EMA_UNSET
+
+            loss_ema = jax.device_put(jnp.float32(EMA_UNSET), rep)
+        if self.flight is not None:
+            # empty replicated ring, every slot tagged invalid (step -1)
+            flight = jax.device_put(self.flight.init_buffers(), rep)
+        state = TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            params=params,
+            opt_state=opt_state,
+            rng=jax.device_put(jax.random.PRNGKey(seed), rep),
+            carry=carry,
+            momentum=momentum,
+            momentum_steps=momentum_steps,
+            reputation=reputation,
+            loss_ema=loss_ema,
+            flight=flight,
+        )
+        # Remember the layout for put_state (checkpoint restore re-sharding).
+        self._state_shardings = jax.tree.map(lambda a: a.sharding, state)
+        return state
+
+    def _sharded_put_state(self, state):
+        """Re-shard a (possibly host-resident) state onto this mesh with the
+        layout ``init_state`` established — the checkpoint-restore path
+        (cli/runner.py) round-trips state through the host and needs the
+        sharded placement back.  Leaves that are already live device arrays
+        with the right sharding pass through unchanged."""
+        if self._state_shardings is None:
+            raise RuntimeError("put_state needs init_state to have run first")
+        return jax.tree.map(jax.device_put, state, self._state_shardings)
+
+    def _perturb(self, g, spec, key, widx, previous=None, ridx=None, late=None):
+        """Worker-local attack + lossy link + chaos regime on this worker's
+        own shard (the sharded twin of ``_perturb_local``'s head; kept
+        separate because the PRNG stream is keyed per (worker, leaf) here).
+
+        Returns (perturbed leaf, post-transport leaf) — the latter is what
+        "the receiver saw", the stale value a lost packet keeps under CLEVER
+        and a stale-mode straggler keeps re-submitting.  ``late`` is the
+        worker's per-STEP lateness flag (drawn once in the body, shared by
+        every leaf: a late worker misses the deadline for its whole
+        gradient).
+        """
+        flat = g.reshape(-1)
+        prev_flat = previous.reshape(-1) if previous is not None else None
+        if self.attack is not None and not self.attack.omniscient:
+            forged = self.attack.apply_local(flat, jax.random.fold_in(key, 1))
+            flat = jnp.where(widx < self.nb_real_byz, forged, flat)
+        if self.chaos is not None and self.chaos.has_local_attacks:
+            forged = self.chaos.apply_local_attacks(ridx, flat, jax.random.fold_in(key, 1))
+            flat = jnp.where(widx < self.nb_real_byz, forged, flat)
+        if self.lossy_link is not None:
+            flat = self.lossy_link.apply(flat, jax.random.fold_in(key, 2), widx, previous=prev_flat)
+        if self.chaos is not None:
+            if self.chaos.has_drop:
+                flat = self.chaos.link.apply(
+                    flat, jax.random.fold_in(key, 2), widx,
+                    drop_rate=self.chaos.drop_rate(ridx),
+                )
+            if late is not None:
+                flat = self.chaos.stragglers.apply(
+                    flat, late, self.chaos.straggler_stale(ridx), previous=prev_flat
+                )
+        out = flat.reshape(g.shape)
+        return out, out
+
+    def _submission_pipeline(self, g_leaves, key, gidx, ridx):
+        """The submission-forgery pipeline on sharded leaves (the tail of
+        the flat ``_perturb_local``, re-expressed per leaf): chaos ``forge``
+        replaces every leaf of a coalition worker with impostor noise,
+        sender digests accumulate over all leaf shards, ``tamper`` flips a
+        bit after signing, receiver digests follow, and under ``secure`` a
+        rejected worker's every leaf reads NaN.
+
+        Returns ``(g_leaves, secure_local)`` — ``secure_local`` (None unless
+        ``secure``) holds the per-LOCAL-worker digests (lane sums over this
+        device's shards; the body psum-completes them within the worker
+        group) and the forge/reject verdicts.
+        """
+        from ..secure.submit import (
+            DIGEST_LANES,
+            FORGE_SCALE,
+            row_digest,
+            tamper_row,
+        )
+
+        chaos_forgery = self.chaos is not None and self.chaos.has_forgery
+        if not (self.secure or chaos_forgery):
+            return g_leaves, None
+        k = self.workers_per_device
+        out_leaves = [[] for _ in g_leaves]
+        sent = jnp.zeros((k, DIGEST_LANES), jnp.uint32)
+        recv = jnp.zeros((k, DIGEST_LANES), jnp.uint32)
+        forged_flags, rejected_flags = [], []
+        for j in range(k):
+            widx = gidx * k + j
+            # the 32_000+ offset namespace keeps these per-worker streams
+            # disjoint from the per-(worker, leaf) perturbation parents and
+            # the 30_000+ straggler draws (see the body's key discipline)
+            wkey = jax.random.fold_in(key, 32_000 + widx)
+            is_forge = is_tamper = None
+            if chaos_forgery:
+                fkey = jax.random.fold_in(wkey, 5)
+                is_forge = (widx < self.nb_real_byz) & jax.random.bernoulli(
+                    fkey, self.chaos.forge_rate(ridx)
+                )
+                tkey = jax.random.fold_in(wkey, 6)
+                is_tamper = (widx < self.nb_real_byz) & jax.random.bernoulli(
+                    tkey, self.chaos.tamper_rate(ridx)
+                )
+            forged_flag = is_forge if is_forge is not None else jnp.bool_(False)
+            rejected = forged_flag
+            if is_tamper is not None:
+                rejected = rejected | is_tamper
+            sent_j = jnp.zeros((DIGEST_LANES,), jnp.uint32)
+            recv_j = jnp.zeros((DIGEST_LANES,), jnp.uint32)
+            for i, g in enumerate(g_leaves):
+                flat = g[j].reshape(-1).astype(jnp.float32)
+                if is_forge is not None:
+                    impostor = jax.random.normal(
+                        jax.random.fold_in(jax.random.fold_in(fkey, 1), i),
+                        flat.shape, flat.dtype,
+                    ) * jnp.float32(FORGE_SCALE)
+                    flat = jnp.where(is_forge, impostor, flat)
+                leaf_digest = None
+                if self.secure:
+                    # per-leaf salt: leaves must not alias in the checksum
+                    leaf_digest = row_digest(flat, salt=i * 0x9E3779B1)
+                    sent_j = sent_j + leaf_digest
+                if is_tamper is not None and i == 0:
+                    # one bit flipped in transit (the first leaf's shard)
+                    flat = jnp.where(
+                        is_tamper, tamper_row(flat, jax.random.fold_in(tkey, 1)), flat
+                    )
+                if self.secure:
+                    # no in-transit transform on this leaf -> received bytes
+                    # are the submitted bytes, reuse the checksum
+                    if chaos_forgery and i == 0:
+                        leaf_digest = row_digest(flat, salt=i * 0x9E3779B1)
+                    recv_j = recv_j + leaf_digest
+                    flat = jnp.where(rejected, jnp.nan, flat)
+                out_leaves[i].append(flat.reshape(g[j].shape).astype(g.dtype))
+            sent = sent.at[j].set(sent_j)
+            recv = recv.at[j].set(recv_j)
+            forged_flags.append(forged_flag)
+            rejected_flags.append(rejected)
+        g_leaves = [jnp.stack(rows) for rows in out_leaves]
+        if not self.secure:
+            return g_leaves, None
+        return g_leaves, {
+            "digest_sent": sent,
+            "digest_recv": recv,
+            "forged": jnp.stack(forged_flags),
+            "rejected": jnp.stack(rejected_flags),
+        }
+
+    def _leaf_buckets(self, g, spec):
+        """Reshape a locally worker-stacked (k, ...) leaf to (k, n_buckets,
+        d_bucket) rows-to-be."""
+        k = g.shape[0]
+        if self.granularity == "layer" and spec is not None and len(spec) >= 2 and spec[0] == pipe_axis:
+            # Stage-stacked leaf (local stage dim 1, then the scanned layer
+            # dim): one bucket per layer.
+            return g.reshape(k, g.shape[1] * g.shape[2], -1)
+        return g.reshape(k, 1, -1)
+
+    def _gather_rows(self, buckets):
+        """(k, Lb, d) local buckets -> (Lb, n, d) per-worker rows via one
+        all_gather over the worker axis (worker-major: global worker index
+        is group * k + local slot, the same layout the flat dataflow uses)."""
+        if self.exchange_dtype is not None:
+            buckets = buckets.astype(self.exchange_dtype)
+        rows = jax.lax.all_gather(buckets, worker_axis)  # (W, k, Lb, d)
+        if self.exchange_dtype is not None:
+            rows = rows.astype(jnp.float32)
+        rows = rows.reshape((self.nb_workers,) + rows.shape[2:])  # (n, Lb, d)
+        return jnp.swapaxes(rows, 0, 1)
+
+    def _apply_omniscient(self, rows, key, ridx=None):
+        byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
+        forged = False
+        if self.attack is not None and self.attack.omniscient:
+            rows = jax.vmap(lambda m: self.attack.apply_matrix(m, byz_mask, key))(rows)
+            forged = True
+        if self.chaos is not None and self.chaos.has_omniscient_attacks:
+            rows = jax.vmap(
+                lambda m: self.chaos.apply_omniscient_attacks(ridx, m, byz_mask, key)
+            )(rows)
+            forged = True
+        if forged and self.exchange_dtype is not None:
+            # forged rows crossed the same quantized wire as honest ones
+            rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+        return rows
+
+    def _bucket_distances(self, rows, spec):
+        """(Lb, n, n) squared distances for this leaf's buckets (exact)."""
+        partial = jax.vmap(centered_gram_sq_distances)(rows.astype(jnp.float32))
+        if model_axis in _spec_axis_names(spec):
+            partial = jax.lax.psum(partial, model_axis)
+        return jnp.maximum(partial, 0.0)
+
+    def _replication_scale(self, spec):
+        scale = 1.0
+        for a in _replication_axes(spec):
+            scale /= self.mesh.shape[a]
+        return scale
+
+    def _make_sharded_body(self, loss_fn, tx, state_specs):
+        """The single-step shard_map body of the leafwise-sharded dataflow,
+        shared by its ``build_step`` and ``build_multi_step`` forms."""
+        param_specs = state_specs.params
+        gar = self.gar
+        k = self.workers_per_device
+
+        def body(state, batch):
+            key = jax.random.fold_in(state.rng, state.step)
+            gidx = jax.lax.axis_index(worker_axis)  # worker-GROUP index
+            # Active chaos regime + per-STEP worker lateness (one draw per
+            # logical worker, shared by all its leaves).  The lateness key
+            # lives in the 30_000+ offset namespace — fold_in(key, widx) is
+            # the PARENT of every per-leaf stream (fold i, then tags 1/2),
+            # so folding the straggler tag onto it directly would collide
+            # with leaf index 5's stream (same convention as the 10_000+i /
+            # 20_000+i offsets the engine uses elsewhere).
+            ridx = None
+            lates = [None] * k
+            if self.chaos is not None:
+                ridx = self.chaos.regime_index(state.step)
+                if self.chaos.has_stragglers:
+                    lates = [
+                        self.chaos.stragglers.is_late(
+                            jax.random.fold_in(key, 30_000 + gidx * k + j),
+                            gidx * k + j,
+                            self.chaos.straggler_rate(ridx),
+                        )
+                        for j in range(k)
+                    ]
+            if k == 1:
+                # one logical worker per submesh: the historical (and
+                # bit-proven) unvmapped path — keep it byte-for-byte
+                local = jax.tree.map(lambda x: x[0], batch)  # strip block dim
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, local)
+                losses = loss[None]
+                grads = jax.tree.map(lambda g: g[None], grads)
+            else:
+                # k logical workers per submesh (the large-n regime): vmap
+                # the per-worker loss/grad — every leaf leads with k
+                losses, grads = jax.vmap(
+                    lambda b: jax.value_and_grad(loss_fn)(state.params, b)
+                )(batch)
+
+            g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+            s_leaves = treedef.flatten_up_to(param_specs)
+
+            # (2) complete replicated-leaf grads within the worker group
+            g_leaves = [
+                jax.lax.psum(g, _replication_axes(s)) if _replication_axes(s) else g
+                for g, s in zip(g_leaves, s_leaves)
+            ]
+            # (2a) l1/l2 regularization, analytically on the completed grads
+            # (see __init__): part of every worker's HONEST gradient, so it
+            # lands before momentum and before the Byzantine perturbation —
+            # the flat dataflow's in-loss placement, same math.
+            l1, l2 = self.l1_regularize, self.l2_regularize
+            if l1 or l2:
+                p_leaves = jax.tree_util.tree_leaves(state.params)
+                reg = jnp.float32(0.0)
+                for i, (p, s) in enumerate(zip(p_leaves, s_leaves)):
+                    p32 = p.astype(jnp.float32)
+                    delta = jnp.zeros_like(p32)
+                    if l1:
+                        delta = delta + l1 * jnp.sign(p32)
+                        reg = reg + l1 * jnp.sum(jnp.abs(p32)) * self._replication_scale(s)
+                    if l2:
+                        delta = delta + 2.0 * l2 * p32
+                        reg = reg + l2 * jnp.sum(p32 * p32) * self._replication_scale(s)
+                    g_leaves[i] = g_leaves[i] + delta.astype(g_leaves[i].dtype)
+                # scaled per-leaf partials psum exactly like the data loss:
+                # the in-group psum in `metrics` then counts the norm once
+                # (every logical worker's loss carries the reg term, the flat
+                # dataflow's per-worker in-loss placement)
+                losses = losses + reg
+            # (2b) honest worker momentum (pre-attack, like the flat body):
+            # send bias-corrected momenta, carry the uncorrected buffer
+            new_momentum, new_momentum_steps = state.momentum, state.momentum_steps
+            if self.worker_momentum is not None:
+                beta = self.worker_momentum
+                # momentum buffers are worker-sharded: local block (k, ...)
+                m_leaves, _ = jax.tree_util.tree_flatten(state.momentum)
+                new_momentum_steps = state.momentum_steps + 1
+                corr = 1.0 - beta ** new_momentum_steps.astype(jnp.float32)
+                m_new = [beta * m + (1.0 - beta) * g for m, g in zip(m_leaves, g_leaves)]
+                g_leaves = [m / corr for m in m_new]
+                new_momentum = jax.tree_util.tree_unflatten(treedef, m_new)
+            # (3) per-worker perturbation of each logical worker's own shards
+            # (skipped entirely when no adversity is configured — at k
+            # workers per submesh the k-fold loop would otherwise pay trace
+            # size for an identity transform)
+            carry_leaves = None
+            if self.carries_gradients:
+                carry_leaves = jax.tree_util.tree_leaves(state.carry)  # (k, ...)
+            new_carry = state.carry
+            if (self.attack is not None or self.lossy_link is not None
+                    or self.chaos is not None):
+                post_leaves = []
+                for i, (g, s) in enumerate(zip(g_leaves, s_leaves)):
+                    outs, posts = [], []
+                    for j in range(k):
+                        widx = gidx * k + j
+                        out, post = self._perturb(
+                            g[j], s,
+                            jax.random.fold_in(jax.random.fold_in(key, widx), i),
+                            widx,
+                            previous=(
+                                carry_leaves[i][j]
+                                if carry_leaves is not None else None
+                            ),
+                            ridx=ridx, late=lates[j],
+                        )
+                        outs.append(out)
+                        posts.append(post)
+                    g_leaves[i] = jnp.stack(outs)
+                    post_leaves.append(jnp.stack(posts))
+                if self.carries_gradients:
+                    new_carry = jax.tree_util.tree_unflatten(treedef, post_leaves)
+
+            # (3b) submission forgery + authentication digests (secure/):
+            # impersonated/tampered submissions, sender/receiver checksums
+            # over every leaf shard, reject-to-NaN under ``secure``
+            g_leaves, secure_local = self._submission_pipeline(
+                g_leaves, key, gidx, ridx
+            )
+
+            # (4/5) per-bucket robust aggregation over the worker axis
+            all_rows = []
+            for i, (g, s) in enumerate(zip(g_leaves, s_leaves)):
+                rows = self._gather_rows(self._leaf_buckets(g, s))
+                rows = self._apply_omniscient(rows, jax.random.fold_in(key, 10_000 + i), ridx=ridx)
+                all_rows.append(rows)
+
+            # Quarantine BEFORE any distance computation (incl. the global
+            # path below): masked rows must read +inf-distant to selection
+            # rules, never finite-distant-but-NaN-valued.  raw rows are kept
+            # for the reputation signal.
+            raw_all_rows = all_rows
+            if self.quarantine_threshold:
+                qmask = quarantine_mask(
+                    state.reputation, self.quarantine_threshold, gar.nb_byz_workers
+                )
+                all_rows = [
+                    jnp.where(qmask[None, :, None], jnp.nan, rows) for rows in all_rows
+                ]
+
+            global_dist2 = None
+            if self.granularity == "global" and gar.needs_distances:
+                acc = jnp.zeros((self.nb_workers, self.nb_workers), jnp.float32)
+                for rows, s in zip(all_rows, s_leaves):
+                    partial = centered_gram_sq_distances(
+                        rows.reshape(self.nb_workers, -1).astype(jnp.float32)
+                    )
+                    acc = acc + partial * self._replication_scale(s)
+                global_dist2 = jnp.maximum(jax.lax.psum(acc, _IN_GROUP_AXES), 0.0)
+
+            agg_leaves = []
+            # Suspicion accumulators (worker_metrics): whole-model per-worker
+            # squared distance to the aggregate — per-leaf partials scaled by
+            # the replication factor exactly like grad_norm's, psum-completed
+            # below — and the mean per-bucket participation.  Participation
+            # values are identical on every in-group device EXCEPT along the
+            # pipe axis of stage-stacked leaves (distinct buckets), so each
+            # contribution is scaled by 1/(replicating axes' size) and the
+            # in-group psum then counts every distinct bucket exactly once.
+            wdist = jnp.zeros((self.nb_workers,), jnp.float32)
+            part_sum = jnp.zeros((self.nb_workers,), jnp.float32)
+            part_count = 0.0  # global distinct-bucket count (static)
+            rep_dist = jnp.zeros((self.nb_workers,), jnp.float32)
+            # (vmapped rule calls below: the Pallas auto-tier detects the
+            # batching trace centrally and stays on jnp — gars/common.py
+            # _is_batched_tracer)
+            for rows, raw_rows, g, s in zip(all_rows, raw_all_rows, g_leaves, s_leaves):
+                participation = None
+                if gar.needs_distances:
+                    if global_dist2 is not None:
+                        dist2 = jnp.broadcast_to(global_dist2, rows.shape[:1] + global_dist2.shape)
+                    else:
+                        dist2 = self._bucket_distances(rows, s)
+                    if self.worker_metrics:
+                        # One pass: the memoized selection graph serves both
+                        # the aggregate and the participation (two separate
+                        # vmaps would trace it twice per leaf).
+                        agg, participation = jax.vmap(
+                            gar.aggregate_block_and_participation
+                        )(rows, dist2)
+                    else:
+                        agg = jax.vmap(gar.aggregate_block)(rows, dist2)
+                elif gar.uses_axis or gar.uses_key:
+                    # Iterative rules' row norms complete over the model axis
+                    # when this leaf's dimensions are sharded across it —
+                    # exactly _bucket_distances' discipline — so every shard
+                    # derives identical weights and the result matches dense.
+                    # Randomized meta-rules get the replicated step key (one
+                    # permutation per step, same on every device and leaf).
+                    axis = model_axis if model_axis in _spec_axis_names(s) else None
+                    from ..gars import GAR_KEY_TAG
+
+                    gkey = jax.random.fold_in(key, GAR_KEY_TAG)
+                    if self.worker_metrics:
+                        agg, participation = jax.vmap(
+                            lambda r, axis=axis: gar.aggregate_block_and_participation(
+                                r, None, axis_name=axis, key=gkey
+                            )
+                        )(rows)
+                    else:
+                        agg = jax.vmap(
+                            lambda r, axis=axis: gar._call_aggregate(
+                                r, None, axis_name=axis, key=gkey)
+                        )(rows)
+                else:
+                    agg = jax.vmap(lambda r: gar.aggregate_block(r, None))(rows)
+                if self.reputation_decay is not None:
+                    rdiff = raw_rows.astype(jnp.float32) - agg.astype(jnp.float32)[:, None, :]
+                    rep_dist = rep_dist + jnp.sum(rdiff * rdiff, axis=(0, 2)) * self._replication_scale(s)
+                if self.worker_metrics:
+                    diff = rows.astype(jnp.float32) - agg.astype(jnp.float32)[:, None, :]
+                    wdist = wdist + jnp.sum(diff * diff, axis=(0, 2)) * self._replication_scale(s)
+                    if participation is not None:
+                        stacked = (
+                            self.granularity == "layer" and s is not None
+                            and len(s) >= 2 and s[0] == pipe_axis
+                        )
+                        rep = (model_axis,) + (() if stacked else (pipe_axis,))
+                        pscale = 1.0
+                        for a in rep:
+                            pscale /= self.mesh.shape[a]
+                        part_sum = part_sum + jnp.sum(participation, axis=0) * pscale
+                        part_count += participation.shape[0] * (
+                            self.mesh.shape[pipe_axis] if stacked else 1
+                        )
+                # one aggregate per PARAMETER: strip the local worker
+                # stacking dim from the layout target
+                agg_leaves.append(agg.reshape(g.shape[1:]).astype(g.dtype))
+            agg_tree = jax.tree_util.tree_unflatten(treedef, agg_leaves)
+
+            # (6) local optax update — layouts already match the parameters
+            updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+
+            sq = jnp.float32(0.0)
+            for agg, s in zip(agg_leaves, s_leaves):
+                sq = sq + jnp.sum(jnp.square(agg.astype(jnp.float32))) * self._replication_scale(s)
+            grad_norm = jnp.sqrt(jax.lax.psum(sq, _IN_GROUP_AXES))
+
+            # loss is a local partial: sum the local workers, then the worker
+            # group's devices, then groups
+            total_loss = jax.lax.psum(jnp.sum(losses), _IN_GROUP_AXES + (worker_axis,))
+            worker_nan = None
+            if self.health_probe:
+                # Per-worker NaN-row flags over the POST-TRANSPORT shards:
+                # count this worker's non-finite coordinates locally,
+                # complete over the worker group, flag, gather workers.
+                bad = jnp.zeros((k,), jnp.int32)
+                for g in g_leaves:
+                    bad = bad + jnp.sum(
+                        (~jnp.isfinite(g)).astype(jnp.int32),
+                        axis=tuple(range(1, g.ndim)),
+                    )
+                bad = jax.lax.psum(bad, _IN_GROUP_AXES)
+                worker_nan = jax.lax.all_gather(bad > 0, worker_axis).reshape(
+                    self.nb_workers
+                )
+            secure_metrics = None
+            if secure_local is not None:
+                # complete each worker's lane sums over its in-group shards
+                # (uint32 psum wraps mod 2^32 — the checksum's own domain),
+                # then gather worker-major like the probe's NaN flags
+                def complete(local, summed):
+                    value = (
+                        jax.lax.psum(local, _IN_GROUP_AXES) if summed else local
+                    )
+                    gathered = jax.lax.all_gather(value, worker_axis)
+                    return gathered.reshape((self.nb_workers,) + value.shape[1:])
+
+                secure_metrics = {
+                    "digest_sent": complete(secure_local["digest_sent"], True),
+                    "digest_recv": complete(secure_local["digest_recv"], True),
+                    "forged": complete(secure_local["forged"], False),
+                    "rejected": complete(secure_local["rejected"], False),
+                }
+            return self._finalize_step(
+                state, params=params, opt_state=opt_state, new_carry=new_carry,
+                new_momentum=new_momentum, new_momentum_steps=new_momentum_steps,
+                total_loss=total_loss, update_norm=grad_norm,
+                worker_nan=worker_nan,
+                rep_dist=(
+                    jax.lax.psum(rep_dist, _IN_GROUP_AXES)
+                    if self.reputation_decay is not None else None
+                ),
+                wdist=(
+                    jax.lax.psum(wdist, _IN_GROUP_AXES)
+                    if self.worker_metrics else None
+                ),
+                participation=(
+                    jax.lax.psum(part_sum, _IN_GROUP_AXES) / part_count
+                    if part_count else None
+                ),
+                secure_metrics=secure_metrics, ridx=ridx,
+            )
+
+        return body
+
+    def _sharded_build_step(self, loss_fn, tx, state):
+        state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        body = self._make_sharded_body(loss_fn, tx, state_specs)
+        sharded = compat.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(state_specs, P(worker_axis)),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+        # Host-side span wrapper only (obs/trace.py): the jit underneath is
+        # untouched — zero added compiles, ``_cache_size`` falls through.
+        # EXPLICIT out_shardings pin the output state to the init_state
+        # layout: without them the compiler canonicalizes size-1 mesh axes
+        # to replicated specs, so the SECOND step call would see differently
+        # committed inputs and retrace (the zero-steady-state-recompile bar,
+        # tests/test_gar_scaling.py).
+        out_shardings = (
+            jax.tree.map(lambda a: a.sharding, state),
+            NamedSharding(self.mesh, P()),
+        )
+        return trace.traced(
+            "train_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,), out_shardings=out_shardings),
+            cat="train",
+        )
+
+    def _sharded_build_multi_step(self, loss_fn, tx, state, repeat_steps=None):
+        state_specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        body = self._make_sharded_body(loss_fn, tx, state_specs)
+
+        if repeat_steps is None:
+
+            def many(state, batches):
+                return jax.lax.scan(body, state, batches)
+
+            batch_spec = P(None, worker_axis)
+        else:
+
+            def many(state, batch):
+                return jax.lax.scan(
+                    lambda s, _: body(s, batch), state, None, length=int(repeat_steps)
+                )
+
+            batch_spec = P(worker_axis)
+
+        sharded = compat.shard_map(
+            many,
+            mesh=self.mesh,
+            in_specs=(state_specs, batch_spec),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+        # Same out_shardings discipline as build_step: keep the output state
+        # committed exactly like init_state's, or call 2 retraces.
+        out_shardings = (
+            jax.tree.map(lambda a: a.sharding, state),
+            NamedSharding(self.mesh, P()),
+        )
+        return trace.traced(
+            "train_multi_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,), out_shardings=out_shardings),
+            cat="train",
+        )
+
+    def _sharded_build_gar_probe(self, d, seed=0):
+        """The sharded twin of the flat GAR probe (the measurement
+        instrument behind ``gar_seconds_total`` / the ``gar.aggregate``
+        span).
+
+        The engine proper reduces per leaf/bucket; the probe measures ONE
+        rule application over the whole-model (n, d) row matrix on a single
+        replica — exact for ``granularity=global`` (one selection over the
+        flattened vector) and an upper bound for layer/leaf granularity
+        (the same arithmetic split across buckets).  Attacks/quarantine are
+        excluded: the probe times the rule, not the adversity simulation."""
+        from ..gars import GAR_KEY_TAG
+
+        # Column-shard the synthetic rows over the worker axis (the flat
+        # probe's layout): a replicated (n, d) matrix at whole-model d and
+        # large n would cost n x the model footprint PER DEVICE — the
+        # sharded mode's whole reason to exist is that that doesn't fit.
+        # The body is plain jit, so GSPMD partitions the distance Gram and
+        # the rule's columnwise work along d automatically.  d is padded to
+        # the worker-axis multiple (sharding a dim requires divisibility;
+        # model_dim is an arbitrary parameter count), and the rows are
+        # generated ON DEVICE under jit with an explicit output sharding so
+        # the host never materializes the (n, d) matrix.
+        W = self.nb_mesh_workers
+        blk = -(-int(d) // W)
+        make_rows = jax.jit(
+            lambda k: jax.random.normal(k, (self.nb_workers, W * blk), jnp.float32),
+            out_shardings=NamedSharding(self.mesh, P(None, worker_axis)),
+        )
+        rows = make_rows(jax.random.PRNGKey(seed))
+        gar = self.gar
+
+        def body(rows, key):
+            dist2 = None
+            if gar.needs_distances:
+                # jnp-tier Gram distances (same as _bucket_distances): the
+                # common pairwise_sq_distances auto-dispatches to a Pallas
+                # kernel on TPU, which GSPMD cannot partition over the
+                # column-sharded rows
+                dist2 = jnp.maximum(centered_gram_sq_distances(rows), 0.0)
+            gar_key = jax.random.fold_in(key, GAR_KEY_TAG)
+            return gar._call_aggregate(rows, dist2, axis_name=None, key=gar_key)
+
+        fn = jax.jit(body)
+        base = jax.random.PRNGKey(seed)
+
+        def probe(step=0):
+            return fn(rows, jax.random.fold_in(base, step))
+
+        return probe
+
+    def _sharded_build_eval(self, loss_fn, state):
+        """Jitted eval: mean of the sharded loss over the worker axis.
+
+        Built once from ``state``'s layout (like ``build_step``) so repeated
+        cadenced evals hit the jit cache instead of recompiling.
+        """
+        specs = jax.tree.map(lambda a: a.sharding.spec, state)
+        k = self.workers_per_device
+
+        def body(state, batch):
+            if k == 1:
+                local = jax.tree.map(lambda x: x[0], batch)
+                total = loss_fn(state.params, local)  # local partial
+            else:
+                total = jnp.sum(
+                    jax.vmap(lambda b: loss_fn(state.params, b))(batch)
+                )
+            return jax.lax.psum(total, _IN_GROUP_AXES + (worker_axis,)) / self.nb_workers
+
+        sharded = compat.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(specs, P(worker_axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return trace.traced("eval_step.dispatch", jax.jit(sharded), cat="eval")
+
+    # ------------------------------------------------------------------ #
+    # the public, mode-polymorphic surface
+
+    def init_state(self, *args, seed=0):
+        """Create the TrainState for this engine's mode.
+
+        - flat:    ``init_state(params, tx, seed=0)``
+        - sharded: ``init_state(init_fn, specs, tx, seed=0)``
+        """
+        if self.sharded:
+            if len(args) != 3:
+                raise UserException(
+                    "sharded init_state wants (init_fn, specs, tx); got %d "
+                    "positional argument(s)" % len(args)
+                )
+            return self._sharded_init_state(*args, seed=seed)
+        if len(args) != 2:
+            raise UserException(
+                "flat init_state wants (params, tx); got %d positional "
+                "argument(s)" % len(args)
+            )
+        return self._flat_init_state(*args, seed=seed)
+
+    def put_state(self, state):
+        """Device_put a TrainState with this engine's state layout (the
+        checkpoint-restore path)."""
+        if self.sharded:
+            return self._sharded_put_state(state)
+        return self._flat_put_state(state)
+
+    def build_step(self, loss_fn, tx, state=None):
+        """Build the jitted robust training step.
+
+        The sharded mode derives its in/out shardings from ``state`` (the
+        TrainState from ``init_state``) and therefore requires it; the flat
+        mode's layout is static and ``state`` is accepted and ignored, so
+        callers can pass it uniformly."""
+        if self.sharded:
+            if state is None:
+                raise UserException(
+                    "the sharded build_step derives its shardings from the "
+                    "TrainState; pass state=init_state(...)"
+                )
+            return self._sharded_build_step(loss_fn, tx, state)
+        return self._flat_build_step(loss_fn, tx)
+
+    def build_multi_step(self, loss_fn, tx, state=None, repeat_steps=None):
+        """Build the jitted K-step scanned trainer (same ``state`` contract
+        as :meth:`build_step`; ``repeat_steps`` reuses one resident batch)."""
+        if self.sharded:
+            if state is None:
+                raise UserException(
+                    "the sharded build_multi_step derives its shardings from "
+                    "the TrainState; pass state=init_state(...)"
+                )
+            return self._sharded_build_multi_step(
+                loss_fn, tx, state, repeat_steps=repeat_steps
+            )
+        return self._flat_build_multi_step(loss_fn, tx, repeat_steps=repeat_steps)
+
+    def build_eval(self, fn, state=None):
+        """flat: ``build_eval(metric_fn)`` -> per-batch means;
+        sharded: ``build_eval(loss_fn, state)`` -> mean sharded loss."""
+        if self.sharded:
+            if state is None:
+                raise UserException(
+                    "the sharded build_eval derives its shardings from the "
+                    "TrainState; pass state=init_state(...)"
+                )
+            return self._sharded_build_eval(fn, state)
+        return self._flat_build_eval(fn)
+
+    def build_gar_probe(self, d, seed=0):
+        """Jitted GAR-only executable at the engine's exact (n, d) — see the
+        mode-specific docstrings."""
+        if self.sharded:
+            return self._sharded_build_gar_probe(d, seed=seed)
+        return self._flat_build_gar_probe(d, seed=seed)
+
+
+    # ------------------------------------------------------------------ #
+    # bounded-wait protocol hooks (parallel/bounded.py, docs/engine.md):
+    # the fused SPMD step splits into per-worker submission executables the
+    # host dispatches asynchronously, plus one aggregate+update executable
+    # that absorbs workers missing the deadline as NaN rows — the chaos
+    # straggler model as the ACTUAL protocol, not a simulation.
+
+    def _check_bounded_wait_supported(self):
+        if self.sharded:
+            raise UserException(
+                "bounded-wait needs the flat mode: a sharded logical worker "
+                "is a collective submesh whose submission cannot complete "
+                "independently of its peers"
+            )
+        if self.granularity != "vector":
+            raise UserException(
+                "bounded-wait aggregates the whole flattened gradient "
+                "(granularity vector); per-leaf selection is not supported"
+            )
+        if self.worker_momentum is not None:
+            raise UserException(
+                "bounded-wait does not carry worker momentum yet (the "
+                "per-worker buffers live in the fused step's TrainState)"
+            )
+        if self.lossy_link is not None or self.chaos is not None:
+            raise UserException(
+                "bounded-wait replaces the simulated transport: drop --UDP/"
+                "--chaos in-graph regimes (straggler regimes move to the "
+                "host straggler model, parallel/bounded.py)"
+            )
+        if self.secure:
+            raise UserException(
+                "bounded-wait + --secure is not implemented yet (digests "
+                "would ride the per-worker submissions)"
+            )
+
+    def build_worker_grad(self, loss_fn):
+        """One jitted per-worker submission executable: ``grad_fn(params,
+        worker_batch, rng, step, widx) -> (loss, (d,) row)``.
+
+        Compiled ONCE and dispatched n times per step (worker index and
+        step are traced operands, so steady state never recompiles).  The
+        row is what the worker "sends": flattened f32, local attack applied
+        to coalition workers with the fused body's exact key discipline
+        (fold worker, then tag 1), wire-quantized when ``exchange_dtype``
+        is set — bit-compatible with the synchronous step's submissions."""
+        self._check_bounded_wait_supported()
+
+        def grad_fn(params, worker_batch, rng, step, widx):
+            key = jax.random.fold_in(rng, step)
+            if self.batch_transform is not None:
+                # fold tag 3: the augmentation stream (same as the fused body)
+                wkey = jax.random.fold_in(jax.random.fold_in(key, widx), 3)
+                worker_batch = self.batch_transform(worker_batch, wkey)
+            loss, grads = jax.value_and_grad(loss_fn)(params, worker_batch)
+            leaves = jax.tree_util.tree_leaves(grads)
+            row = jnp.concatenate(
+                [leaf.reshape(-1).astype(jnp.float32) for leaf in leaves]
+            )
+            if self.attack is not None and not self.attack.omniscient:
+                wkey = jax.random.fold_in(key, widx)
+                forged = self.attack.apply_local(row, jax.random.fold_in(wkey, 1))
+                row = jnp.where(widx < self.nb_real_byz, forged, row)
+            if self.exchange_dtype is not None:
+                row = row.astype(self.exchange_dtype)
+            return loss, row
+
+        return trace.traced(
+            "worker_grad.dispatch", jax.jit(grad_fn), cat="train"
+        )
+
+    def build_bounded_aggregate(self, tx, params_template):
+        """The aggregator side of the bounded-wait protocol: ``agg(state,
+        rows, losses, arrived) -> (state, metrics)``, jitted once
+        (``params_template`` fixes the flatten/inflate layout).
+
+        ``rows`` is the (n, d) submission buffer (missing workers' rows may
+        hold garbage — they are masked in-graph), ``arrived`` the (n,) bool
+        submission mask the host measured against its deadline.  Workers
+        that missed it contribute NaN rows INSIDE the same declared-f
+        budget as Byzantine rows (timeout rows + attack rows <= f for the
+        rule's guarantee to hold — docs/engine.md, "f-accounting"), land in
+        ``metrics["straggler_timeout"]``, and are excluded from the loss
+        sum (the aggregator only averages what it received).  Omniscient
+        attacks, quarantine, reputation, the health probe and the flight
+        recorder ride the same shared code paths as the fused step
+        (``_prepare_rows`` / ``_finalize_step``)."""
+        self._check_bounded_wait_supported()
+        from ..gars import GAR_KEY_TAG
+        from ..gars.common import pairwise_sq_distances
+
+        # the flattening layout, for inflating the aggregate back to a tree
+        flatmap = FlatMap(params_template)
+
+        def agg_fn(state, rows, losses, arrived):
+            key = jax.random.fold_in(state.rng, state.step)
+            rows = rows.astype(jnp.float32)
+            # deadline verdict first: a missing worker IS a NaN row — the
+            # exact convention of a fully-lossy link, absorbed by the rule
+            rows = jnp.where(arrived[:, None], rows, jnp.nan)
+            if self.exchange_dtype is not None:
+                rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+            rows, raw_rows = self._prepare_rows(rows, key, state.reputation)
+            dist2 = None
+            if self.gar.needs_distances:
+                dist2 = jnp.maximum(pairwise_sq_distances(rows), 0.0)
+            gar_key = jax.random.fold_in(key, GAR_KEY_TAG)
+            participation = None
+            if self.worker_metrics:
+                agg, participation = self.gar.aggregate_block_and_participation(
+                    rows, dist2, axis_name=None, key=gar_key
+                )
+            else:
+                agg = self.gar._call_aggregate(
+                    rows, dist2, axis_name=None, key=gar_key
+                )
+            agg = agg.astype(jnp.float32)
+            agg_tree = flatmap.inflate(agg)
+            updates, opt_state = tx.update(agg_tree, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            # the aggregator can only sum the losses it RECEIVED; a late
+            # worker's loss never arrived (its row is the NaN infill)
+            total_loss = jnp.sum(jnp.where(arrived, losses, 0.0))
+            wdist = rep_dist = None
+            if self.worker_metrics:
+                diff = rows - agg[None, :]
+                wdist = jnp.sum(diff * diff, axis=1)
+            if self.reputation_decay is not None:
+                rdiff = raw_rows - agg[None, :]
+                rep_dist = jnp.sum(rdiff * rdiff, axis=1)
+            worker_nan = None
+            if self.health_probe:
+                worker_nan = jnp.any(~jnp.isfinite(rows), axis=1)
+            new_state, metrics = self._finalize_step(
+                state, params=params, opt_state=opt_state, new_carry=None,
+                new_momentum=None, new_momentum_steps=None,
+                total_loss=total_loss, update_norm=jnp.linalg.norm(agg),
+                worker_nan=worker_nan, rep_dist=rep_dist, wdist=wdist,
+                participation=participation, secure_metrics=None, ridx=None,
+            )
+            # deadline evidence AFTER the epilogue: the flight recorder's
+            # lane set predates the protocol; forensics/registry consume
+            # these from the metrics dict on the host
+            metrics["straggler_timeout"] = ~arrived
+            metrics["nb_timeouts"] = jnp.sum((~arrived).astype(jnp.int32))
+            return new_state, metrics
+
+        jitted = jax.jit(agg_fn, donate_argnums=(0,))
+        return trace.traced("bounded_aggregate.dispatch", jitted, cat="train")
+
+
+class ShardedRobustEngine(RobustEngine):
+    """Thin compatibility shim: ``RobustEngine(..., sharding="sharded")``
+    under the historical name/signature.  New code should construct
+    :class:`RobustEngine` directly."""
+
+    def __init__(self, mesh, gar, nb_real_byz=0, attack=None, lossy_link=None,
+                 granularity="layer", exchange_dtype=None, worker_momentum=None,
+                 worker_metrics=False, reputation_decay=None,
+                 quarantine_threshold=0.0, l1_regularize=None,
+                 l2_regularize=None, chaos=None, health_probe=True,
+                 nb_workers=None, secure=False, flight=None):
+        super().__init__(
+            mesh, gar, nb_workers=nb_workers, nb_real_byz=nb_real_byz,
+            attack=attack, lossy_link=lossy_link, granularity=granularity,
+            exchange_dtype=exchange_dtype, worker_momentum=worker_momentum,
+            worker_metrics=worker_metrics, reputation_decay=reputation_decay,
+            quarantine_threshold=quarantine_threshold,
+            l1_regularize=l1_regularize, l2_regularize=l2_regularize,
+            chaos=chaos, health_probe=health_probe, secure=secure,
+            flight=flight, sharding="sharded",
+        )

@@ -65,7 +65,7 @@ def main():
     from aggregathor_tpu import gars
     from aggregathor_tpu.models import transformer as tfm
     from aggregathor_tpu.parallel.mesh import make_mesh
-    from aggregathor_tpu.parallel.sharded_engine import ShardedRobustEngine
+    from aggregathor_tpu.parallel.engine import RobustEngine
 
     mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
     cfg = tfm.TransformerConfig(
@@ -73,7 +73,8 @@ def main():
         n_layers=args.layers * pp, n_experts=2 * tp,
     )
     f = max(0, (w - 3) // 2) if args.gar.startswith("krum") else max(0, (w - 1) // 3)
-    engine = ShardedRobustEngine(mesh, gars.instantiate(args.gar, w, f), granularity="layer")
+    engine = RobustEngine(mesh, gars.instantiate(args.gar, w, f),
+                          granularity="layer", sharding="sharded")
     tx = optax.sgd(1e-2)
     state = engine.init_state(lambda k: tfm.init_params(cfg, k, n_stages=pp), tfm.param_specs(cfg), tx)
     step = engine.build_step(tfm.make_pipeline_loss(cfg, n_stages=pp, microbatches=2), tx, state)

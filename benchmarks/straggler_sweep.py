@@ -1,0 +1,219 @@
+"""Straggler sweep: steps/s vs straggler severity, synchronous vs bounded-wait.
+
+The tentpole measurement of ISSUE 10: a synchronous step takes as long as
+the slowest worker, so its throughput degrades linearly with the injected
+stall; a bounded-wait round closes at the deadline, so its throughput stays
+FLAT while the GAR absorbs the missing rows inside the declared-f budget.
+Both modes run the REAL protocol machinery (parallel/bounded.py over the
+unified engine) — the synchronous baseline is the same per-worker
+submission pipeline with ``deadline=None`` (wait for every arrival), so the
+comparison isolates exactly one variable: whether the aggregator waits.
+
+Also re-checks the n=8/f=2 breakdown property under bounded-wait: the rule
+sized for the timeout tail (krum, r = f persistent stragglers) keeps a
+finite trajectory; the majority rule (plain average) is poisoned by the
+first timeout.
+
+Output schema ``aggregathor.straggler.sweep.v1``::
+
+    {schema, generated_at, config: {...}, cells: [
+        {mode: "sync"|"bounded", stall_seconds, steps_per_s,
+         losses_finite, timeouts_total, final_loss}... ],
+     breakdown: {krum_finite, average_finite},
+     verdict: {bounded_flat, sync_degrades, breakdown_holds, pass}}
+
+Usage::
+
+    python benchmarks/straggler_sweep.py [--steps 10] [--deadline 0.15]
+        [--severities 0,0.2,0.4,0.8] [--out straggler_sweep.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "aggregathor.straggler.sweep.v1"
+
+#: bounded-wait is "flat" when its worst cell is within this factor of its
+#: best; the synchronous baseline "degrades" when its best-to-worst ratio
+#: exceeds it (the stall dominates the step)
+FLAT_TOLERANCE = 1.6
+
+
+def run_cell(mode, stall, args, gar_name="krum"):
+    import jax
+    import numpy as np
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.core import build_optimizer, build_schedule
+    from aggregathor_tpu.parallel import RobustEngine, make_mesh
+    from aggregathor_tpu.parallel.bounded import (
+        BoundedWaitStep,
+        HostStragglerModel,
+    )
+
+    n, f = args.nb_workers, args.nb_byz
+    exp = models.instantiate("digits", ["batch-size:%d" % args.batch_size])
+    gar = gars.instantiate(gar_name, n, f)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=1), gar, n)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    model = None
+    if stall > 0:
+        model = HostStragglerModel(
+            n, stall, rate=1.0, nb_eligible=args.stragglers, seed=0
+        )
+    step = BoundedWaitStep(
+        engine, exp.loss, tx, jax.device_get(state.params),
+        deadline=args.deadline if mode == "bounded" else None,
+        straggler_model=model,
+    )
+    it = exp.make_train_iterator(n, seed=3)
+    losses = []
+    try:
+        state, m = step(state, next(it))  # warmup: compiles, deadline off
+        losses.append(float(jax.device_get(m["total_loss"])))
+        begin = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = step(state, next(it))
+            losses.append(float(jax.device_get(m["total_loss"])))
+        elapsed = time.perf_counter() - begin
+        timeouts = int(step.timeouts_total.sum())
+    finally:
+        step.close()
+    return {
+        "mode": mode,
+        "gar": gar_name,
+        "stall_seconds": float(stall),
+        "steps_per_s": args.steps / elapsed,
+        "losses_finite": bool(np.isfinite(losses).all()),
+        "final_loss": float(losses[-1]),
+        "timeouts_total": timeouts,
+    }
+
+
+def validate(doc):
+    """Schema check for round-tripping consumers (the smoke script)."""
+    if doc.get("schema") != SCHEMA:
+        raise ValueError("not a %s document" % SCHEMA)
+    for key in ("config", "cells", "breakdown", "verdict"):
+        if key not in doc:
+            raise ValueError("missing %r" % key)
+    for cell in doc["cells"]:
+        for key in ("mode", "stall_seconds", "steps_per_s", "losses_finite",
+                    "timeouts_total"):
+            if key not in cell:
+                raise ValueError("cell missing %r" % key)
+        if cell["mode"] not in ("sync", "bounded"):
+            raise ValueError("bad mode %r" % cell["mode"])
+    for key in ("bounded_flat", "sync_degrades", "breakdown_holds", "pass"):
+        if not isinstance(doc["verdict"].get(key), bool):
+            raise ValueError("verdict missing bool %r" % key)
+    return doc
+
+
+def load(path):
+    with open(path) as fd:
+        return validate(json.load(fd))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--steps", type=int, default=10,
+                        help="measured steps per cell (after 1 warmup)")
+    parser.add_argument("--deadline", type=float, default=0.15,
+                        help="bounded-wait round deadline (seconds)")
+    parser.add_argument("--severities", default="0,0.2,0.4,0.8",
+                        help="comma-separated straggler stalls (seconds)")
+    parser.add_argument("--nb-workers", type=int, default=8)
+    parser.add_argument("--nb-byz", type=int, default=2,
+                        help="declared f (the timeout budget)")
+    parser.add_argument("--stragglers", type=int, default=2,
+                        help="eligible straggler count (must be <= f for "
+                             "the bounded trajectory to stay finite)")
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--out", default=None, help="write the JSON here")
+    args = parser.parse_args(argv)
+    severities = [float(x) for x in args.severities.split(",")]
+
+    cells = []
+    for stall in severities:
+        for mode in ("sync", "bounded"):
+            cell = run_cell(mode, stall, args)
+            cells.append(cell)
+            print("%-8s stall=%.2fs  %6.2f steps/s  timeouts=%d  %s" % (
+                cell["mode"], cell["stall_seconds"], cell["steps_per_s"],
+                cell["timeouts_total"],
+                "finite" if cell["losses_finite"] else "NON-FINITE",
+            ))
+
+    # breakdown property at the harshest severity: r = f stragglers
+    harshest = max(severities) if max(severities) > 0 else args.deadline * 4
+    b_args = argparse.Namespace(**vars(args))
+    b_args.steps = max(3, min(args.steps, 5))
+    krum_cell = run_cell("bounded", harshest, b_args, gar_name="krum")
+    avg_cell = run_cell("bounded", harshest, b_args, gar_name="average")
+    breakdown = {
+        "stall_seconds": harshest,
+        "krum_finite": krum_cell["losses_finite"],
+        "average_finite": avg_cell["losses_finite"],
+    }
+
+    def rate(mode, stall):
+        return next(c["steps_per_s"] for c in cells
+                    if c["mode"] == mode and c["stall_seconds"] == stall)
+
+    bounded_rates = [rate("bounded", s) for s in severities]
+    sync_rates = [rate("sync", s) for s in severities]
+    # The protocol guarantee is a FLOOR, not a constant: a bounded round
+    # closes at worst at deadline + compute, whatever the stall (rounds
+    # whose stragglers are still in flight skip them and close even
+    # faster), while the synchronous round time grows with the stall
+    # itself.  "Flat within tolerance" = no bounded cell falls below the
+    # deadline-implied rate; "degrades" = the harshest sync cell loses
+    # more than the tolerance factor vs its own zero-severity rate.
+    base_step = 1.0 / max(sync_rates)  # compute-only step time
+    floor = 1.0 / (args.deadline + base_step)
+    bounded_flat = min(bounded_rates) >= floor / FLAT_TOLERANCE
+    sync_degrades = min(sync_rates) <= max(sync_rates) / FLAT_TOLERANCE
+    breakdown_holds = breakdown["krum_finite"] and not breakdown["average_finite"]
+    doc = {
+        "schema": SCHEMA,
+        "generated_at": time.time(),
+        "config": {
+            "nb_workers": args.nb_workers, "nb_byz": args.nb_byz,
+            "stragglers": args.stragglers, "deadline": args.deadline,
+            "steps": args.steps, "batch_size": args.batch_size,
+            "severities": severities, "flat_tolerance": FLAT_TOLERANCE,
+            "platform": os.environ.get("JAX_PLATFORMS", ""),
+        },
+        "cells": cells,
+        "breakdown": breakdown,
+        "deadline_rate_floor": floor,
+        "verdict": {
+            "bounded_flat": bool(bounded_flat),
+            "sync_degrades": bool(sync_degrades),
+            "breakdown_holds": bool(breakdown_holds),
+            "pass": bool(bounded_flat and sync_degrades and breakdown_holds),
+        },
+    }
+    validate(doc)
+    print("verdict: bounded_flat=%s sync_degrades=%s breakdown_holds=%s -> %s"
+          % (doc["verdict"]["bounded_flat"], doc["verdict"]["sync_degrades"],
+             doc["verdict"]["breakdown_holds"],
+             "PASS" if doc["verdict"]["pass"] else "FAIL"))
+    if args.out:
+        with open(args.out, "w") as fd:
+            json.dump(doc, fd, indent=1)
+            fd.write("\n")
+        print("sweep -> %s" % args.out)
+    return 0 if doc["verdict"]["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,3 +31,92 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0xA66)
+
+
+# --------------------------------------------------------------------- #
+# One engine-fixture sweep for the whole suite (ISSUE 10 satellite): the
+# unified RobustEngine in either dataflow mode, built through a single
+# cached factory so tests that need an identical configuration share ONE
+# compiled step executable (states are rebuilt per call — the step donates
+# its input buffers).  Use ``mode="sharded"`` for the leafwise-sharded
+# dataflow on the same cheap MLP (fully-replicated specs on a worker mesh:
+# the in-group axes are size 1, so the plain loss IS the local partial) —
+# the transformer stacks stay for the pipeline/tensor-parallel tests, but
+# feature-parity sweeps do not need to pay their compile times.
+
+_ENGINE_STACK_CACHE = {}
+
+
+def build_engine_stack(mode="flat", experiment="mnist",
+                       experiment_args=("batch-size:16",), gar="average",
+                       n=8, f=0, nb_devices=1, lr=0.05, attack=None,
+                       attack_args=(), nb_real_byz=0, lossy=None,
+                       flight=None, cache=True, **engine_kw):
+    """Returns ``(exp, engine, tx, step, make_state)``.
+
+    ``attack`` is the attack NAME (instantiated inside, so the config stays
+    hashable); ``lossy`` the --UDP ``(first_k, args...)`` tuple; ``flight``
+    a ``(capacity, worker_metrics)`` tuple (the recorder is reachable as
+    ``engine.flight``).  Extra ``engine_kw`` must be hashable; pass
+    ``cache=False`` for one-off stacks."""
+    key = (mode, experiment, tuple(experiment_args), gar, n, f, nb_devices,
+           lr, attack, tuple(attack_args), nb_real_byz, lossy, flight,
+           tuple(sorted(engine_kw.items())))
+    if cache and key in _ENGINE_STACK_CACHE:
+        return _ENGINE_STACK_CACHE[key]
+    import optax  # noqa: F401  (ensures optax registered before engines)
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.core import build_optimizer, build_schedule
+    from aggregathor_tpu.obs.flight import FlightRecorder
+    from aggregathor_tpu.parallel import RobustEngine, attacks, make_mesh
+    from aggregathor_tpu.parallel.lossy import LossyLink
+    from jax.sharding import PartitionSpec as P
+
+    exp = models.instantiate(experiment, list(experiment_args))
+    gar_obj = gars.instantiate(gar, n, f)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:%s" % lr]))
+    atk = attacks.instantiate(attack, n, nb_real_byz, list(attack_args)) if attack else None
+    link = LossyLink(lossy[0], list(lossy[1:])) if lossy else None
+    rec = None
+    if flight is not None:
+        capacity, worker_metrics = flight
+        rec = FlightRecorder(capacity, n, probe=True,
+                             worker_metrics=worker_metrics)
+        engine_kw = dict(engine_kw, worker_metrics=worker_metrics)
+    mesh = make_mesh(nb_workers=nb_devices)
+    engine = RobustEngine(mesh, gar_obj, n, nb_real_byz=nb_real_byz,
+                          attack=atk, lossy_link=link, flight=rec,
+                          sharding=mode, **engine_kw)
+    if mode == "sharded":
+        specs = jax.tree.map(lambda _: P(), exp.init(jax.random.PRNGKey(0)))
+
+        def make_state(seed=1):
+            return engine.init_state(exp.init, specs, tx, seed=seed)
+
+        state0 = make_state()
+        step = engine.build_step(exp.loss, tx, state0)
+    else:
+
+        def make_state(seed=1):
+            return engine.init_state(exp.init(jax.random.PRNGKey(42)), tx,
+                                     seed=seed)
+
+        step = engine.build_step(exp.loss, tx)
+    stack = (exp, engine, tx, step, make_state)
+    if cache:
+        _ENGINE_STACK_CACHE[key] = stack
+    return stack
+
+
+def assert_zero_recompiles(*executables, expect=1):
+    """The shared zero-steady-state-recompile bar: each executable's compile
+    count equals ``expect`` (1 for a warmed jit; serve engines pass their
+    bucket-ladder size).  Accepts ``obs.trace.traced`` wrappers / jits
+    (``_cache_size``) and serve engines (``compile_count``)."""
+    for fn in executables:
+        count = fn._cache_size() if hasattr(fn, "_cache_size") else fn.compile_count
+        assert count == expect, (
+            "steady state recompiled: %r compiled %d time(s), expected %d"
+            % (getattr(fn, "__name__", fn), count, expect)
+        )

@@ -1,0 +1,232 @@
+"""Bounded-wait aggregation tests (ISSUE 10 tentpole, parallel/bounded.py):
+deadline-closed rounds, NaN-row absorption within the declared-f budget,
+the n=8/f=2 breakdown property under real timeouts, zero steady-state
+recompiles, straggler forensics evidence, and the guardian's sustained-
+timeout escalation input."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars, models
+from aggregathor_tpu.core import build_optimizer, build_schedule
+from aggregathor_tpu.guardian import GuardianConfig, Watchdog
+from aggregathor_tpu.obs.forensics import ForensicsLedger
+from aggregathor_tpu.obs.metrics import MetricsRegistry
+from aggregathor_tpu.parallel import RobustEngine, make_mesh
+from aggregathor_tpu.parallel.bounded import BoundedWaitStep, HostStragglerModel
+from aggregathor_tpu.utils import UserException
+
+
+def make_stack(gar_name="krum", n=8, f=2, deadline=None, stall=0.0, rate=0.0,
+               nb_eligible=0, registry=None, **engine_kw):
+    exp = models.instantiate("digits", ["batch-size:8"])
+    gar = gars.instantiate(gar_name, n, f)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=1), gar, n, **engine_kw)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    model = None
+    if stall > 0:
+        model = HostStragglerModel(n, stall, rate=rate, nb_eligible=nb_eligible)
+    step = BoundedWaitStep(engine, exp.loss, tx, jax.device_get(state.params),
+                           deadline=deadline, straggler_model=model,
+                           registry=registry)
+    return exp, engine, step, state
+
+
+def test_bounded_wait_absorbs_timeouts_within_budget():
+    """ACCEPTANCE: two persistent stragglers (stall >> deadline) time out
+    every round; their rows land as NaN inside the declared f=2 budget,
+    krum absorbs them, loss stays finite and decreases, and the steady-
+    state round closes at the deadline, not at the stall."""
+    reg = MetricsRegistry()
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.2, stall=1.0, rate=1.0, nb_eligible=2, registry=reg)
+    it = exp.make_train_iterator(8, seed=3)
+    losses, walls = [], []
+    try:
+        for _ in range(5):
+            begin = time.monotonic()
+            state, m = step(state, next(it))
+            m = jax.device_get(m)
+            walls.append(time.monotonic() - begin)
+            losses.append(float(m["total_loss"]))
+        tmo = np.asarray(m["straggler_timeout"])
+        nan_rows = np.asarray(m["probe"]["worker_nan_rows"])
+    finally:
+        step.close()
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    np.testing.assert_array_equal(tmo[:2], [True, True])
+    assert not tmo[2:].any()
+    np.testing.assert_array_equal(nan_rows, tmo)  # the NaN rows ARE the timeouts
+    assert step.timeouts_total[:2].min() >= 4  # late every post-warmup round
+    assert step.timeouts_total[2:].sum() == 0
+    # steady state closes at (or under) the deadline, never at the stall:
+    # the post-warmup rounds must beat the 1 s stall by a wide margin
+    assert max(walls[2:]) < 0.8, walls
+    # registry counters: per-worker timeouts + round count
+    fams = {f.name: f for f in reg.families()}
+    assert fams["straggler_timeouts_total"].labels(worker="0").value >= 4
+    assert fams["bounded_wait_rounds_total"].value == 5
+
+
+def test_bounded_wait_sync_mode_matches_fused_engine():
+    """deadline=None (the synchronous baseline) waits for every submission:
+    no timeouts, and the trajectory matches the fused SPMD step to float
+    tolerance (same per-worker batches, same rule; the per-worker grad
+    executables need not lower bit-identically to the vmapped body)."""
+    exp, engine, step, state = make_stack("median", n=4, f=1, deadline=None)
+    fused_engine = RobustEngine(
+        make_mesh(nb_workers=1), gars.instantiate("median", 4, 1), 4)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    fused_step = fused_engine.build_step(exp.loss, tx)
+    fused_state = fused_engine.init_state(
+        exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    it_a = exp.make_train_iterator(4, seed=3)
+    it_b = exp.make_train_iterator(4, seed=3)
+    try:
+        for _ in range(3):
+            state, m = step(state, next(it_a))
+            fused_state, fm = fused_step(
+                fused_state, fused_engine.shard_batch(next(it_b)))
+            assert not np.asarray(
+                jax.device_get(m["straggler_timeout"])).any()
+            np.testing.assert_allclose(
+                float(jax.device_get(m["total_loss"])),
+                float(jax.device_get(fm["total_loss"])), rtol=1e-5)
+    finally:
+        step.close()
+    a = np.concatenate([np.ravel(np.asarray(x))
+                        for x in jax.tree_util.tree_leaves(
+                            jax.device_get(state.params))])
+    b = np.concatenate([np.ravel(np.asarray(x))
+                        for x in jax.tree_util.tree_leaves(
+                            jax.device_get(fused_state.params))])
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_breakdown_property_under_bounded_wait():
+    """ACCEPTANCE (n=8, f=2): the majority rule (plain average, no NaN
+    budget) is poisoned by the very first timeout — the chaos campaign's
+    empirical f-breakdown check, driven by the real clock.  The r = f
+    half (krum stays finite under 2 persistent stragglers) is asserted by
+    test_bounded_wait_absorbs_timeouts_within_budget on the same config."""
+    exp, engine, step, state = make_stack(
+        "average", deadline=0.15, stall=1.0, rate=1.0, nb_eligible=2)
+    it = exp.make_train_iterator(8, seed=3)
+    try:
+        vals = []
+        for _ in range(3):
+            state, m = step(state, next(it))
+            vals.append(float(jax.device_get(m["total_loss"])))
+    finally:
+        step.close()
+    # the first post-warmup round (index >= 1) poisons the params; the NaN
+    # surfaces in the loss one step later
+    assert not np.isfinite(vals).all()
+
+
+def test_bounded_wait_zero_steady_state_recompiles():
+    """One submission executable + one aggregate executable, compiled once:
+    varying arrival masks and steps are data, not shapes."""
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.15, stall=0.6, rate=0.6, nb_eligible=3)
+    it = exp.make_train_iterator(8, seed=3)
+    try:
+        for _ in range(6):
+            state, _ = step(state, next(it))
+    finally:
+        step.close()
+    # max over (grad_fn, agg_fn): steady state reads 1 like a fused step
+    from conftest import assert_zero_recompiles
+
+    assert_zero_recompiles(step)
+
+
+def test_bounded_wait_rejects_unsupported_modes():
+    gar = gars.instantiate("krum", 4, 1)
+    mesh = make_mesh(nb_workers=1)
+    eng = RobustEngine(mesh, gar, 4, worker_momentum=0.9)
+    with pytest.raises(UserException):
+        eng.build_worker_grad(lambda p, b: 0.0)
+    eng = RobustEngine(mesh, gar, 4, granularity="leaf")
+    with pytest.raises(UserException):
+        eng.build_worker_grad(lambda p, b: 0.0)
+    sharded = RobustEngine(mesh, gars.instantiate("krum", 4, 1), 4,
+                           sharding="sharded", granularity="layer")
+    with pytest.raises(UserException):
+        sharded.build_worker_grad(lambda p, b: 0.0)
+    with pytest.raises(UserException):
+        BoundedWaitStep(RobustEngine(mesh, gar, 4), lambda p, b: 0.0,
+                        None, {}, deadline=-1.0)
+
+
+def test_host_straggler_model_validation_and_determinism():
+    from aggregathor_tpu.chaos import ChaosSchedule
+
+    with pytest.raises(UserException):  # attack regimes stay in-graph
+        HostStragglerModel(4, 1.0, chaos=ChaosSchedule(
+            "0:attack=empire", 4, nb_real_byz=1))
+    with pytest.raises(UserException):  # no straggler regime at all
+        HostStragglerModel(4, 1.0, chaos=ChaosSchedule("0:calm", 4))
+    with pytest.raises(UserException):  # rate/schedule without a stall
+        HostStragglerModel(4, 0.0, rate=0.5)  # would inject nothing
+    model = HostStragglerModel(4, 0.5, chaos=ChaosSchedule(
+        "0:calm 10:straggle=1.0", 4, args=["straggle-workers:2"]))
+    assert model.nb_eligible == 2
+    assert model.delay(5, 0) == 0.0          # calm regime
+    assert model.delay(12, 0) == 0.5         # straggle regime, eligible
+    assert model.delay(12, 3) == 0.0         # beyond straggle-workers
+    flat = HostStragglerModel(4, 0.5, rate=0.5, seed=7)
+    draws = [flat.delay(s, w) for s in range(8) for w in range(4)]
+    assert draws == [flat.delay(s, w) for s in range(8) for w in range(4)]
+    assert 0.0 in draws and 0.5 in draws     # both outcomes at rate 0.5
+
+
+def test_forensics_timeout_evidence_named_not_byzantine():
+    """A timed-out worker gets straggler_timeout evidence and lands in the
+    report's stragglers list; its NaN row is EXPLAINED by the timeout (no
+    nan_row strong evidence), so it is NOT attributed Byzantine."""
+    ledger = ForensicsLedger(4)
+    timeout = np.asarray([True, False, False, False])
+    nan_rows = np.asarray([True, False, False, False])
+    for s in range(8):
+        ledger.observe(s, worker_nan=nan_rows, timeout=timeout)
+    report = ledger.report()
+    assert report["stragglers"] == [0]
+    assert report["suspects"] == []
+    w0 = report["workers"][0]
+    assert w0["evidence"] == {"straggler_timeout": 8}
+    assert w0["timeout_rate"] == 1.0
+    # a NaN row WITHOUT a timeout still counts as strong evidence
+    ledger2 = ForensicsLedger(4)
+    for s in range(8):
+        ledger2.observe(s, worker_nan=nan_rows,
+                        timeout=np.zeros((4,), bool))
+    assert ledger2.report()["workers"][0]["evidence"] == {"nan_row": 8}
+    assert ledger2.report()["suspects"] == [0]
+
+
+def test_watchdog_sustained_timeout_escalation_input():
+    """Timeouts beyond the declared budget sustained for ``patience`` steps
+    are a rollback decision; within-budget timeouts are the protocol
+    working as designed."""
+    dog = Watchdog(GuardianConfig(["patience:3"]))
+    # within budget: never triggers
+    for s in range(10):
+        assert dog.observe_timeouts(s, 2, 2) is None
+    # beyond budget: triggers exactly at the patience threshold
+    assert dog.observe_timeouts(10, 3, 2) is None
+    assert dog.observe_timeouts(11, 3, 2) is None
+    assert dog.observe_timeouts(12, 3, 2) == "rollback"
+    assert "beyond the declared budget" in dog.last_reason
+    # a within-budget step resets the streak
+    dog2 = Watchdog(GuardianConfig(["patience:2"]))
+    assert dog2.observe_timeouts(0, 3, 2) is None
+    assert dog2.observe_timeouts(1, 2, 2) is None  # reset
+    assert dog2.observe_timeouts(2, 3, 2) is None
+    assert dog2.observe_timeouts(3, 3, 2) == "rollback"

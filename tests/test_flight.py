@@ -127,23 +127,24 @@ def test_summarize_window_tail():
 # engine integration: bit identity + compile counts
 
 
-def _flat_setup(nb_workers=4, flight_rec=None, worker_metrics=False, **kw):
-    exp = models.instantiate("mnist", ["batch-size:16"])
-    gar = gars.instantiate("median", nb_workers, 1)
-    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
-    engine = RobustEngine(
-        make_mesh(nb_workers=1), gar, nb_workers=nb_workers,
-        flight=flight_rec, worker_metrics=worker_metrics, **kw)
-    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
-    return exp, engine, tx, state
+def _flat_setup(nb_workers=4, flight=None, mode="flat", nb_devices=1):
+    """Delegates to the suite-wide cached engine-fixture factory
+    (tests/conftest.py, ISSUE 10 satellite).  ``flight`` is a (capacity,
+    worker_metrics) tuple; the recorder is ``engine.flight``.  Identical
+    configurations across tests share one compiled step."""
+    from conftest import build_engine_stack
+
+    exp, engine, tx, step, make_state = build_engine_stack(
+        mode=mode, gar="median", n=nb_workers, f=1, nb_devices=nb_devices,
+        flight=flight)
+    return exp, engine, tx, step, make_state
 
 
 def test_ring_bit_identical_to_metrics_unroll1():
     """Per-step dispatches: the fetched ring rows equal the per-dispatch
     metrics BIT-EXACTLY — every lane stores the same traced value."""
-    rec = FlightRecorder(8, 4, worker_metrics=True)
-    exp, engine, tx, state = _flat_setup(flight_rec=rec, worker_metrics=True)
-    step = engine.build_step(exp.loss, tx)
+    exp, engine, tx, step, make_state = _flat_setup(flight=(8, True))
+    rec, state = engine.flight, make_state()
     it = exp.make_train_iterator(4, seed=2)
     seen = {"loss": [], "norm": [], "spike": [], "nan": [], "dist": []}
     for _ in range(5):
@@ -167,8 +168,8 @@ def test_ring_bit_identical_to_metrics_unroll1():
 def test_ring_bit_identical_to_metrics_unroll8():
     """One 8-step scanned dispatch: the ring's rows equal the scan's
     per-step metrics stack bit-exactly (the in-scan write IS the metric)."""
-    rec = FlightRecorder(8, 4, worker_metrics=True)
-    exp, engine, tx, state = _flat_setup(flight_rec=rec, worker_metrics=True)
+    exp, engine, tx, _, make_state = _flat_setup(flight=(8, True))
+    rec, state = engine.flight, make_state()
     multi = engine.build_multi_step(exp.loss, tx)
     it = exp.make_train_iterator(4, seed=2)
     chunk = jax.tree_util.tree_map(
@@ -193,9 +194,9 @@ def test_zero_recompile_recorder_on_vs_off():
     run — 1 steady-state executable each for the per-step and the scanned
     trainer (the ring rides the one compiled program)."""
     counts = {}
-    for label, rec in (("off", None), ("on", FlightRecorder(8, 4))):
-        exp, engine, tx, state = _flat_setup(flight_rec=rec)
-        step = engine.build_step(exp.loss, tx)
+    for label, flight in (("off", None), ("on", (8, False))):
+        exp, engine, tx, step, make_state = _flat_setup(flight=flight)
+        state = make_state()
         multi = engine.build_multi_step(exp.loss, tx)
         it = exp.make_train_iterator(4, seed=2)
         for _ in range(3):
@@ -204,39 +205,31 @@ def test_zero_recompile_recorder_on_vs_off():
             lambda *xs: np.stack(xs), *[next(it) for _ in range(4)])
         for _ in range(2):
             state, _ = multi(state, engine.shard_batches(chunk))
+        from conftest import assert_zero_recompiles
+
+        assert_zero_recompiles(step, multi)  # recorder on == off == 1
         counts[label] = (step._cache_size(), multi._cache_size())
-    assert counts["on"] == counts["off"] == (1, 1), counts
+    assert counts["on"] == counts["off"], counts
 
 
-@pytest.mark.slow  # transformer compile dominates; the flat-engine tests
-def test_sharded_engine_ring_matches_metrics(rng):  # cover the semantics
-    """The sharded engine writes the same ring: rows bit-identical to its
-    per-step metrics, one compile, per-worker lanes sized (n,)."""
-    from aggregathor_tpu.models import transformer as tfm
-    from aggregathor_tpu.parallel.sharded_engine import ShardedRobustEngine
+def test_sharded_engine_ring_matches_metrics():
+    """The sharded dataflow writes the same ring (replicated, in-scan):
+    rows bit-identical to its per-step metrics, one compile, per-worker
+    lanes sized (n,).  Runs on the cheap sharded-mode stack of the unified
+    engine (conftest factory) — ring parity no longer pays a transformer
+    compile (ISSUE 10 satellite dedup), so it rides tier-1."""
+    from conftest import assert_zero_recompiles
 
-    cfg = tfm.TransformerConfig(vocab_size=17, d_model=8, n_heads=2, n_layers=1)
-    mesh = make_mesh(nb_workers=2)
-    gar = gars.instantiate("average", 4, 0)
-    rec = FlightRecorder(6, 4)
-    eng = ShardedRobustEngine(mesh, gar, nb_workers=4, granularity="layer",
-                              flight=rec)
-    tx = optax.sgd(0.05)
-    state = eng.init_state(
-        lambda k: tfm.init_params(cfg, k, n_stages=1),
-        tfm.param_specs(cfg), tx)
-    loss_fn = tfm.make_pipeline_loss(cfg, n_stages=1, microbatches=1)
-    step = eng.build_step(loss_fn, tx, state)
+    exp, engine, tx, step, make_state = _flat_setup(
+        mode="sharded", nb_devices=2, flight=(6, False))
+    rec, state = engine.flight, make_state()
+    it = exp.make_train_iterator(4, seed=2)
     losses, norms = [], []
     for _ in range(3):
-        batch = {
-            "tokens": rng.integers(0, 17, size=(4, 2, 8)).astype(np.int32),
-            "targets": rng.integers(0, 17, size=(4, 2, 8)).astype(np.int32),
-        }
-        state, m = step(state, eng.shard_batch(batch))
+        state, m = step(state, engine.shard_batch(next(it)))
         losses.append(np.asarray(jax.device_get(m["total_loss"])))
         norms.append(np.asarray(jax.device_get(m["grad_norm"])))
-    assert step._cache_size() == 1
+    assert_zero_recompiles(step)
     window = rec.fetch(state.flight)
     np.testing.assert_array_equal(window["step"], np.arange(3))
     np.testing.assert_array_equal(window["loss"], np.stack(losses))

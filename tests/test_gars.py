@@ -365,7 +365,7 @@ def test_global_granularity_rejected_for_iterative_rules():
     import pytest
 
     from aggregathor_tpu.parallel.mesh import make_mesh
-    from aggregathor_tpu.parallel.sharded_engine import ShardedRobustEngine
+    from aggregathor_tpu.parallel import ShardedRobustEngine
     from aggregathor_tpu.utils import UserException
 
     mesh = make_mesh(nb_workers=2, model_parallelism=2, pipeline_parallelism=2)

@@ -33,7 +33,18 @@ def make_stack(gar_name="median", n=6, f=1, chaos=None, nb_real_byz=0,
                secure=False, lossy_link=None, masking=None, lr=0.05,
                experiment_args=("batch-size:8",)):
     # digits: the 64-dim toy experiment — engine compiles stay cheap on the
-    # 1-core CI box (the mnist MLP's 7850-d graph would dominate the suite)
+    # 1-core CI box (the mnist MLP's 7850-d graph would dominate the suite).
+    # Plain configurations ride the suite-wide cached engine-fixture factory
+    # (tests/conftest.py, ISSUE 10 satellite); chaos/masking/lossy stacks
+    # carry unhashable objects and stay one-off.
+    if chaos is None and lossy_link is None and masking is None:
+        from conftest import build_engine_stack
+
+        exp, engine, tx, step, make_state = build_engine_stack(
+            experiment="digits", experiment_args=tuple(experiment_args),
+            gar=gar_name, n=n, f=f, nb_devices=1, lr=lr,
+            nb_real_byz=nb_real_byz, secure=secure)
+        return exp, engine, step, make_state()
     exp = models.instantiate("digits", list(experiment_args))
     gar = gars.instantiate(gar_name, n, f)
     if masking is not None:
@@ -157,8 +168,9 @@ def test_secure_zero_added_recompiles():
         lambda *xs: np.stack(xs), *[next(it) for _ in range(2)]
     )
     state, many = multi(state, engine.shard_batches(chunk))
-    assert step._cache_size() == step0._cache_size() == 1
-    assert multi._cache_size() == 1
+    from conftest import assert_zero_recompiles
+
+    assert_zero_recompiles(step, step0, multi)
     # unrolled metrics carry the per-step digest stacks: (K, n, lanes)
     assert np.asarray(many["secure"]["digest_sent"]).shape == (2, n, 4)
     assert np.asarray(many["secure"]["rejected"]).shape == (2, n)

@@ -263,19 +263,21 @@ def test_engine_zero_recompile_over_reused_buckets():
     exp, params = _digits()
     engine = InferenceEngine(exp, [params], max_batch=16)
     assert engine.buckets == (1, 2, 4, 8, 16)
+    from conftest import assert_zero_recompiles
+
     engine.warmup()
-    compiled = engine.compile_count
-    assert compiled == len(engine.buckets)
+    compiled = len(engine.buckets)
+    assert_zero_recompiles(engine, expect=compiled)
     x = np.asarray(exp.dataset.x_test[:16], np.float32)
     for size in (1, 3, 5, 8, 16, 2, 7, 16, 1, 11):
         out = engine.predict(x[:size])
         assert out["predictions"].shape == (size,)
         assert out["bucket"] == choose_bucket(size, engine.buckets)
-    assert engine.compile_count == compiled, "steady-state serving recompiled"
+    assert_zero_recompiles(engine, expect=compiled)  # steady state
     # beyond the ladder top: chunked at the largest bucket, still no recompile
     big = engine.predict(np.concatenate([x, x]))
     assert big["predictions"].shape == (32,)
-    assert engine.compile_count == compiled
+    assert_zero_recompiles(engine, expect=compiled)
 
 
 def test_poisoned_replica_masked_by_median_not_average():

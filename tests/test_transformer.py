@@ -16,7 +16,7 @@ from aggregathor_tpu.utils import compat
 from aggregathor_tpu import config, gars
 from aggregathor_tpu.models import transformer as tfm
 from aggregathor_tpu.parallel.mesh import factor_devices, make_mesh
-from aggregathor_tpu.parallel.sharded_engine import ShardedRobustEngine
+from aggregathor_tpu.parallel import ShardedRobustEngine
 
 CFG = tfm.TransformerConfig(vocab_size=17, d_model=16, n_heads=2, n_layers=4)
 
@@ -263,91 +263,93 @@ def test_transformer_experiment_registered():
     assert "transformer" in models.itemize()
 
 
-def test_sharded_engine_bf16_exchange_converges(rng):
-    """bfloat16 per-bucket gathers: per-layer median still trains the MoE
-    transformer (GAR math stays f32 on the upcast rows)."""
-    w, pp, tp = 4, 2, 1
-    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
-    gar = gars.instantiate("median", w, 1)
-    eng = ShardedRobustEngine(mesh, gar, granularity="layer", exchange_dtype="bfloat16")
-    tx = optax.sgd(0.05)
-    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
-    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2)
-    step = eng.build_step(loss_fn, tx, state)
+def test_sharded_engine_bf16_exchange_converges():
+    """bfloat16 per-bucket gathers on the sharded dataflow: per-layer median
+    still trains (GAR math stays f32 on the upcast rows).  Runs on the cheap
+    sharded-mode stack (conftest factory, ISSUE 10 satellite dedup) — the
+    wire-precision path is dataflow plumbing, not transformer-specific; the
+    pipeline/tensor-parallel collectives keep their own tests below."""
+    from conftest import build_engine_stack
+
+    exp, eng, tx, step, make_state = build_engine_stack(
+        mode="sharded", experiment="digits", experiment_args=("batch-size:8",),
+        gar="median", n=4, f=1, nb_devices=2, exchange_dtype="bfloat16")
+    state = make_state()
+    it = exp.make_train_iterator(4, seed=5)
     losses = []
-    for _ in range(8):
-        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+    for _ in range(25):
+        state, metrics = step(state, eng.shard_batch(next(it)))
         losses.append(float(metrics["total_loss"]))
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0], losses
+    # windowed comparison: single digits steps are noisy at this batch size
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
-def test_sharded_engine_momentum_first_step_matches_plain(rng):
+def test_sharded_engine_momentum_first_step_matches_plain():
     """Bias correction makes the first momentum step identical to the plain
-    engine's step on the same batch (flat-engine parity of the policy)."""
-    w, pp, tp = 2, 2, 1
-    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
-    gar = gars.instantiate("average", w, 0)
-    tx = optax.sgd(0.1)
-    loss_fn = tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2)
-    batch = _batch(rng, w)
+    step on the same batch (flat-engine parity of the policy) — on the cheap
+    sharded-mode stack (conftest factory, ISSUE 10 satellite dedup)."""
+    from conftest import build_engine_stack
 
-    def one_step(worker_momentum):
-        eng = ShardedRobustEngine(mesh, gar, granularity="layer",
-                                  worker_momentum=worker_momentum)
-        state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp),
-                               tfm.param_specs(CFG), tx)
-        step = eng.build_step(loss_fn, tx, state)
-        state, _ = step(state, eng.shard_batch(batch))
-        return jax.device_get(state.params)
-
-    with_m, plain = one_step(0.9), one_step(None)
-    for a, b in zip(jax.tree_util.tree_leaves(with_m), jax.tree_util.tree_leaves(plain)):
+    results = {}
+    for momentum in (0.9, None):
+        kw = {} if momentum is None else {"worker_momentum": momentum}
+        exp, eng, tx, step, make_state = build_engine_stack(
+            mode="sharded", experiment="digits",
+            experiment_args=("batch-size:8",), gar="average", n=4, f=0,
+            nb_devices=2, **kw)
+        state = make_state()
+        it = exp.make_train_iterator(4, seed=5)
+        state, _ = step(state, eng.shard_batch(next(it)))
+        results[momentum] = jax.device_get(state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(results[0.9]),
+                    jax.tree_util.tree_leaves(results[None])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
-def test_sharded_engine_momentum_under_attack_converges(rng):
-    from aggregathor_tpu.parallel.attacks import instantiate as make_attack
+def test_sharded_engine_momentum_under_attack_converges():
+    """History-aware robustness on the sharded dataflow (cheap sharded-mode
+    stack; ISSUE 10 satellite dedup): per-worker momentum buffers carried
+    worker-sharded, krum resists a sign-flipping coalition."""
+    from conftest import build_engine_stack
 
-    w, pp, tp = 4, 2, 1
-    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
-    gar = gars.instantiate("krum", w, 1)
-    eng = ShardedRobustEngine(mesh, gar, nb_real_byz=1,
-                              attack=make_attack("signflip", w, 1),
-                              granularity="layer", worker_momentum=0.8)
-    tx = optax.sgd(0.05)
-    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+    exp, eng, tx, step, make_state = build_engine_stack(
+        mode="sharded", experiment="digits", experiment_args=("batch-size:8",),
+        gar="krum", n=4, f=1, nb_devices=2, attack="signflip",
+        nb_real_byz=1, worker_momentum=0.8)
+    state = make_state()
     assert state.momentum is not None
-    step = eng.build_step(tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2), tx, state)
+    it = exp.make_train_iterator(4, seed=5)
     losses = []
-    for _ in range(8):
-        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+    for _ in range(25):
+        state, metrics = step(state, eng.shard_batch(next(it)))
         losses.append(float(metrics["total_loss"]))
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0], losses
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
-def test_sharded_engine_clever_lossy(rng):
-    """CLEVER stale infill on the sharded engine: plain average stays finite
-    and trains under a lossy worker, where NaN infill would poison params."""
-    from aggregathor_tpu.parallel.lossy import LossyLink
+def test_sharded_engine_clever_lossy():
+    """CLEVER stale infill on the sharded dataflow (cheap sharded-mode
+    stack; ISSUE 10 satellite dedup): plain average stays finite and trains
+    under a lossy worker, where NaN infill would poison params."""
+    from conftest import build_engine_stack
 
-    w, pp, tp = 2, 2, 1
-    mesh = make_mesh(nb_workers=w, model_parallelism=tp, pipeline_parallelism=pp)
-    gar = gars.instantiate("average", w, 0)
-    link = LossyLink(1, ["drop-rate:0.3", "packet-coords:64", "min-coords:0", "clever:true"])
-    eng = ShardedRobustEngine(mesh, gar, lossy_link=link, granularity="layer")
-    tx = optax.sgd(0.05)
-    state = eng.init_state(lambda k: tfm.init_params(CFG, k, n_stages=pp), tfm.param_specs(CFG), tx)
+    exp, eng, tx, step, make_state = build_engine_stack(
+        mode="sharded", experiment="digits", experiment_args=("batch-size:8",),
+        gar="average", n=2, f=0, nb_devices=2,
+        lossy=(1, "drop-rate:0.3", "packet-coords:64", "min-coords:0",
+               "clever:true"))
+    state = make_state()
     assert state.carry is not None
-    step = eng.build_step(tfm.make_pipeline_loss(CFG, n_stages=pp, microbatches=2), tx, state)
+    it = exp.make_train_iterator(2, seed=5)
     losses = []
-    for _ in range(8):
-        state, metrics = step(state, eng.shard_batch(_batch(rng, w)))
+    for _ in range(25):
+        state, metrics = step(state, eng.shard_batch(next(it)))
         losses.append(float(metrics["total_loss"]))
     assert np.isfinite(losses).all(), losses
-    assert losses[-1] < losses[0], losses
-    finite = [bool(np.isfinite(np.asarray(l)).all()) for l in jax.tree_util.tree_leaves(state.params)]
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+    finite = [bool(np.isfinite(np.asarray(l)).all())
+              for l in jax.tree_util.tree_leaves(state.params)]
     assert all(finite)
 
 
