@@ -1,0 +1,81 @@
+"""graftcheck: the repo-native static-analysis pass (docs/analysis.md).
+
+Four checkers prove — everywhere in the package, on every PR — the
+invariants the tests only sample at the configs they happen to run:
+
+- **retrace** (``retrace.py``): the zero-recompile discipline — no jit
+  built per call, no host sync / Python branch on traced values, no
+  unhashable statics.
+- **prng** (``prng.py``): key hygiene — no key consumed twice without a
+  split/fold_in, no minted-and-dropped randomness.
+- **concurrency** (``concurrency.py``): no unlocked attribute writes on
+  thread-reachable code paths.
+- **gar-contract** (``gar_contract.py``): every registered GAR spec honors
+  its declared contract (NaN tolerance, parse-time feasibility,
+  participation scatter, dtype preservation) under ``eval_shape`` + tiny
+  concrete probes.
+
+Run as a CLI (``python -m aggregathor_tpu.analysis``), as tier-1 tests
+(``tests/test_analysis.py``) and from ``scripts/run_analysis.sh``.
+Accepted findings live in ``baseline.json`` with per-entry justifications;
+new findings, stale entries and empty justifications all fail the gate.
+"""
+
+from . import baseline, concurrency, core, gar_contract, prng, report, retrace
+from .core import Finding
+
+#: name -> (module, needs_source): the checker registry the CLI and tests
+#: iterate — adding a checker means adding a module with ``check(modules)``
+#: and one line here (docs/analysis.md "Adding a checker")
+CHECKERS = {
+    "retrace": retrace,
+    "prng": prng,
+    "concurrency": concurrency,
+    "gar-contract": gar_contract,
+}
+
+#: finding-code prefixes owned by each checker (plus the pass's own):
+#: baseline staleness (BL001) is only asserted for entries whose owning
+#: checker actually ran, so a ``--checkers`` subset cannot misreport the
+#: others' justified entries as stale
+CHECKER_CODES = {
+    "retrace": ("RT",),
+    "prng": ("PK",),
+    "concurrency": ("CC",),
+    "gar-contract": ("GC",),
+}
+
+
+def active_codes(checkers=None):
+    """Code prefixes for a checker selection (None = every checker ran,
+    plus the scan's own PARSE findings)."""
+    selected = list(CHECKERS) if checkers is None else list(checkers)
+    codes = ["PARSE"]
+    for name in selected:
+        codes.extend(CHECKER_CODES.get(name, ()))
+    return tuple(codes)
+
+
+def run_checkers(root=None, paths=None, checkers=None, gar_specs=None):
+    """Run the selected checkers; returns (findings, scan_errors).
+
+    AST checkers share one cached module scan (core.scan_modules); the
+    gar-contract checker ignores the scan and probes the live registry.
+    """
+    root = root or core.package_root()
+    selected = list(CHECKERS) if checkers is None else list(checkers)
+    unknown = [name for name in selected if name not in CHECKERS]
+    if unknown:
+        raise ValueError(
+            "unknown checker(s) %r; available: %s"
+            % (unknown, ", ".join(sorted(CHECKERS)))
+        )
+    needs_scan = any(name != "gar-contract" for name in selected)
+    modules, errors = core.scan_modules(root, paths) if needs_scan else ([], [])
+    findings = []
+    for name in selected:
+        if name == "gar-contract":
+            findings.extend(gar_contract.check(specs=gar_specs))
+        else:
+            findings.extend(CHECKERS[name].check(modules))
+    return findings, errors

@@ -1,0 +1,240 @@
+"""Concurrency lint: host threads never touch shared state unlocked.
+
+The package runs real threads in production paths — the bounded-wait
+submission pool (``parallel/bounded.py``), the input ``ChunkPipeline``
+(``models/datasets.py``), the serve ``MicroBatcher`` dispatcher
+(``serve/batcher.py``), the live exporter (``obs/live.py``) and the
+background checkpoint writer (``obs/checkpoint.py``).  The dynamic tests
+exercise each at one schedule; this checker proves the *pattern* —
+unlocked attribute writes on thread-reachable code paths — is absent (or
+explicitly baselined with its safety argument) package-wide.
+
+Algorithm:
+
+1. **Spawn sites**: every ``threading.Thread(target=X)``,
+   ``threading.Timer(_, X)`` and ``<pool>.submit(X, ...)`` in the module.
+   ``X`` resolves intra-module (bare names, nested defs, ``self.method``);
+   unresolvable targets (stdlib callables like ``serve_forever``) are
+   skipped — their bodies are not ours to lint.
+2. **Reachability**: the transitive intra-module call closure from the
+   spawn targets (``core.reachable_functions``) — the set of functions
+   that may execute on a non-main thread.
+3. **CC001**: inside that set, an attribute write (``obj.attr = ...``,
+   ``obj.attr += ...``, ``obj.attr[i] = ...``) whose base object is not
+   function-local, not lexically inside a ``with <lock>`` block, and not
+   in ``__init__`` (construction happens before the thread exists).
+   Lock recognition is lexical: the context expression's last segment
+   contains ``lock``/``mutex``/``cond``/``guard``/``sem``.
+
+What a CC001 baseline entry must argue (docs/analysis.md): why the write
+is safe — single-writer with GIL-atomic reference assignment, an
+Event/queue handshake ordering the read after the write, or monotonic
+telemetry where staleness is tolerated.  "It has not crashed yet" is not
+an argument; an empty justification is itself a finding (BL002).
+"""
+
+import ast
+import re
+
+from .core import (
+    Finding,
+    callee_name,
+    callee_tail,
+    dotted_name,
+    enclosing_function,
+    reachable_functions,
+)
+
+CHECKER = "concurrency"
+
+LOCKISH = frozenset({
+    "lock", "rlock", "mutex", "cond", "condition", "sem", "semaphore",
+    "guard", "latch",
+})
+
+
+def _is_lockish(expr):
+    """Last name segment of a with-context looks like a lock.
+
+    Token match, not substring: the name is split on underscores and camel
+    humps and a token must EQUAL a lock word (or end with ``lock``, for
+    ``qlock``-style names) — ``assembler`` must not whitelist a block just
+    because it contains ``sem``."""
+    name = callee_name(expr) if isinstance(expr, ast.Call) else dotted_name(expr)
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    tokens = [t for t in re.split(r"_|(?<=[a-z0-9])(?=[A-Z])", tail) if t]
+    return any(t.lower() in LOCKISH or t.lower().endswith("lock")
+               for t in tokens)
+
+
+def _spawn_targets(module):
+    """Function defs handed to Thread(target=)/Timer/pool.submit."""
+    targets = []
+
+    def resolve(arg, site):
+        """ALL function defs ``arg`` may denote (a ``self.X`` spawn in a
+        module with several classes defining ``X`` must cover every one —
+        the conservative over-approximation)."""
+        if isinstance(arg, ast.Name):
+            caller = enclosing_function(module, site)
+            scope = caller
+            while scope is not None:
+                for node in ast.walk(scope):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                            and node.name == arg.id:
+                        return [node]
+                scope = enclosing_function(module, scope)
+            return [
+                node for node in module.tree.body
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == arg.id
+            ]
+        if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name) \
+                and arg.value.id in ("self", "cls"):
+            return [
+                stmt
+                for node in ast.walk(module.tree)
+                if isinstance(node, ast.ClassDef)
+                for stmt in node.body
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name == arg.attr
+            ]
+        return []
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = callee_tail(node)
+        if tail in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    targets.extend(resolve(kw.value, node))
+            if tail == "Timer" and len(node.args) >= 2:
+                targets.extend(resolve(node.args[1], node))
+        elif tail == "submit" and node.args:
+            targets.extend(resolve(node.args[0], node))
+    return targets
+
+
+def _attr_write_base(target):
+    """(base-name, attr-symbol) of an attribute-write target, else None.
+
+    ``self.x = _``        -> ("self", "x")
+    ``pending.error = _`` -> ("pending", "error")
+    ``self.buf[i] = _``   -> ("self", "buf[]")
+    """
+    if isinstance(target, ast.Subscript):
+        inner = _attr_write_base(target.value)
+        if inner is not None:
+            return inner[0], inner[1] + "[]"
+        if isinstance(target.value, ast.Name):
+            return None  # plain local-subscript writes are the owner's call
+        return None
+    if isinstance(target, ast.Attribute):
+        cur = target.value
+        while isinstance(cur, ast.Attribute):
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            return cur.id, target.attr
+    return None
+
+
+def _local_names(func):
+    """Names bound by plain (non-attribute) assignment/for/with in ``func``
+    — writes through them are writes to objects this function created or
+    was handed privately ONLY when they never alias shared state; we treat
+    params as shared (the spawn call passes shared objects in)."""
+    created = set()
+    params = {
+        a.arg
+        for a in list(func.args.posonlyargs) + list(func.args.args)
+        + list(func.args.kwonlyargs)
+    }
+
+    def reads_shared(value):
+        # an alias of shared state (``st = self.state``) is NOT private: a
+        # one-line alias must not defeat the lint
+        return any(
+            isinstance(n, ast.Name) and n.id in ("self", "cls")
+            for n in ast.walk(value)
+        )
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            if reads_shared(node.value):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    created.add(t.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    created.add(n.id)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for n in ast.walk(item.optional_vars):
+                        if isinstance(n, ast.Name):
+                            created.add(n.id)
+    return created - params - {"self", "cls"}
+
+
+def check_module(module):
+    findings = []
+    spawned = _spawn_targets(module)
+    if not spawned:
+        return findings
+    for func in reachable_functions(module, spawned):
+        if func.name == "__init__":
+            continue
+        scope = module.qualname(func)
+        locals_ = _local_names(func)
+
+        def lock_depth(node, func=func):
+            depth = 0
+            cur = module.parent(node)
+            while cur is not None and cur is not func:
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    if any(_is_lockish(item.context_expr) for item in cur.items):
+                        depth += 1
+                cur = module.parent(cur)
+            return depth
+
+        for node in ast.walk(func):
+            if enclosing_function(module, node) is not func:
+                continue  # nested defs are checked via their own reachability
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]  # a bare annotation writes nothing
+            for target in targets:
+                base = _attr_write_base(target)
+                if base is None:
+                    continue
+                base_name, symbol = base
+                if base_name in locals_:
+                    continue  # object this function created itself
+                if lock_depth(node) > 0:
+                    continue
+                findings.append(Finding(
+                    CHECKER, "CC001", module.path, node.lineno, scope,
+                    "%s.%s" % (base_name, symbol),
+                    "unlocked write to %s.%s on a thread-reachable path: "
+                    "hold the owning lock, or baseline with the safety "
+                    "argument (single-writer handshake, GIL-atomic "
+                    "reference, tolerated-staleness telemetry)"
+                    % (base_name, symbol),
+                ))
+    return findings
+
+
+def check(modules):
+    findings = []
+    for module in modules:
+        findings.extend(check_module(module))
+    return findings

@@ -1,0 +1,468 @@
+"""PRNG-hygiene checker: one key, one consumer.
+
+JAX keys are values, not streams — feeding the same key to two samplers
+yields IDENTICAL draws, and reusing a key after splitting it reuses the
+randomness the split already spent.  In this codebase that is not a
+style nit: the attack, lossy-link and GAR permutation streams are all
+derived from one per-step key by ``fold_in`` tags (``GAR_KEY_TAG``), and a
+collision silently correlates the adversary with the defense.  Dynamic
+tests only notice when the correlated draws happen to change a golden;
+this checker proves the absence of the reuse *patterns* package-wide.
+
+Rules (per function body, forward dataflow over local names):
+
+- **PK001 key reuse** — a key name consumed twice with no intervening
+  rebind.  Consumption = passing the key to a sampler (``jax.random.*``),
+  to ``split`` (without rebinding the same name), or to any other callable
+  (a "consumer" — two different consumers of one key is exactly the bug).
+  ``fold_in(key, tag)`` does NOT consume: folding distinct data mints
+  distinct keys (the engine idiom) — but two *textually identical*
+  ``fold_in`` calls in one straight-line region are a reuse.
+- **PK002 dropped split** — a ``split``/``fold_in`` result that is never
+  bound (bare expression statement) or a split target never read
+  afterwards: randomness was minted and thrown away, which almost always
+  means some consumer is still holding the parent key.
+
+Approximation contract (docs/analysis.md): branches fork the state and
+merge optimistically (a kill in one arm does not kill after the join);
+loop bodies are analyzed once with no cross-iteration carry — both choices
+trade recall for a near-zero false-positive rate, the right trade for a
+gate that must stay green on every PR.
+"""
+
+import ast
+
+from .core import Finding, callee_name
+
+CHECKER = "prng"
+
+#: callee tails that mint keys
+KEY_MAKERS = frozenset({"PRNGKey", "key", "split", "fold_in"})
+
+#: jax.random sampler tails that consume a key (first arg or ``key=``)
+SAMPLERS = frozenset({
+    "normal", "uniform", "bernoulli", "permutation", "randint", "choice",
+    "gumbel", "truncated_normal", "categorical", "bits", "exponential",
+    "laplace", "shuffle", "beta", "dirichlet", "gamma", "poisson",
+    "rademacher", "ball", "orthogonal", "multivariate_normal",
+})
+
+#: parameter-name shapes that declare a key argument
+KEY_PARAM_NAMES = frozenset({"key", "rng", "prng", "prng_key", "rng_key"})
+
+LIVE, CONSUMED = "live", "consumed"
+
+#: roots under which ``split``/``fold_in``/``PRNGKey`` are the jax.random
+#: ones (``setting.split("=")`` must not look like key surgery)
+RANDOM_ROOTS = frozenset({"jax", "random", "jrandom", "jr"})
+
+#: call roots that never consume a key stream: passing a key through
+#: numerical/structural ops (jnp.stack of keys, a debug norm) is not a
+#: second CONSUMER in the reuse sense
+NONCONSUMING_ROOTS = frozenset({"jnp", "np", "numpy", "lax", "math", "len",
+                                "print", "repr", "str", "int", "float",
+                                "isinstance", "type", "list", "tuple"})
+
+
+def _key_op(call):
+    """``split``/``fold_in``/``PRNGKey``/``key`` when ``call`` is a
+    jax.random operation (bare name, or dotted under a random-ish root),
+    else None."""
+    name = callee_name(call)
+    if name is None:
+        return None
+    parts = name.split(".")
+    tail = parts[-1]
+    if tail not in KEY_MAKERS:
+        return None
+    if len(parts) == 1:
+        return tail  # ``from jax import random`` style bare import
+    return tail if parts[0] in RANDOM_ROOTS else None
+
+
+def _is_key_param(name):
+    return name in KEY_PARAM_NAMES or name.endswith("_key") or name.endswith("_rng")
+
+
+def _store_names(target):
+    return [n.id for n in ast.walk(target)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)]
+
+
+class _FunctionState:
+    """Per-linear-region key liveness; forked at branches."""
+
+    def __init__(self):
+        self.keys = {}        # name -> LIVE | CONSUMED
+        self.consumed_at = {}  # name -> (line, how)
+        self.folds = {}       # name -> {call-dump}
+
+    def fork(self):
+        child = _FunctionState()
+        child.keys = dict(self.keys)
+        child.consumed_at = dict(self.consumed_at)
+        child.folds = {k: set(v) for k, v in self.folds.items()}
+        return child
+
+    def merge(self, *branches):
+        # optimistic join: a key is CONSUMED after the join only when EVERY
+        # branch consumed it (a kill in one arm must not convict the other)
+        for name in list(self.keys):
+            states = [b.keys.get(name, self.keys[name]) for b in branches]
+            if all(s == CONSUMED for s in states) and states:
+                self.keys[name] = CONSUMED
+                for b in branches:
+                    if name in b.consumed_at:
+                        self.consumed_at[name] = b.consumed_at[name]
+                        break
+        for b in branches:
+            for name, dumps in b.folds.items():
+                # every arm's folds stay recorded past the join: a later
+                # textually identical fold collides with WHICHEVER arm ran
+                # (duplicates ACROSS arms are distinct paths — each arm was
+                # checked in isolation, so they were never flagged)
+                self.folds.setdefault(name, set()).update(dumps)
+
+
+def _param_names(func):
+    """POSITIONAL parameter names, in binding order (used to map caller
+    positional args onto callee params)."""
+    args = func.args
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+def _all_param_names(func):
+    """Every parameter name incl. keyword-only (used to SEED the
+    derive-only table — a kw-only ``def draw(*, key)`` is as much a key
+    consumer surface as a positional one)."""
+    args = func.args
+    return _param_names(func) + [a.arg for a in args.kwonlyargs]
+
+
+def _calls_taking(func, param):
+    """Call nodes in ``func`` with ``param`` as a direct argument."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            direct = list(node.args) + [kw.value for kw in node.keywords]
+            if any(isinstance(a, ast.Name) and a.id == param for a in direct):
+                yield node
+
+
+def _resolve_callee(module, call):
+    """Function defs a call may denote, intra-module (bare name or
+    ``self.X``/``cls.X`` against every class — the over-approximation the
+    concurrency checker also uses)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return [f for f in module.functions() if f.name == fn.id]
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id in ("self", "cls"):
+        return [f for f in module.functions() if f.name == fn.attr]
+    return []
+
+
+def _receiving_params(call, callee, param):
+    """Names of ``callee``'s params bound to caller-side name ``param``."""
+    params = _param_names(callee)
+    method = bool(params) and params[0] in ("self", "cls")
+    if method:
+        params = params[1:]
+    received = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Name) and arg.id == param and i < len(params):
+            received.append(params[i])
+    for kw in call.keywords:
+        if isinstance(kw.value, ast.Name) and kw.value.id == param and kw.arg:
+            received.append(kw.arg)
+    return received
+
+
+def derive_only_params(module):
+    """Greatest-fixpoint set of ``(function, param)`` pairs where the key
+    param is only ever DERIVED from (``fold_in`` with fresh data, or handed
+    to another derive-only param) — the engine idiom: one per-step key,
+    disjoint ``fold_in`` tags per consumer (``GAR_KEY_TAG``).  Passing a
+    key to such a function is not a consumption."""
+    table = {}
+    for func in module.functions():
+        for param in _all_param_names(func):
+            if _is_key_param(param):
+                table[(func, param)] = True
+    changed = True
+    while changed:
+        changed = False
+        for (func, param), ok in list(table.items()):
+            if not ok:
+                continue
+            for call in _calls_taking(func, param):
+                if _key_op(call) == "fold_in":
+                    continue
+                root = (callee_name(call) or "").split(".")[0]
+                if root in NONCONSUMING_ROOTS:
+                    continue
+                callees = _resolve_callee(module, call)
+                if callees and all(
+                    table.get((c, q), False)
+                    for c in callees
+                    for q in (_receiving_params(call, c, param) or [None])
+                ) and all(_receiving_params(call, c, param) for c in callees):
+                    continue  # delegated to (currently) derive-only params
+                table[(func, param)] = False
+                changed = True
+                break
+    return {pair for pair, ok in table.items() if ok}
+
+
+class Checker:
+    def __init__(self, module, func, derive_only=frozenset()):
+        self.module = module
+        self.func = func
+        self.scope = module.qualname(func)
+        self.derive_only = derive_only
+        self.findings = []
+        self.split_targets = {}  # name -> line (for the unread-split pass)
+
+    def finding(self, code, line, symbol, message):
+        self.findings.append(Finding(
+            CHECKER, code, self.module.path, line, self.scope, symbol, message,
+        ))
+
+    # ------------------------------------------------------------------ #
+
+    def run(self):
+        state = _FunctionState()
+        args = self.func.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if _is_key_param(a.arg):
+                state.keys[a.arg] = LIVE
+        self._block(self.func.body, state)
+        self._unread_splits()
+        return self.findings
+
+    def _unread_splits(self):
+        """PK002: split targets never read after their binding."""
+        loads = {}
+        for node in ast.walk(self.func):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads.setdefault(node.id, []).append(node.lineno)
+        for name, line in self.split_targets.items():
+            if name.startswith("_"):
+                continue  # explicit discard
+            if not any(at > line or at == line for at in loads.get(name, [])):
+                self.finding(
+                    "PK002", line, name,
+                    "split result %r is never consumed: randomness minted "
+                    "and dropped — the parent key is probably still doing "
+                    "its job" % name,
+                )
+
+    # ------------------------------------------------------------------ #
+
+    def _block(self, stmts, state):
+        for stmt in stmts:
+            self._stmt(stmt, state)
+
+    def _stmt(self, stmt, state):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own Checker
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, state)
+            then, other = state.fork(), state.fork()
+            self._block(stmt.body, then)
+            self._block(stmt.orelse, other)
+            state.merge(then, other)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, state)
+            else:
+                self._expr(stmt.test, state)
+            body = state.fork()
+            # fresh fold/consumption memory per iteration: cross-iteration
+            # reuse of fold_in(key, i) with loop-varying data is the IDIOM
+            for name in list(body.folds):
+                body.folds[name] = set()
+            self._block(stmt.body, body)
+            self._block(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.Try,)):
+            body = state.fork()
+            self._block(stmt.body, body)
+            for handler in stmt.handlers:
+                self._block(handler.body, state.fork())
+            self._block(stmt.orelse, body)
+            self._block(stmt.finalbody, body)
+            state.merge(body)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, state)
+            self._block(stmt.body, state)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value, state)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value, state)
+            return
+        if isinstance(stmt, ast.Expr):
+            call = stmt.value
+            if isinstance(call, ast.Call) and _key_op(call) in ("split", "fold_in"):
+                self.finding(
+                    "PK002", stmt.lineno, _key_op(call),
+                    "%s(...) result discarded: the fresh key is lost and "
+                    "the parent key stays in circulation" % _key_op(call),
+                )
+                return
+            self._expr(stmt.value, state)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            # returning the key ITSELF hands ownership out (not a
+            # consumption) — but samplers inside the returned expression
+            # absolutely consume (`return normal(key, ...)`)
+            if not isinstance(stmt.value, ast.Name):
+                self._expr(stmt.value, state)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, state)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, state)
+
+    # ------------------------------------------------------------------ #
+
+    def _key_args(self, call, state):
+        """Tracked key names appearing as arguments of ``call``."""
+        names = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in state.keys:
+                names.append(arg.id)
+        return names
+
+    def _consume(self, name, state, line, how):
+        if state.keys.get(name) == CONSUMED:
+            prev_line, prev_how = state.consumed_at.get(name, (line, how))
+            self.finding(
+                "PK001", line, name,
+                "key %r consumed twice without an intervening split/fold_in "
+                "(first %s at line %d, again %s here): both consumers see "
+                "IDENTICAL randomness" % (name, prev_how, prev_line, how),
+            )
+        state.keys[name] = CONSUMED
+        state.consumed_at[name] = (line, how)
+
+    def _is_sampler(self, call):
+        name = callee_name(call)
+        if name is None:
+            return False
+        parts = name.split(".")
+        if parts[-1] not in SAMPLERS:
+            return False
+        return len(parts) == 1 or parts[0] in RANDOM_ROOTS
+
+    def _fold(self, call, state):
+        for name in self._key_args(call, state):
+            dump = ast.dump(call)
+            seen = state.folds.setdefault(name, set())
+            if dump in seen:
+                self.finding(
+                    "PK001", call.lineno, name,
+                    "identical fold_in of key %r twice in one region: both "
+                    "folds mint the SAME key" % name,
+                )
+            seen.add(dump)
+
+    def _assign(self, targets, value, state):
+        stores = []
+        for t in targets:
+            stores.extend(_store_names(t))
+        if isinstance(value, ast.Call):
+            op = _key_op(value)
+            key_args = self._key_args(value, state)
+            if op == "split":
+                for name in key_args:
+                    if name not in stores:
+                        # split without rebinding the parent: the parent key
+                        # is spent — any later consumer reuses it (PK001 via
+                        # _consume when it was already spent here)
+                        self._consume(name, state, value.lineno, "by split")
+                for name in stores:
+                    state.keys[name] = LIVE
+                    self.split_targets.setdefault(name, value.lineno)
+                return
+            if op == "fold_in":
+                self._fold(value, state)
+                for name in stores:
+                    state.keys[name] = LIVE
+                return
+            if op in ("PRNGKey", "key"):
+                for name in stores:
+                    state.keys[name] = LIVE
+                return
+            # not a key op: sampler / generic call — consumes its key args
+            self._expr(value, state)
+            for name in stores:
+                if name in state.keys:
+                    # rebound from a non-key value: stop tracking as a key
+                    del state.keys[name]
+            return
+        # non-call value: alias/rebind clears tracking for the target names
+        for name in stores:
+            if name in state.keys:
+                del state.keys[name]
+        self._expr(value, state)
+
+    def _expr(self, expr, state):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            op = _key_op(node)
+            if op == "fold_in":
+                self._fold(node, state)
+                continue
+            if op == "split":
+                for name in self._key_args(node, state):
+                    self._consume(name, state, node.lineno, "by split")
+                continue
+            if op is not None:
+                continue  # PRNGKey(...) mints, consumes nothing
+            key_args = self._key_args(node, state)
+            if not key_args:
+                continue
+            if self._is_sampler(node):
+                for name in key_args:
+                    self._consume(
+                        name, state, node.lineno,
+                        "by sampler %s" % (callee_name(node) or "?"),
+                    )
+                continue
+            root = (callee_name(node) or "").split(".")[0]
+            if root in NONCONSUMING_ROOTS:
+                continue  # numerical/structural op, not a stream consumer
+            callees = _resolve_callee(self.module, node)
+            for name in key_args:
+                if callees and all(
+                    (c, q) in self.derive_only
+                    for c in callees
+                    for q in _receiving_params(node, c, name)
+                ) and all(_receiving_params(node, c, name) for c in callees):
+                    continue  # callee only fold_ins the key: not a consumer
+                self._consume(
+                    name, state, node.lineno,
+                    "by %s" % (callee_name(node) or "a call"),
+                )
+
+
+def check_module(module):
+    findings = []
+    derive_only = derive_only_params(module)
+    for func in module.functions():
+        findings.extend(Checker(module, func, derive_only).run())
+    return findings
+
+
+def check(modules):
+    findings = []
+    for module in modules:
+        findings.extend(check_module(module))
+    return findings

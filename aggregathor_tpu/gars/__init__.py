@@ -160,6 +160,18 @@ class GAR:
             raise UserException("GAR %r needs at least 1 worker" % type(self).__name__)
         if self.nb_byz_workers < 0:
             raise UserException("Negative declared Byzantine count")
+        # Universal feasibility floor (graftcheck GC002): NO rule can
+        # tolerate a Byzantine majority of everyone — f >= n leaves zero
+        # honest rows to aggregate, and every declared-f budget downstream
+        # (NaN infill, bounded-wait timeouts, forgery rejection, guardian
+        # f+K re-sizing) silently overdraws.  Rejected here, at parse time,
+        # for every rule — per-rule checks only tighten this further.
+        if self.nb_byz_workers >= self.nb_workers:
+            raise UserException(
+                "GAR %r cannot declare f=%d >= n=%d: at least one worker "
+                "must be honest for any aggregate to mean anything"
+                % (type(self).__name__, self.nb_byz_workers, self.nb_workers)
+            )
 
     def aggregate(self, grads, key=None):
         """Dense tier: reduce the full (n, d) matrix to (d,)."""

@@ -28,7 +28,6 @@ pipeline stage; ``param_specs`` gives the matching ``PartitionSpec`` tree.
 """
 
 import dataclasses
-import functools
 import math
 import os
 

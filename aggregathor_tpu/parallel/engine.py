@@ -31,13 +31,11 @@ aggregation, since the GAR provably needs per-worker gradients, not their sum
 (SURVEY.md §2.6).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import config
 from ..core.flatten import FlatMap

@@ -71,8 +71,8 @@ STATE_PATH = os.path.join(REPO, "scripts", "tpu_capture_state.json")
 LOG_PATH = os.path.join(REPO, "benchmarks", "tpu_capture.jsonl")
 
 sys.path.insert(0, REPO)
-from aggregathor_tpu.utils.state import load_json, save_json_atomic  # noqa: E402
 from aggregathor_tpu.utils.capture import is_complete_tpu_datum as _tpu_datum  # noqa: E402
+from aggregathor_tpu.utils.state import load_json, save_json_atomic  # noqa: E402
 
 PROBE_CODE = (
     "import jax, jax.numpy as jnp;"

@@ -1,0 +1,660 @@
+"""graftcheck tests: seeded violations, baseline round-trip, GAR contract
+sweep, clean-package gate (ISSUE 11; docs/analysis.md).
+
+Layout mirrors the checker contract:
+
+- one seeded-violation fixture per checker, each tripping EXACTLY its
+  checker and nothing else (a fixture that trips two checkers would hide a
+  regression in either);
+- the baseline lifecycle — add (empty justification stays red), justify
+  (green), expire (stale entry is a finding);
+- the GAR contract sweep covering 100% of the registry, asserted against
+  ``gars.itemize()`` rather than a hand-kept list, plus ``hier:`` /
+  ``bucketing:`` nestings;
+- the clean-package assertion: the shipped baseline makes the whole
+  package pass — the same gate ``scripts/run_analysis.sh --check`` runs.
+
+Whole-package AST scans and the GAR probe sweep are cached per process
+(``core._MODULE_CACHE``, ``gar_contract._check_cached``), so the suite
+pays for each once however many tests consume them.
+"""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars
+from aggregathor_tpu.analysis import (
+    CHECKERS,
+    baseline as baseline_mod,
+    concurrency,
+    core,
+    gar_contract,
+    prng,
+    report as report_mod,
+    retrace,
+    run_checkers,
+)
+from aggregathor_tpu.utils import UserException
+
+AST_CHECKERS = {name: mod for name, mod in CHECKERS.items() if name != "gar-contract"}
+
+
+def snippet_module(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return core.Module(str(tmp_path), name, textwrap.dedent(source))
+
+
+def run_ast_checkers(module):
+    """(checker name -> findings) for one snippet across ALL AST checkers."""
+    return {name: mod.check([module]) for name, mod in AST_CHECKERS.items()}
+
+
+# --------------------------------------------------------------------- #
+# seeded violations: one per checker, tripping exactly that checker
+
+
+RETRACE_SNIPPET = """
+    import jax
+    import jax.numpy as jnp
+
+
+    def build_many(step_fn):
+        fns = []
+        for _ in range(3):
+            fns.append(jax.jit(step_fn))      # RT001: jit per iteration
+        return fns
+
+
+    def hot(x):
+        y = float(x)                          # RT002: host sync on traced x
+        if x > 0:                             # RT003: Python branch on traced x
+            y = y + 1.0
+        return jnp.asarray(y)
+
+
+    def lowered(x, opts=[1, 2]):
+        return x
+
+
+    fast = jax.jit(hot)
+    slow = jax.jit(lowered, static_argnames=("opts",))   # RT004: mutable static
+"""
+
+PRNG_SNIPPET = """
+    import jax
+
+
+    def sample(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))     # PK001: key consumed twice
+        return a + b
+
+
+    def mint_and_drop(key):
+        jax.random.split(key)                 # PK002: split result discarded
+        return jax.random.normal(key, (3,))
+"""
+
+CONCURRENCY_SNIPPET = """
+    import threading
+
+
+    class Worker:
+        def __init__(self):
+            self.count = 0
+            self.note = None
+            self._lock = threading.Lock()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            self.note = "hot"                 # CC001: unlocked shared write
+            self._helper()
+            with self._lock:
+                self.count += 1               # locked: fine
+
+        def _helper(self):
+            self.count += 1                   # CC001: reachable, unlocked
+"""
+
+
+def test_retrace_fixture_trips_only_retrace(tmp_path):
+    module = snippet_module(tmp_path, "seeded_retrace.py", RETRACE_SNIPPET)
+    results = run_ast_checkers(module)
+    codes = sorted({f.code for f in results["retrace"]})
+    assert codes == ["RT001", "RT002", "RT003", "RT004"], results["retrace"]
+    assert results["prng"] == [], results["prng"]
+    assert results["concurrency"] == [], results["concurrency"]
+
+
+def test_prng_fixture_trips_only_prng(tmp_path):
+    module = snippet_module(tmp_path, "seeded_prng.py", PRNG_SNIPPET)
+    results = run_ast_checkers(module)
+    codes = sorted({f.code for f in results["prng"]})
+    assert codes == ["PK001", "PK002"], results["prng"]
+    assert results["retrace"] == [], results["retrace"]
+    assert results["concurrency"] == [], results["concurrency"]
+    reuse = [f for f in results["prng"] if f.code == "PK001"]
+    assert any(f.scope == "sample" and f.symbol == "key" for f in reuse)
+
+
+def test_concurrency_fixture_trips_only_concurrency(tmp_path):
+    module = snippet_module(tmp_path, "seeded_concurrency.py", CONCURRENCY_SNIPPET)
+    results = run_ast_checkers(module)
+    assert sorted({f.code for f in results["concurrency"]}) == ["CC001"]
+    # both the direct write and the transitively-reachable helper's write
+    scopes = {f.scope for f in results["concurrency"]}
+    assert scopes == {"Worker._run", "Worker._helper"}, scopes
+    assert results["retrace"] == [], results["retrace"]
+    assert results["prng"] == [], results["prng"]
+
+
+class _LyingGAR(gars.GAR):
+    """Seeded gar-contract violation: every declaration is false.
+
+    Declares NaN tolerance but averages (GC001), skips the feasibility
+    floor (GC002), reports a participation scatter summing to 2 (GC003)
+    and returns float64 (GC004) — the checker must convict each claim."""
+
+    nan_row_tolerant = True
+    coordinate_wise = True
+
+    def check(self):  # deliberately bypasses the f < n floor
+        pass
+
+    def aggregate_block(self, block, dist2=None):
+        import jax.numpy as jnp
+
+        # bfloat16 (not float64): the drifted dtype must exist without x64
+        # mode or jax silently truncates the lie back to float32
+        return jnp.mean(block, axis=0).astype(jnp.bfloat16)
+
+    def worker_participation(self, dist2):
+        import jax.numpy as jnp
+
+        return jnp.full((self.nb_workers,), 2.0 / self.nb_workers)
+
+
+def test_gar_contract_fixture_convicts_every_false_claim():
+    name = "lying-gar-fixture"
+    gars.gars._register[name] = _LyingGAR
+    try:
+        findings = gar_contract.check_spec(name)
+    finally:
+        del gars.gars._register[name]
+    assert findings, "the lying rule passed its own contract"
+    assert {f.checker for f in findings} == {"gar-contract"}
+    codes = {f.code for f in findings}
+    assert {"GC001", "GC002", "GC003", "GC004"} <= codes, findings
+
+
+# --------------------------------------------------------------------- #
+# baseline lifecycle: add -> (red) -> justify -> (green) -> expire -> (red)
+
+
+def _finding(symbol="x"):
+    return core.Finding(
+        checker="concurrency", code="CC001", path="pkg/mod.py", line=7,
+        scope="Cls.fn", symbol=symbol, message="seeded",
+    )
+
+
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    finding = _finding()
+
+    # no baseline: the finding is unbaselined
+    unb, base, issues = baseline_mod.apply([finding], baseline_mod.load(path))
+    assert [f.fingerprint for f in unb] == [finding.fingerprint]
+    assert base == [] and issues == []
+
+    # add with EMPTY justification: matched, but BL002 keeps the gate red
+    baseline_mod.save(path, {finding.fingerprint: ""})
+    unb, base, issues = baseline_mod.apply([finding], baseline_mod.load(path))
+    assert unb == [] and [f.code for f in issues] == ["BL002"]
+
+    # justify: green
+    baseline_mod.save(path, {finding.fingerprint: "single-writer telemetry"})
+    unb, base, issues = baseline_mod.apply([finding], baseline_mod.load(path))
+    assert unb == [] and issues == []
+    assert [f.fingerprint for f in base] == [finding.fingerprint]
+
+    # line drift must NOT expire the entry (fingerprints are line-free)
+    moved = core.Finding(**{**finding.__dict__, "line": 99})
+    unb, base, issues = baseline_mod.apply([moved], baseline_mod.load(path))
+    assert unb == [] and issues == []
+
+    # the violation is fixed: the entry goes stale -> BL001
+    unb, base, issues = baseline_mod.apply([], baseline_mod.load(path))
+    assert [f.code for f in issues] == ["BL001"]
+
+    # a different symbol is a DIFFERENT finding, not a match
+    other = _finding(symbol="y")
+    unb, base, issues = baseline_mod.apply([other], baseline_mod.load(path))
+    assert [f.fingerprint for f in unb] == [other.fingerprint]
+    assert [f.code for f in issues] == ["BL001"]
+
+
+def test_baseline_rejects_malformed(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"version": 999, "entries": []}))
+    with pytest.raises(ValueError):
+        baseline_mod.load(str(path))
+    path.write_text(json.dumps({"version": 1, "entries": [{"nope": 1}]}))
+    with pytest.raises(ValueError):
+        baseline_mod.load(str(path))
+
+
+def test_report_schema_round_trip(tmp_path):
+    doc = report_mod.build_report(
+        root="pkg", checkers=["concurrency"], unbaselined=[_finding()],
+        baselined=[_finding("b")], issues=[],
+        justifications={_finding("b").fingerprint: "why"},
+    )
+    report_mod.validate_report(doc)
+    assert doc["counts"] == {"total": 2, "unbaselined": 1, "baselined": 1,
+                             "baseline_issues": 0}
+    assert doc["clean"] is False
+    path = tmp_path / "report.json"
+    report_mod.save_report(str(path), doc)
+    report_mod.validate_report(json.loads(path.read_text()))
+    bad = dict(doc, clean=True)
+    with pytest.raises(ValueError):
+        report_mod.validate_report(bad)
+
+
+# --------------------------------------------------------------------- #
+# GAR contract sweep: 100% of the registry, composites included
+
+
+def test_gar_contract_sweep_covers_entire_registry():
+    specs = gar_contract.default_specs()
+    swept = set(specs)
+    # coverage asserted against the REGISTRY, not a hand-kept list: a rule
+    # cannot register without entering the sweep
+    missing = set(gars.itemize()) - swept
+    assert not missing, "registered GARs missing from the sweep: %r" % missing
+    assert any(s.startswith("hier:") for s in specs)
+    assert any(s.startswith("bucketing:") for s in specs)
+    # nested composites in both directions
+    assert any(s.startswith("hier:") and "bucketing(" in s for s in specs)
+    assert any(s.startswith("bucketing:") and "hier(" in s for s in specs)
+
+
+def test_gar_contract_sweep_is_clean():
+    findings = gar_contract.check()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_every_gar_rejects_byzantine_majority_of_everyone():
+    """Pins the graftcheck conviction fixed in this PR, e.g.:
+
+        gars/average:0: gar-contract [GC002] (n=3, f=3) accepted at parse
+        time: a rule cannot tolerate a Byzantine majority of everyone —
+        feasibility must reject f >= n before a step ever runs
+
+    (also convicted: average-nan[-native/-pallas], average-native, median
+    [-native/-pallas], centered-clip, geometric-median, rfa, and the
+    bucketing:s=2,inner=hier(...) nesting).  The fix is the universal
+    f < n floor in the GAR base class — swept here against the registry."""
+    for name in gars.itemize():
+        with pytest.raises(UserException):
+            gars.instantiate(name, 3, 3)
+        with pytest.raises(UserException):
+            gars.instantiate(name, 2, 5)
+
+
+def test_feasibility_floor_keeps_boundary_configs():
+    # f = n - 1 stays a per-rule decision (average-nan accepts, krum does
+    # not); f < n with f = 0 is always fine
+    gars.instantiate("average", 1, 0)
+    gars.instantiate("average-nan", 4, 3)
+    with pytest.raises(UserException):
+        gars.instantiate("krum", 4, 3)  # krum wants n >= f + 3
+
+
+# --------------------------------------------------------------------- #
+# the whole-package gate
+
+
+def test_clean_package_with_shipped_baseline():
+    """THE acceptance gate: zero unbaselined findings, zero baseline
+    issues over the whole package — what `python -m aggregathor_tpu.analysis`
+    and `scripts/run_analysis.sh --check` exit 0 on."""
+    findings, errors = run_checkers()
+    assert errors == [], "\n".join(f.render() for f in errors)
+    entries = baseline_mod.load(baseline_mod.default_baseline_path())
+    unbaselined, baselined, issues = baseline_mod.apply(findings, entries)
+    assert unbaselined == [], "\n".join(f.render() for f in unbaselined)
+    assert issues == [], "\n".join(f.render() for f in issues)
+    # the shipped baseline is tight: every entry justifies at least one
+    # live finding (an entry may cover several same-fingerprint findings —
+    # e.g. the two mutually-exclusive fold sites in one scope)
+    assert {f.fingerprint for f in baselined} == set(entries)
+
+
+def test_package_scan_is_cached_per_session():
+    root = core.package_root()
+    paths = core.iter_package_paths(root)
+    first = core.load_module(root, paths[0])
+    again = core.load_module(root, paths[0])
+    assert first is again  # same object: the scan cache the budget relies on
+
+
+def test_cli_reports_clean_and_validates_json(tmp_path):
+    from aggregathor_tpu.analysis.__main__ import main
+
+    out = str(tmp_path / "report.json")
+    assert main(["--json", out, "--check", "-q"]) == 0
+    doc = report_mod.validate_report(json.loads(open(out).read()))
+    assert doc["clean"] is True
+    assert doc["counts"]["unbaselined"] == 0
+    # unknown checker fails loudly
+    with pytest.raises(SystemExit):
+        main(["--checkers", "definitely-not-a-checker"])
+
+
+def test_cli_rejects_unknown_checker_via_api():
+    with pytest.raises(ValueError):
+        run_checkers(checkers=["nope"])
+
+
+# --------------------------------------------------------------------- #
+# checker unit behavior worth pinning (the idioms the package relies on)
+
+
+def test_prng_fold_in_with_distinct_data_is_not_reuse(tmp_path):
+    module = snippet_module(tmp_path, "folds.py", """
+        import jax
+
+
+        def derive(key):
+            a = jax.random.fold_in(key, 1)
+            b = jax.random.fold_in(key, 2)      # distinct data: fine
+            return jax.random.normal(a, ()) + jax.random.normal(b, ())
+
+
+        def collide(key):
+            a = jax.random.fold_in(key, 1)
+            b = jax.random.fold_in(key, 1)      # SAME data: PK001
+            return a, b
+    """)
+    findings = prng.check([module])
+    assert [f.scope for f in findings] == ["collide"]
+    assert findings[0].code == "PK001"
+
+
+def test_prng_derive_only_callee_is_not_a_consumer(tmp_path):
+    """The engine idiom: one per-step key handed to several helpers, each
+    deriving its own stream with disjoint fold_in tags (GAR_KEY_TAG)."""
+    module = snippet_module(tmp_path, "derive.py", """
+        import jax
+
+
+        def _stream_a(key):
+            return jax.random.fold_in(key, 1)
+
+
+        def _stream_b(key):
+            return jax.random.fold_in(key, 2)
+
+
+        def step(key):
+            a = _stream_a(key)
+            b = _stream_b(key)                  # derive-only: NOT reuse
+            return a, b
+
+
+        def _sampler(key):
+            return jax.random.normal(key, ())
+
+
+        def bad(key):
+            a = _sampler(key)
+            b = _sampler(key)                   # two consumers of ONE key
+            return a, b
+    """)
+    findings = prng.check([module])
+    assert [(f.scope, f.code) for f in findings] == [("bad", "PK001")]
+
+
+def test_prng_str_split_is_not_key_surgery(tmp_path):
+    module = snippet_module(tmp_path, "strings.py", """
+        def parse(text):
+            key, value = text.split("=", 1)
+            seen = set()
+            seen.add(key)
+            return key, value, len(seen)
+    """)
+    assert prng.check([module]) == []
+
+
+def test_retrace_static_projections_stay_static(tmp_path):
+    module = snippet_module(tmp_path, "shapes.py", """
+        import jax
+        import jax.numpy as jnp
+
+
+        def body(x, cfg, axis):
+            n, d = x.shape
+            if n > 3:                           # static: shape projection
+                x = x + 1.0
+            if cfg.deep:                        # static: config record
+                x = x * 2.0
+            if axis is not None:                # static: axis name
+                x = x - 1.0
+            return jnp.sum(x) / d
+
+
+        fn = jax.jit(body, static_argnums=(1, 2))
+    """)
+    assert retrace.check([module]) == []
+
+
+def test_retrace_traced_helpers_are_reached_transitively(tmp_path):
+    module = snippet_module(tmp_path, "reach.py", """
+        import jax
+
+
+        def _helper(x):
+            return float(x)                     # RT002, via reachability
+
+
+        def build():
+            def body(x):
+                return _helper(x) + 1.0
+
+            return jax.jit(body)
+    """)
+    findings = retrace.check([module])
+    assert [(f.scope, f.code) for f in findings] == [("_helper", "RT002")]
+
+
+def test_concurrency_requires_a_spawn_site(tmp_path):
+    module = snippet_module(tmp_path, "nospawn.py", """
+        class Plain:
+            def poke(self):
+                self.count = 1                  # no threads: not our business
+    """)
+    assert concurrency.check([module]) == []
+
+
+def test_gar_contract_probe_sizes_are_feasible_for_all():
+    # every registry entry finds a feasible candidate (a GC000 feasibility
+    # finding would surface in the clean-sweep test; this pins the cause)
+    for spec in gar_contract.default_specs():
+        gar, n, f = gar_contract._feasible(spec)
+        assert gar is not None, "no feasible (n, f) for %r" % spec
+        assert 0 <= f < n
+
+
+def test_checker_subset_does_not_stale_other_checkers_entries(tmp_path):
+    """Pins the review finding: `--checkers prng --check` misreported the
+    concurrency/retrace baseline entries as stale (BL001) and told the
+    user to delete valid justified entries."""
+    from aggregathor_tpu.analysis import active_codes
+    from aggregathor_tpu.analysis.__main__ import main
+
+    # through the API: a CC001 entry is out of scope for a prng-only pass
+    cc = _finding()
+    entries = {cc.fingerprint: "justified elsewhere"}
+    unb, base, issues = baseline_mod.apply(
+        [], entries, active_codes=active_codes(["prng"]))
+    assert issues == []
+    # ... and in scope (therefore stale) when concurrency actually runs
+    unb, base, issues = baseline_mod.apply(
+        [], entries, active_codes=active_codes(["concurrency"]))
+    assert [f.code for f in issues] == ["BL001"]
+    # through the real CLI: every single-checker gate stays green against
+    # the shipped baseline
+    for name in CHECKERS:
+        assert main(["--checkers", name, "--check", "-q"]) == 0, name
+
+
+def test_prng_branch_arm_folds_survive_the_join(tmp_path):
+    """Pins the review finding: fold_in records made inside an if-arm were
+    dropped at the merge, so a post-join textually identical fold of the
+    same key (SAME key minted twice on the taken path) went unflagged."""
+    module = snippet_module(tmp_path, "branchfold.py", """
+        import jax
+
+
+        def step(key, flag):
+            if flag:
+                a = jax.random.fold_in(key, 1)
+            b = jax.random.fold_in(key, 1)      # collides when flag is True
+            return b
+
+
+        def distinct(key, flag):
+            if flag:
+                a = jax.random.fold_in(key, 1)
+            b = jax.random.fold_in(key, 2)      # distinct data: fine
+            return b
+    """)
+    findings = prng.check([module])
+    assert [(f.scope, f.code) for f in findings] == [("step", "PK001")]
+
+
+def test_prng_sampler_inside_return_still_consumes(tmp_path):
+    """Pins the review finding: the blanket Return skip swallowed sampler
+    consumption inside the returned expression, hiding a real reuse."""
+    module = snippet_module(tmp_path, "retcons.py", """
+        import jax
+
+
+        def reuse(key):
+            x = jax.random.normal(key, (3,))
+            return jax.random.normal(key, (3,))   # PK001: second consumer
+
+
+        def handoff(key):
+            jax.random.normal(key, (3,))
+            return key                            # ownership out: no finding
+    """)
+    findings = prng.check([module])
+    assert [(f.scope, f.code) for f in findings] == [("reuse", "PK001")]
+
+
+def test_concurrency_lockish_matches_tokens_not_substrings(tmp_path):
+    """Pins the review finding: 'assembler' contains 'sem' and silently
+    whitelisted every unlocked write in its with-block."""
+    module = snippet_module(tmp_path, "lockish.py", """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self.assembler:
+                    self.count = 1                # NOT a lock: CC001
+                with self.round_lock:
+                    self.count = 2                # token 'lock': fine
+                with self.queueLock:
+                    self.count = 3                # camel token: fine
+    """)
+    findings = concurrency.check([module])
+    assert [(f.line, f.code) for f in findings] == [(11, "CC001")], findings
+
+
+def test_module_cache_is_per_root(tmp_path):
+    """Pins the review finding: a cache keyed on abspath alone returned a
+    Module carrying the FIRST request's relative path, mis-pathing (and
+    mis-fingerprinting) findings for any later --root."""
+    inner = tmp_path / "pkg"
+    inner.mkdir()
+    (inner / "m.py").write_text("x = 1\n")
+    a = core.load_module(str(tmp_path), "pkg/m.py")
+    b = core.load_module(str(inner), "m.py")
+    assert a.path == "pkg/m.py" and b.path == "m.py"
+
+
+def test_gar_contract_constructor_crash_is_a_finding_not_a_crash():
+    """Pins the review finding: a rule whose __init__ raises a
+    non-UserException killed the whole checker run instead of becoming
+    GC000 ('a rule the checker cannot exercise...')."""
+    class _CrashyGAR(gars.GAR):
+        def __init__(self, nb_workers, nb_byz_workers, args=None):
+            raise TypeError("constructor exploded")
+
+    name = "crashy-gar-fixture"
+    gars.gars._register[name] = _CrashyGAR
+    try:
+        findings = gar_contract.check_spec(name)
+    finally:
+        del gars.gars._register[name]
+    assert [f.code for f in findings] == ["GC000"]
+    assert "TypeError" in findings[0].message
+
+
+def test_concurrency_alias_of_shared_state_is_not_private(tmp_path):
+    """Pins the review finding: 'st = self.state; st.count = 1' dodged
+    CC001 because the alias target looked function-local."""
+    module = snippet_module(tmp_path, "alias.py", """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                st = self.state
+                st.count = 1                  # CC001 through the alias
+                mine = object()
+                mine.tag = 2                  # genuinely private: fine
+    """)
+    findings = concurrency.check([module])
+    assert [(f.symbol, f.code) for f in findings] == [("st.count", "CC001")]
+
+
+def test_prng_kwonly_key_param_can_be_derive_only(tmp_path):
+    """Pins the review finding: a derive-only helper taking its key as
+    keyword-only ('def draw(*, key)') never entered the derive-only table,
+    so its callers got false PK001s."""
+    module = snippet_module(tmp_path, "kwonly.py", """
+        import jax
+
+
+        def _stream(*, key, tag):
+            return jax.random.fold_in(key, tag)
+
+
+        def step(key):
+            a = _stream(key=key, tag=1)
+            b = _stream(key=key, tag=2)       # derive-only: NOT reuse
+            return a, b
+    """)
+    assert prng.check([module]) == []
+
+
+def test_finding_fingerprint_is_line_free():
+    a = _finding()
+    b = core.Finding(**{**a.__dict__, "line": 1234})
+    assert a.fingerprint == b.fingerprint
+    assert "1234" not in a.fingerprint

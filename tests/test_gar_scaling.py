@@ -13,10 +13,9 @@ import pytest
 from aggregathor_tpu import gars, models
 from aggregathor_tpu.core import build_optimizer, build_schedule
 from aggregathor_tpu.gars import oracle, parse_spec, scaling
-from aggregathor_tpu.ops import pallas_kernels as pk
-from aggregathor_tpu.parallel import RobustEngine, make_mesh
-from aggregathor_tpu.parallel import ShardedRobustEngine
 from aggregathor_tpu.models import transformer as tfm
+from aggregathor_tpu.ops import pallas_kernels as pk
+from aggregathor_tpu.parallel import RobustEngine, ShardedRobustEngine, make_mesh
 from aggregathor_tpu.utils import UserException
 
 

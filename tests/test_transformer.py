@@ -12,11 +12,11 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from aggregathor_tpu.utils import compat
 from aggregathor_tpu import config, gars
 from aggregathor_tpu.models import transformer as tfm
-from aggregathor_tpu.parallel.mesh import factor_devices, make_mesh
 from aggregathor_tpu.parallel import ShardedRobustEngine
+from aggregathor_tpu.parallel.mesh import factor_devices, make_mesh
+from aggregathor_tpu.utils import compat
 
 CFG = tfm.TransformerConfig(vocab_size=17, d_model=16, n_heads=2, n_layers=4)
 
