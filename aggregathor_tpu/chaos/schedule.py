@@ -22,6 +22,14 @@ Known keys:
 - ``straggle-mode=MODE``   what a late worker's row becomes: ``drop``
   (whole row NaN — the NaN-aware GARs exclude it) or ``stale`` (the
   previous-step submission, via the CLEVER ``TrainState.carry``);
+- ``jitter=SIGMA``         heavy-tail lateness (SIGMA >= 0, needs
+  ``straggle=RATE`` in the same regime): under bounded-wait
+  (``--step-deadline``), a late worker's wall-clock stall becomes
+  lognormal around ``--straggler-stall`` (median = stall, sigma =
+  SIGMA — the realistic arrival distribution the adaptive deadline
+  controller is exercised on, ``parallel/deadline.py``).  The in-graph
+  simulation's lateness is binary (there is no wall clock inside the
+  step), so jitter shapes the HOST straggler model only;
 - ``forge=RATE``           per-step probability that each coalition worker
   (the first ``nb_real_byz``) submits as an IMPERSONATOR without the
   session secret: its row is replaced by noise and its submission tag is
@@ -61,7 +69,8 @@ import numpy as np
 from ..utils import UserException, parse_keyval
 
 #: regime keys the DSL itself consumes; anything else must ride an ``attack=``
-_REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode", "forge", "tamper")
+_REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode", "jitter",
+                "forge", "tamper")
 
 _CALM = "calm"
 
@@ -70,17 +79,19 @@ class Regime:
     """One parsed schedule segment (static Python config, no arrays)."""
 
     __slots__ = ("start", "spec", "attack", "drop_rate", "straggler_rate",
-                 "straggler_stale", "forge_rate", "tamper_rate")
+                 "straggler_stale", "straggler_jitter", "forge_rate",
+                 "tamper_rate")
 
     def __init__(self, start, spec, attack=None, drop_rate=0.0,
                  straggler_rate=0.0, straggler_stale=False,
-                 forge_rate=0.0, tamper_rate=0.0):
+                 straggler_jitter=0.0, forge_rate=0.0, tamper_rate=0.0):
         self.start = int(start)
         self.spec = spec
         self.attack = attack
         self.drop_rate = float(drop_rate)
         self.straggler_rate = float(straggler_rate)
         self.straggler_stale = bool(straggler_stale)
+        self.straggler_jitter = float(straggler_jitter)
         self.forge_rate = float(forge_rate)
         self.tamper_rate = float(tamper_rate)
 
@@ -106,6 +117,7 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
     drop_rate = 0.0
     straggler_rate = None
     straggler_stale = None
+    straggler_jitter = None
     forge_rate = 0.0
     tamper_rate = 0.0
     seen = set()
@@ -140,6 +152,18 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
                     "Chaos straggle-mode=%r must be 'drop' or 'stale'" % (value,)
                 )
             straggler_stale = value == "stale"
+        elif key == "jitter":
+            try:
+                straggler_jitter = float(value)
+            except ValueError:
+                raise UserException(
+                    "Chaos jitter=%r is not a number" % (value,)
+                )
+            if straggler_jitter < 0.0:
+                raise UserException(
+                    "Chaos jitter=%r must be >= 0 (the lognormal sigma "
+                    "around the straggler stall)" % (value,)
+                )
         else:
             attack_args.append("%s:%s" % (key, value))
     if attack_args and attack_name is None:
@@ -150,6 +174,10 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
     if straggler_stale is not None and straggler_rate is None:
         raise UserException(
             "Chaos regime at step %d sets straggle-mode without straggle=RATE" % start
+        )
+    if straggler_jitter is not None and straggler_rate is None:
+        raise UserException(
+            "Chaos regime at step %d sets jitter without straggle=RATE" % start
         )
     attack = None
     if attack_name is not None:
@@ -170,6 +198,7 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
         start, text, attack=attack, drop_rate=drop_rate,
         straggler_rate=straggler_rate or 0.0,
         straggler_stale=bool(straggler_stale),
+        straggler_jitter=straggler_jitter or 0.0,
         forge_rate=forge_rate, tamper_rate=tamper_rate,
     )
 
@@ -225,6 +254,12 @@ class ChaosSchedule:
         self._drop_rates = np.asarray([r.drop_rate for r in regimes], np.float32)
         self._straggler_rates = np.asarray([r.straggler_rate for r in regimes], np.float32)
         self._straggler_stale = np.asarray([r.straggler_stale for r in regimes], np.bool_)
+        #: wall-clock heavy-tail sigma per regime — consumed by the HOST
+        #: straggler model only (parallel/bounded.py); the in-graph
+        #: lateness simulation is binary
+        self._straggler_jitter = np.asarray(
+            [r.straggler_jitter for r in regimes], np.float32
+        )
         self._forge_rates = np.asarray([r.forge_rate for r in regimes], np.float32)
         self._tamper_rates = np.asarray([r.tamper_rate for r in regimes], np.float32)
         self.has_drop = bool((self._drop_rates > 0).any())

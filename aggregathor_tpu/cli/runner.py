@@ -74,8 +74,11 @@ def build_parser():
              "transformer). W must equal --nb-workers.",
     )
     parser.add_argument(
-        "--microbatches", type=int, default=2,
-        help="pipeline microbatches per step (sharded engine only)",
+        "--microbatches", type=int, default=None,
+        help="pipeline microbatches per step (sharded engine only; "
+             "default 2).  Rejected under sharded --step-deadline: the "
+             "bounded submission body computes per-worker FULL-batch "
+             "gradients over experiment.loss, so the knob would be dead",
     )
     parser.add_argument(
         "--granularity", default="vector", choices=["vector", "leaf", "layer", "global"],
@@ -172,6 +175,56 @@ def build_parser():
         "--straggler-rate", type=float, default=0.0, metavar="P",
         help="bounded-wait: flat per-(step, worker) lateness probability "
              "when no --chaos schedule provides regime rates",
+    )
+    parser.add_argument(
+        "--straggler-jitter", type=float, default=0.0, metavar="SIGMA",
+        help="bounded-wait straggler injection: heavy-tail the stall — a "
+             "late worker sleeps stall * exp(SIGMA * N(0,1)) (lognormal, "
+             "median = --straggler-stall) instead of exactly the stall; "
+             "with --chaos the per-regime jitter=SIGMA takes precedence",
+    )
+    parser.add_argument(
+        "--deadline-percentile", type=float, default=None, metavar="P",
+        help="adaptive bounded-wait window (parallel/deadline.py, "
+             "docs/engine.md): track the per-worker arrival distribution "
+             "and set each round's window to its P-th percentile, "
+             "EMA-smoothed and clamped into [--deadline-floor, "
+             "--deadline-ceiling].  Requires --step-deadline (the initial "
+             "window and the default ceiling).  Choose P at or below "
+             "100*(n-f-1)/(n-1) (e.g. 71.4 for n=8, f=2) so a persistent "
+             "straggler coalition inside the declared budget cannot pin "
+             "the window at the ceiling",
+    )
+    parser.add_argument(
+        "--deadline-floor", type=float, default=0.01, metavar="SECONDS",
+        help="adaptive deadline: smallest window the controller may emit",
+    )
+    parser.add_argument(
+        "--deadline-ceiling", type=float, default=None, metavar="SECONDS",
+        help="adaptive deadline: largest window (default: --step-deadline "
+             "— the fixed protocol's declared worst-case wait); a "
+             "controller pinned here for ceiling-patience steps is a "
+             "guardian escalation input",
+    )
+    parser.add_argument(
+        "--deadline-ema", type=float, default=0.3, metavar="ALPHA",
+        help="adaptive deadline: weight of each new round's percentile "
+             "target in (0, 1] — smoothing so a single spiked round "
+             "cannot whipsaw the window",
+    )
+    parser.add_argument(
+        "--stale-infill", action="store_true",
+        help="bounded-wait: a timed-out worker re-enters its CLEVER carry "
+             "row (the last submission this aggregator received from it) "
+             "instead of a NaN drop.  Stale rows SPEND the declared-f "
+             "budget exactly like timeouts and attacks (stale + timeouts "
+             "+ attacks <= f — a Byzantine straggler re-enters its carried "
+             "attack row), and land as stale_infill forensics evidence",
+    )
+    parser.add_argument(
+        "--stale-max-age", type=int, default=4, metavar="ROUNDS",
+        help="bounded-wait stale infill: a carry older than this many "
+             "consecutive missed rounds degrades back to a NaN drop",
     )
     parser.add_argument(
         "--backend-timeout", type=float, default=300.0, metavar="SECONDS",
@@ -810,14 +863,23 @@ def main(argv=None):
         # without a deadline drive the SYNCHRONOUS baseline the straggler
         # sweep compares against.  Validated before any compilation.
         straggler_model = None
+        deadline_controller = None
         if bounded_wait:
             from ..parallel.bounded import BoundedWaitStep, HostStragglerModel
 
-            if mesh_axes is not None:
+            if mesh_axes is not None and mesh_axes[1] * mesh_axes[2] != 1:
                 raise UserException(
-                    "--step-deadline needs the flat engine (a sharded logical "
-                    "worker is a collective submesh; its submission cannot "
-                    "complete independently)"
+                    "--step-deadline with --mesh needs trivial in-group axes "
+                    "(W,1,1): a (pipe x model) submesh submission is one "
+                    "collective program whose members cannot time out "
+                    "independently (docs/engine.md, protocol scope)"
+                )
+            if mesh_axes is not None and args.microbatches is not None:
+                raise UserException(
+                    "--step-deadline on the sharded engine computes per-"
+                    "worker FULL-batch gradients over experiment.loss; "
+                    "--microbatches only shapes the fused pipeline loss — "
+                    "drop it (the bounded path would silently ignore it)"
                 )
             if unroll > 1:
                 raise UserException(
@@ -830,19 +892,17 @@ def main(argv=None):
                     "--step-deadline dispatches per-worker host batches; use "
                     "--input-source stream"
                 )
-            if args.secure or args.secure_mask:
+            if args.secure_mask:
                 raise UserException(
-                    "--step-deadline + --secure is not implemented yet "
-                    "(digests would ride the per-worker submissions)"
+                    "--step-deadline + --secure-mask is not supported: the "
+                    "pairwise pads are added inside the fused submission "
+                    "pipeline and would not cancel across per-worker "
+                    "dispatches (--secure digests DO ride the bounded path)"
                 )
             if args.udp > 0:
                 raise UserException(
                     "--step-deadline replaces the simulated lossy transport; "
                     "drop --UDP (real timeouts produce the NaN rows)"
-                )
-            if args.worker_momentum is not None:
-                raise UserException(
-                    "--step-deadline does not carry worker momentum yet"
                 )
             if jax.process_count() > 1:
                 raise UserException(
@@ -853,7 +913,62 @@ def main(argv=None):
                 straggler_model = HostStragglerModel(
                     n, args.straggler_stall, rate=args.straggler_rate,
                     chaos=chaos, seed=args.seed,
+                    jitter=args.straggler_jitter,
                 )
+            elif args.straggler_jitter > 0:
+                raise UserException(
+                    "--straggler-jitter scales an injected stall; without "
+                    "--straggler-stall/--straggler-rate or a --chaos "
+                    "straggler regime it injects nothing — drop it or add "
+                    "a stall source"
+                )
+            if args.deadline_percentile is not None:
+                from ..parallel.deadline import DeadlineController
+
+                if args.step_deadline is None:
+                    raise UserException(
+                        "--deadline-percentile needs --step-deadline (the "
+                        "controller's initial window and default ceiling)"
+                    )
+                # constructed ONCE, outside the guardian rebuild path: the
+                # learned window is host policy state that must survive an
+                # escalation (and its registry instruments register once)
+                deadline_controller = DeadlineController(
+                    args.step_deadline,
+                    percentile=args.deadline_percentile,
+                    floor=args.deadline_floor,
+                    ceiling=args.deadline_ceiling,
+                    ema=args.deadline_ema,
+                    registry=registry,
+                )
+            if args.stale_infill and args.step_deadline is None:
+                raise UserException(
+                    "--stale-infill needs --step-deadline: the synchronous "
+                    "protocol never times anyone out"
+                )
+        elif (args.deadline_percentile is not None or args.stale_infill
+                or args.straggler_jitter > 0):
+            raise UserException(
+                "--deadline-percentile/--stale-infill/--straggler-jitter "
+                "are bounded-wait options; pass --step-deadline (or "
+                "--straggler-stall for the synchronous baseline)"
+            )
+
+        def make_regularized_loss(base_loss, l1, l2):
+            # l1/l2 regularization wraps the per-worker loss (reference:
+            # graph.py:125-139) — the ONE wrapper shared by the flat
+            # engine and the sharded bounded-wait submission body, so the
+            # two arms cannot silently diverge
+            def loss_fn(params, batch):
+                loss = base_loss(params, batch)
+                leaves = jax.tree_util.tree_leaves(params)
+                if l1:
+                    loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
+                if l2:
+                    loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
+                return loss
+
+            return loss_fn
 
         class TrainingStack:
             """The rebuildable half of the run: engine + jitted step/eval
@@ -911,11 +1026,16 @@ def main(argv=None):
                     # gradients instead of wrapping the loss (docs/engine.md)
                     l1_regularize=args.l1_regularize,
                     l2_regularize=args.l2_regularize,
-                    chaos=chaos,
+                    # under bounded-wait the straggler schedule moved to the
+                    # HOST clock (straggler_model); in-graph chaos is off
+                    chaos=None if bounded_wait else chaos,
                     secure=args.secure,
                     flight=flight_rec,
                 )
-                loss_fn = experiment.sharded_loss(mesh_axes[1], args.microbatches)
+                loss_fn = experiment.sharded_loss(
+                    mesh_axes[1],
+                    2 if args.microbatches is None else args.microbatches,
+                )
 
                 def make_fresh_state(seed=args.seed):
                     return engine.init_state(
@@ -924,7 +1044,28 @@ def main(argv=None):
                     )
 
                 state0 = make_fresh_state()
-                ts.step_fn = engine.build_step(loss_fn, tx, state0)
+                if bounded_wait:
+                    # the sharded bounded-wait variant (trivial in-group
+                    # axes, validated above): per-submesh submission
+                    # streams, per-group deadlines.  The submission body
+                    # needs the GLOBAL per-worker loss — on a W,1,1 mesh
+                    # the plain loss IS the local partial, with l1/l2
+                    # folded in like the flat branch (the sharded engine's
+                    # analytic reg path belongs to the fused step body).
+                    bounded_loss = make_regularized_loss(
+                        experiment.loss, args.l1_regularize, args.l2_regularize)
+
+                    ts.bounded_step = BoundedWaitStep(
+                        engine, bounded_loss, tx, state0.params,
+                        deadline=args.step_deadline,
+                        straggler_model=straggler_model, registry=registry,
+                        controller=deadline_controller,
+                        stale_infill=args.stale_infill,
+                        stale_max_age=args.stale_max_age,
+                    )
+                    ts.step_fn = ts.bounded_step
+                else:
+                    ts.step_fn = engine.build_step(loss_fn, tx, state0)
                 ts.multi_fn = (
                     engine.build_multi_step(loss_fn, tx, state0) if unroll > 1 else None
                 )
@@ -948,17 +1089,8 @@ def main(argv=None):
                     flight=flight_rec,
                 )
 
-                # l1/l2 regularization wraps the per-worker loss (reference: graph.py:125-139)
-                base_loss, l1, l2 = experiment.loss, args.l1_regularize, args.l2_regularize
-
-                def loss_fn(params, batch):
-                    loss = base_loss(params, batch)
-                    leaves = jax.tree_util.tree_leaves(params)
-                    if l1:
-                        loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in leaves)
-                    if l2:
-                        loss = loss + l2 * sum(jnp.sum(p * p) for p in leaves)
-                    return loss
+                loss_fn = make_regularized_loss(
+                    experiment.loss, args.l1_regularize, args.l2_regularize)
 
                 def make_fresh_state(seed=args.seed):
                     # params ALWAYS init from the run seed; ``seed`` only moves
@@ -971,11 +1103,16 @@ def main(argv=None):
                 if bounded_wait:
                     # per-worker async submissions + deadline-closed rounds
                     # (the guardian rebuild path constructs this exactly
-                    # like the fused step: one stack, one engine)
+                    # like the fused step: one stack, one engine; the
+                    # deadline CONTROLLER is shared across rebuilds — its
+                    # learned window survives an escalation)
                     ts.bounded_step = BoundedWaitStep(
                         engine, loss_fn, tx, state0.params,
                         deadline=args.step_deadline,
                         straggler_model=straggler_model, registry=registry,
+                        controller=deadline_controller,
+                        stale_infill=args.stale_infill,
+                        stale_max_age=args.stale_max_age,
                     )
                     ts.step_fn = ts.bounded_step
                 else:
@@ -1565,6 +1702,12 @@ def main(argv=None):
             if "nb_timeouts" in metrics:
                 # bounded-wait deadline verdicts for this dispatch's step
                 scalars["straggler_timeouts"] = int(jax.device_get(metrics["nb_timeouts"]))
+            if "nb_stale" in metrics:
+                scalars["stale_infill_rows"] = int(jax.device_get(metrics["nb_stale"]))
+            if ts.bounded_step is not None and ts.bounded_step.controller is not None:
+                scalars["deadline_window_seconds"] = (
+                    ts.bounded_step.controller.window
+                )
             if args.gar_probe:
                 scalars["gar_seconds"] = time_gar_probe(step)
             if flight_rec is not None:
@@ -1711,6 +1854,7 @@ def main(argv=None):
                 rep = fetch(pending_metrics.get("worker_reputation"))
                 regime = fetch(pending_metrics.get("chaos_regime"))
                 timeouts = fetch(pending_metrics.get("straggler_timeout"))
+                stale_rows = fetch(pending_metrics.get("stale_infill"))
                 probe = pending_metrics.get(health.PROBE_KEY)
                 nan_rows = (
                     fetch(probe.get("worker_nan_rows")) if probe is not None else None
@@ -1722,7 +1866,7 @@ def main(argv=None):
                         return None
                     return vector[None] if vector.ndim == 1 else vector
                 dist, rep, nan_rows = rows(dist), rows(rep), rows(nan_rows)
-                timeouts = rows(timeouts)
+                timeouts, stale_rows = rows(timeouts), rows(stale_rows)
                 regime = None if regime is None else np.atleast_1d(regime)
                 nb = max(
                     v.shape[0] for v in (dist, rep, nan_rows, regime, timeouts)
@@ -1748,6 +1892,10 @@ def main(argv=None):
                         # bounded-wait deadline verdicts (straggler_timeout
                         # evidence; explains the timed-out rows' NaN flags)
                         timeout=None if timeouts is None else timeouts[i],
+                        # stale infills: named stale_infill evidence, so
+                        # late-but-honest stays distinguishable (they still
+                        # spent the f budget — docs/engine.md)
+                        stale=None if stale_rows is None else stale_rows[i],
                     )
 
         def probe_clean(dispatch_metrics):
@@ -1903,6 +2051,14 @@ def main(argv=None):
                     # ladder (f+K re-sizes the budget for the observed tail)
                     action = watchdog.observe_timeouts(
                         start + i + 1, int(timeouts[i]), overrides.f
+                    )
+                if (action is None and ts.bounded_step is not None
+                        and ts.bounded_step.controller is not None):
+                    # adaptive-deadline escalation input: a controller
+                    # pinned at its ceiling means the arrival tail outgrew
+                    # the budgeted window (parallel/deadline.py)
+                    action = watchdog.observe_ceiling(
+                        start + i + 1, ts.bounded_step.controller.at_ceiling
                     )
                 if action == "recovered":
                     info("guardian: recovered — %d healthy step(s) since the "

@@ -37,7 +37,9 @@ class GuardianConfig:
     ``spike`` (loss/EMA ratio counted as a spike, default 25), ``retries``
     (max rollbacks before the run is declared failed, default 5), ``backoff``
     (cooldown growth base, default 2), ``recover`` (healthy steps after a
-    rollback before declaring recovery, default 10), ``ladder`` (escalation
+    rollback before declaring recovery, default 10), ``ceiling-patience``
+    (consecutive controller-at-ceiling steps before rollback, default
+    4 x patience — see ``observe_ceiling``), ``ladder`` (escalation
     rungs, comma-separated — see ``escalate.py`` for the grammar)."""
 
     DEFAULTS = {
@@ -46,6 +48,7 @@ class GuardianConfig:
         "retries": 5,
         "backoff": 2.0,
         "recover": 10,
+        "ceiling-patience": 0,  # 0 = derive as 4 x patience
         "ladder": DEFAULT_LADDER,
     }
 
@@ -58,6 +61,14 @@ class GuardianConfig:
         self.retries = int(kv["retries"])
         self.backoff = float(kv["backoff"])
         self.recover_after = int(kv["recover"])
+        # sustained controller-at-ceiling is chronic, not acute: give it a
+        # longer leash than the loss-spike patience by default
+        self.ceiling_patience = int(kv["ceiling-patience"]) or 4 * self.patience
+        if self.ceiling_patience < 1:
+            raise UserException(
+                "guardian ceiling-patience must be >= 1 (got %d)"
+                % self.ceiling_patience
+            )
         if self.patience < 1:
             raise UserException("guardian patience must be >= 1 (got %d)" % self.patience)
         if self.spike_factor <= 1.0:
@@ -86,6 +97,7 @@ class Watchdog:
         self.cooldown_until = -1   # spike triggers suppressed below this step
         self.last_reason = None    # human-readable cause of the last rollback
         self.timeout_streak = 0    # consecutive steps with timeouts beyond f
+        self.ceiling_streak = 0    # consecutive steps controller-at-ceiling
 
     @property
     def healthy(self):
@@ -153,6 +165,33 @@ class Watchdog:
             return "rollback"
         return None
 
+    def observe_ceiling(self, step, at_ceiling):
+        """Adaptive-deadline escalation input (parallel/deadline.py): a
+        controller pinned at its CEILING means the observed arrival tail
+        wants a wider window than the operator budgeted — the fleet's tail
+        has outgrown the declared deadline, a capacity regression the same
+        way over-budget timeouts are.  Sustained for ``ceiling-patience``
+        steps (and outside the rollback cooldown) that is a rollback
+        decision; the ladder's ``f+K`` rung re-sizes the budget so more of
+        the tail may be dropped instead of waited on.  Any un-pinned step
+        resets the streak."""
+        if not at_ceiling:
+            self.ceiling_streak = 0
+            return None
+        self.ceiling_streak += 1
+        if (step >= self.cooldown_until
+                and self.ceiling_streak >= self.config.ceiling_patience):
+            self.last_reason = (
+                "deadline controller pinned at its ceiling for %d steps "
+                "(the arrival tail outgrew the budgeted window)"
+                % self.ceiling_streak
+            )
+            trace.instant("guardian.rollback_decision", cat="guardian",
+                          step=int(step), reason="deadline_ceiling",
+                          streak=int(self.ceiling_streak))
+            return "rollback"
+        return None
+
     def note_rollback(self, restore_step):
         """Record that the runner executed a rollback landing at
         ``restore_step``; returns the 0-based attempt index (= the
@@ -164,6 +203,7 @@ class Watchdog:
         self.unhealthy_streak = 0
         self.healthy_streak = 0
         self.timeout_streak = 0
+        self.ceiling_streak = 0
         self.recovering = True
         grace = math.ceil(self.config.patience * self.config.backoff ** self.attempts)
         self.cooldown_until = restore_step + grace

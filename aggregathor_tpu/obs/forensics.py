@@ -157,7 +157,7 @@ class ForensicsLedger:
 
     def observe(self, step, worker_sq_dist=None, worker_nan=None,
                 reputation=None, regime=None, regime_desc=None, forgery=None,
-                timeout=None):
+                timeout=None, stale=None):
         """One completed training step's diagnostics.  Every vector is
         length-n (or None when the engine did not compute it); non-finite
         ``worker_sq_dist`` entries are treated as masked (no ``distance``
@@ -168,7 +168,12 @@ class ForensicsLedger:
         (parallel/bounded.py): a timed-out worker gets ``straggler_timeout``
         evidence, and its NaN row is EXPLAINED by the timeout — it does not
         double as ``nan_row`` strong evidence (late is not Byzantine; the
-        stragglers surface in the report's own ``stragglers`` list)."""
+        stragglers surface in the report's own ``stragglers`` list).
+        ``stale`` marks the timed-out workers whose round was served by
+        their CLEVER carry instead of a NaN drop (stale infill): named
+        ``stale_infill`` evidence, weak like the timeout itself, so
+        late-but-honest stays distinguishable from Byzantine — while the
+        row STILL spends the declared-f budget (docs/engine.md)."""
         suspects = {}
         timed_out = None
         if timeout is not None:
@@ -181,6 +186,11 @@ class ForensicsLedger:
         if timed_out is not None:
             for worker in np.nonzero(timed_out)[0]:
                 mark(worker, "straggler_timeout")
+        if stale is not None:
+            infilled = np.asarray(stale).reshape(-1).astype(bool)
+            self._check_len("stale", infilled)
+            for worker in np.nonzero(infilled)[0]:
+                mark(worker, "stale_infill")
         if forgery is not None:
             forged = np.asarray(forgery).reshape(-1)
             self._check_len("forgery", forged)
@@ -190,6 +200,17 @@ class ForensicsLedger:
         if worker_sq_dist is not None:
             dist = np.asarray(worker_sq_dist, np.float64).reshape(-1)
             self._check_len("worker_sq_dist", dist)
+            if timed_out is not None:
+                # a timeout EXPLAINS the row that replaced this worker's
+                # submission (NaN drop or stale carry): its distance
+                # measures the protocol's infill, not the worker's conduct
+                # this step — excused from distance/rank evidence exactly
+                # like the NaN-row flag below (late is not Byzantine; an
+                # aging stale carry legitimately drifts from the honest
+                # mean).  The row still SPENT the f budget, and a worker
+                # gaming this by straggling loses its infill at
+                # stale-max-age (docs/engine.md).
+                dist = np.where(timed_out, np.nan, dist)
             finite = dist[np.isfinite(dist)]
             if finite.size:
                 anchor = float(np.median(finite))

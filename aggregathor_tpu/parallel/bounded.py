@@ -10,33 +10,49 @@ submission (OptiReduce's tail-optimal allreduce, arXiv:2310.06993;
 "Efficient AllReduce with Stragglers", arXiv:2505.23523).
 
 :class:`BoundedWaitStep` is that protocol, host-orchestrated over the
-unified engine's two bounded-wait executables:
+unified engine's bounded-wait executables:
 
-1. ``engine.build_worker_grad``: ONE jitted per-worker submission
-   executable, dispatched n times per step on its own submission thread —
-   per-worker async device streams; each thread's dispatch returns
-   immediately and the submission "arrives" when its row materializes.
-2. The host polls arrivals against ``deadline`` seconds
-   (``concurrent.futures.wait``).  Workers that miss it are marked timed
-   out; their slot in the (n, d) submission buffer is garbage the
-   aggregator masks to NaN IN GRAPH — the same row the chaos straggler
-   simulation produced, now produced by the real clock.
-3. ``engine.build_bounded_aggregate``: one jitted aggregate+update
-   executable (omniscient attacks, quarantine, GAR, optax, probe, flight —
-   the fused step's shared code paths) consuming the submission buffer and
-   the arrival mask.
+1. ``engine.build_worker_grad`` (flat) / ``engine.build_group_grad``
+   (sharded, trivial in-group mesh): ONE jitted submission executable,
+   dispatched once per SUBMISSION UNIT per step on its own thread — a
+   unit is one worker in the flat mode, one worker-axis submesh (its
+   k = n/W vmapped logical workers) in the sharded mode.  Per-unit async
+   device streams; each thread's dispatch returns immediately and the
+   submission "arrives" when its rows materialize.
+2. The host polls arrivals against a window — a fixed ``deadline``, or
+   the :class:`~.deadline.DeadlineController`'s adaptive one (percentile
+   of the observed arrival distribution, EMA-smoothed, floor/ceiling
+   clamped).  Units that miss it are timed out as a whole (per-GROUP
+   deadlines: a submesh that misses the window forfeits all k of its
+   rows).
+3. A timed-out worker's slot becomes either a **NaN row** (the v1
+   protocol: absorbed like a fully-lossy link) or, under
+   ``stale_infill``, its **CLEVER carry row** — the last submission the
+   aggregator actually received from that worker, re-entered as a stale
+   gradient, with ``stale_max_age`` bounding how many rounds a carry may
+   be reused before it degrades back to a NaN row.
+4. ``engine.build_bounded_aggregate``: one jitted aggregate+update
+   executable (omniscient attacks, quarantine, GAR, optax, probe,
+   flight, worker momentum write-back, secure digest lanes — the fused
+   step's shared code paths) consuming the submission buffer and the
+   arrival/stale masks.
 
-**f-accounting** (docs/engine.md): timeout rows spend the same declared-f
-budget as attack rows.  With ``t`` timeouts and ``b`` Byzantine rows the
-rule's guarantee holds iff ``t + b <= f`` — size ``f`` for BOTH tails.
-A worker whose previous submission is still in flight when a new round
-opens is skipped for that round (an immediate timeout): the per-worker
-stream never queues more than one outstanding submission, which is what
-bounds memory AND models a genuinely slow worker missing consecutive
-rounds.
+**f-accounting** (docs/engine.md): timeout rows AND stale-infilled rows
+spend the same declared-f budget as attack rows.  With ``t`` NaN
+timeouts, ``s`` stale infills and ``b`` Byzantine rows the rule's
+guarantee holds iff ``t + s + b <= f`` — a stale row is NOT free: its
+worker may be Byzantine, and a Byzantine worker that straggles
+deliberately re-enters its carried ATTACK row through the infill (the
+laundering scenario the accounting exists for; the straggler sweep's
+breakdown probe drives it for real).  A worker whose previous submission
+is still in flight when a new round opens is skipped for that round (an
+immediate timeout): the per-unit stream never queues more than one
+outstanding submission, which is what bounds memory AND models a
+genuinely slow worker missing consecutive rounds.
 
 Straggler injection (:class:`HostStragglerModel`) maps a chaos schedule's
-straggler regimes — or an explicit rate — to real wall-clock submission
+straggler regimes — or an explicit rate, optionally with a lognormal
+heavy-tail ``jitter`` around the stall — to real wall-clock submission
 delays, which is how the chaos/ simulation becomes the thing the protocol
 is measured against (benchmarks/straggler_sweep.py).
 """
@@ -52,6 +68,17 @@ from ..obs import trace
 from ..utils import UserException
 
 
+def _is_donation_race(exc):
+    """The ONLY benign late failure: ``block_until_ready`` on outputs whose
+    input buffers the closed round's aggregate donated out from under the
+    dispatch (XLA surfaces it as a deleted/donated-buffer runtime error).
+    Anything else a late submission raises — a device fault, an internal
+    XLA error, a bug in the loss — is a real worker failure and must not
+    be filed under the race."""
+    text = str(exc).lower()
+    return "delet" in text or "donat" in text
+
+
 class HostStragglerModel:
     """Per-(step, worker) wall-clock submission delays.
 
@@ -59,15 +86,19 @@ class HostStragglerModel:
     is late with the regime's ``straggler_rate`` (from ``chaos`` — a
     schedule whose ONLY adversity is straggler regimes — or the flat
     ``rate``), and a late worker sleeps ``stall_seconds`` before
-    dispatching.  ``nb_eligible`` restricts lateness to the first K global
-    workers (the schedule's ``straggle-workers`` knob / the --UDP first-k
-    convention)."""
+    dispatching.  ``jitter`` (the regime's, or the flat argument) makes the
+    stall heavy-tailed: a late worker sleeps ``stall * exp(jitter * N(0,1))``
+    — lognormal with median ``stall`` — the realistic arrival distribution
+    the deadline controller is exercised on.  ``nb_eligible`` restricts
+    lateness to the first K global workers (the schedule's
+    ``straggle-workers`` knob / the --UDP first-k convention)."""
 
     def __init__(self, nb_workers, stall_seconds, rate=0.0, chaos=None,
-                 nb_eligible=0, seed=0):
+                 nb_eligible=0, seed=0, jitter=0.0):
         self.nb_workers = int(nb_workers)
         self.stall_seconds = float(stall_seconds)
         self.rate = float(rate)
+        self.jitter = float(jitter)
         self.chaos = chaos
         self.nb_eligible = int(nb_eligible)
         self.seed = int(seed)
@@ -88,6 +119,11 @@ class HostStragglerModel:
             raise UserException("straggler stall must be >= 0 seconds")
         if not 0.0 <= self.rate <= 1.0:
             raise UserException("straggler rate must lie in [0, 1]")
+        if self.jitter < 0.0:
+            raise UserException(
+                "straggler jitter must be >= 0 (the lognormal sigma around "
+                "the stall), got %g" % self.jitter
+            )
         if self.stall_seconds == 0.0 and (self.rate > 0.0 or chaos is not None):
             # a schedule/rate without a stall would silently inject nothing
             # — the one misconfiguration on this path that wouldn't be loud
@@ -101,6 +137,11 @@ class HostStragglerModel:
             return float(self.chaos._straggler_rates[self.chaos.regime_at(step)])
         return self.rate
 
+    def _jitter_at(self, step):
+        if self.chaos is not None:
+            return float(self.chaos._straggler_jitter[self.chaos.regime_at(step)])
+        return self.jitter
+
     def delay(self, step, worker):
         """Seconds worker ``worker`` holds its step-``step`` submission."""
         rate = self._rate_at(step)
@@ -111,41 +152,86 @@ class HostStragglerModel:
         # counter-based draw: reproducible and order-independent across the
         # submission threads (one Generator shared by n threads would be
         # neither)
-        u = np.random.default_rng(
-            (self.seed, int(step), int(worker))
-        ).random()
-        return self.stall_seconds if u < rate else 0.0
+        gen = np.random.default_rng((self.seed, int(step), int(worker)))
+        if gen.random() >= rate:
+            return 0.0
+        sigma = self._jitter_at(step)
+        if sigma > 0.0:
+            # lognormal around the stall: median == stall, heavy right tail
+            return float(self.stall_seconds * np.exp(sigma * gen.standard_normal()))
+        return self.stall_seconds
 
 
 class BoundedWaitStep:
-    """Host-orchestrated bounded-wait training step over a flat engine.
+    """Host-orchestrated bounded-wait training step over the unified engine.
 
     ``step(state, batch) -> (state, metrics)`` — the same contract as the
     fused ``engine.build_step`` product, so the runner's train loop,
     divergence lag, forensics feed and guardian plumbing consume it
-    unchanged.  ``deadline=None`` degrades to the synchronous protocol
-    (wait for every submission) — the baseline the straggler sweep
-    measures against.
+    unchanged.  ``deadline=None`` without a controller degrades to the
+    synchronous protocol (wait for every submission) — the baseline the
+    straggler sweep measures against.
+
+    Args beyond the v1 surface:
+
+    - ``controller``: a :class:`~.deadline.DeadlineController`; when set it
+      supplies every warm round's window (the fixed ``deadline`` then only
+      seeds/ceils it) and is fed the round's per-worker arrival vector.
+    - ``stale_infill`` / ``stale_max_age``: a timed-out worker re-enters
+      its CLEVER carry row (the last row this aggregator received from it)
+      instead of a NaN row, for at most ``stale_max_age`` consecutive
+      rounds — after that (or before any row ever arrived) it degrades
+      back to the NaN drop.  Stale rows spend the declared-f budget
+      exactly like timeouts (module docstring).
     """
 
     def __init__(self, engine, loss_fn, tx, params_template, deadline=None,
-                 straggler_model=None, registry=None):
+                 straggler_model=None, registry=None, controller=None,
+                 stale_infill=False, stale_max_age=4):
         if deadline is not None and deadline <= 0.0:
             raise UserException("--step-deadline must be > 0 seconds")
+        if stale_infill and deadline is None and controller is None:
+            raise UserException(
+                "--stale-infill needs a deadline (or the adaptive "
+                "controller): the synchronous protocol never times anyone "
+                "out, so there is nothing to infill"
+            )
+        self.stale_max_age = int(stale_max_age)
+        if stale_infill and self.stale_max_age < 1:
+            raise UserException(
+                "--stale-max-age must be >= 1 round (got %d)" % self.stale_max_age
+            )
         self.engine = engine
         self.nb_workers = engine.nb_workers
         self.deadline = deadline
+        self.controller = controller
+        self.stale_infill = bool(stale_infill)
         self.model = straggler_model
-        self.grad_fn = engine.build_worker_grad(loss_fn)
+        self.momentum = engine.worker_momentum is not None
+        self.secure = bool(engine.secure)
+        # Submission units (module docstring): the flat mode dispatches one
+        # executable per WORKER; the sharded mode one per worker-axis
+        # SUBMESH (its k logical workers vmapped inside — per-group
+        # deadlines: the group arrives, and times out, as a whole).
+        self.grouped = bool(engine.sharded)
+        if self.grouped:
+            self.group_size = engine.workers_per_device
+            self.nb_units = engine.nb_devices
+            self.grad_fn = engine.build_group_grad(loss_fn)
+        else:
+            self.group_size = 1
+            self.nb_units = self.nb_workers
+            self.grad_fn = engine.build_worker_grad(loss_fn)
         self.agg_fn = engine.build_bounded_aggregate(tx, params_template)
         self.pool = ThreadPoolExecutor(
-            max_workers=self.nb_workers, thread_name_prefix="bw-submit"
+            max_workers=self.nb_units, thread_name_prefix="bw-submit"
         )
-        # one outstanding submission per worker: a worker still in flight
-        # when a new round opens is skipped (= an immediate timeout)
-        self._in_flight = [None] * self.nb_workers
+        # one outstanding submission per unit: a unit still in flight when
+        # a new round opens is skipped (= an immediate timeout)
+        self._in_flight = [None] * self.nb_units
         self._round = 0
         self._round_lock = threading.Lock()
+        self._closed = False
         # the deadline engages from the SECOND round: the first dispatch
         # compiles both executables, and charging the compile against the
         # deadline would time out every worker of step 0 (the perf report
@@ -160,9 +246,32 @@ class BoundedWaitStep:
         self._nan_template = (
             np.zeros((), np.float32), np.full((d,), np.nan, row_dtype),
         )
+        self._zero_row = np.zeros((d,), np.float32)
+        self._nan_digest = None
+        if self.secure:
+            from ..secure.submit import row_digest
+
+            # the digest of the NaN drop row — what "arrived" for a slot
+            # nobody submitted; sender and receiver agree by construction,
+            # so the host authenticator verifies it without a forgery
+            # verdict (a timeout is named by forensics, not by crypto)
+            import jax.numpy as jnp
+
+            self._nan_digest = np.asarray(jax.device_get(
+                row_digest(jnp.asarray(self._nan_template[1], jnp.float32))
+            ))
+        # CLEVER carry for stale infill: the last row each worker actually
+        # delivered (post-attack, post-momentum — exactly what the PS
+        # received), its submission digest, and its age in rounds.  Host-
+        # side: the bounded protocol's reassembly buffer, the per-worker
+        # twin of the fused engines' TrainState.carry.
+        self._carry = [None] * self.nb_workers
+        self._carry_digest = [None] * self.nb_workers
+        self._carry_age = np.zeros((self.nb_workers,), np.int64)
         self.timeouts_total = np.zeros((self.nb_workers,), np.int64)
+        self.stale_total = np.zeros((self.nb_workers,), np.int64)
         self._c_timeouts = self._c_rounds = self._g_deadline = None
-        self._c_late = None
+        self._c_late = self._c_stale = None
         if registry is not None:
             self._c_timeouts = registry.counter(
                 "straggler_timeouts_total",
@@ -173,6 +282,12 @@ class BoundedWaitStep:
                 "straggler_skipped_rounds_total",
                 "Rounds skipped because the worker's previous submission "
                 "was still in flight",
+                labelnames=("worker",),
+            )
+            self._c_stale = registry.counter(
+                "stale_infill_rows_total",
+                "Timed-out submissions replaced by the worker's CLEVER "
+                "carry row instead of a NaN drop",
                 labelnames=("worker",),
             )
             self._c_rounds = registry.counter(
@@ -186,44 +301,121 @@ class BoundedWaitStep:
 
     # ------------------------------------------------------------------ #
 
-    def _submit_one(self, round_id, step_idx, worker, params, rng, worker_batch):
+    def _unit_workers(self, unit):
+        k = self.group_size
+        return range(unit * k, (unit + 1) * k)
+
+    def _submit_one(self, round_id, step_idx, unit, round_begin, args):
         """Submission-thread body: injected stall, then dispatch + drain.
-        Returns (worker, loss, row) or None when the round already closed
-        (the dispatch would read donated buffers)."""
+        Returns ``(arrival_seconds, outputs)`` or None when the round
+        already closed (the dispatch would read donated buffers).  A
+        submission that fails raises — MID-ROUND failures surface at this
+        round's barrier, and a failure AFTER the round closed (anything
+        but the donation race, which is filtered) surfaces at the NEXT
+        round's dispatch — never masquerading as a timeout."""
         if self.model is not None:
-            stall = self.model.delay(step_idx, worker)
+            # a group is as late as its slowest member (its submission
+            # completes when every vmapped worker's gradient does).  Sleep
+            # in slices with a poison check: a lognormal-jitter tail draw
+            # is unbounded (minutes at z=3), and one uninterruptible
+            # time.sleep would outlive close()'s bounded join and hang
+            # interpreter exit on the pool's atexit thread join.
+            stall = max(
+                self.model.delay(step_idx, w) for w in self._unit_workers(unit)
+            )
             if stall:
-                time.sleep(stall)
+                wake_at = time.monotonic() + stall
+                while True:
+                    remaining = wake_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    time.sleep(min(0.05, remaining))
+                    if self._closed:
+                        return None
         with self._round_lock:
             if round_id != self._round:
                 return None  # round closed while we stalled: don't dispatch
-            out = self.grad_fn(params, worker_batch, rng, step_idx, worker)
+            out = self.grad_fn(*args)
         try:
-            loss, row = jax.block_until_ready(out)
-        except Exception:
-            return None  # buffers reclaimed under a concurrently-closed round
-        return worker, loss, row
+            host = jax.block_until_ready(out)
+        except Exception as exc:
+            with self._round_lock:
+                late = round_id != self._round
+            if late and _is_donation_race(exc):
+                # buffers reclaimed under a concurrently-closed round:
+                # the donation race, not a worker failure
+                return None
+            raise
+        return time.monotonic() - round_begin, host
 
     def __call__(self, state, batch):
-        n = self.nb_workers
+        if self._closed:
+            raise RuntimeError("BoundedWaitStep was closed")
+        n, k = self.nb_workers, self.group_size
+        if self.momentum:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if state.momentum.sharding.spec != PartitionSpec():
+                # one-time re-placement (round 0): init_state worker-shards
+                # the buffer for the fused shard_map dataflow, but the
+                # bounded executables are plain jits whose outputs
+                # canonicalize to replicated — one layout for every round
+                # keeps the steady-state compile count at 1
+                state = state.replace(momentum=jax.device_put(
+                    state.momentum,
+                    NamedSharding(self.engine.mesh, PartitionSpec()),
+                ))
         # the previous dispatch materialized the step counter; this read is
         # a host copy, not a device sync
         step_idx = int(jax.device_get(state.step))
         params, rng = state.params, state.rng
         futures, skipped = {}, []
-        for w in range(n):
-            prev = self._in_flight[w]
+        round_begin = time.monotonic()
+        for unit in range(self.nb_units):
+            prev = self._in_flight[unit]
             if prev is not None and not prev.done():
-                # still submitting a previous round: this worker misses the
+                # still submitting a previous round: this unit misses the
                 # current one outright (bounded queue, see module docstring)
-                skipped.append(w)
+                skipped.append(unit)
                 continue
-            self._in_flight[w] = self.pool.submit(
-                self._submit_one, self._round, step_idx, w, params, rng,
-                jax.tree_util.tree_map(lambda x, _w=w: x[_w], batch),
+            if prev is not None and not prev.cancelled():
+                exc = prev.exception()
+                if exc is not None:
+                    # a submission that outlived its round and then hit a
+                    # REAL failure (_submit_one filtered the donation
+                    # race): its round's barrier already closed booking it
+                    # a timeout, so surface the error here, at the first
+                    # dispatch that sees the dead unit — not silently
+                    # re-booking it as a straggler forever
+                    raise RuntimeError(
+                        "bounded-wait: submission unit %d died after its "
+                        "round closed (late failure, not the donation "
+                        "race)" % unit
+                    ) from exc
+            if self.grouped:
+                # group mode keeps the leading worker axis (k rows, vmapped
+                # inside the group executable — even at k = 1)
+                unit_batch = jax.tree_util.tree_map(
+                    lambda x, _u=unit: x[_u * k:(_u + 1) * k], batch)
+            else:
+                unit_batch = jax.tree_util.tree_map(
+                    lambda x, _w=unit: x[_w], batch)
+            args = [params, unit_batch, rng, step_idx, unit]
+            if self.momentum:
+                args += [state.momentum, state.momentum_steps]
+            self._in_flight[unit] = self.pool.submit(
+                self._submit_one, self._round, step_idx, unit, round_begin,
+                args,
             )
-            futures[w] = self._in_flight[w]
-        deadline = self.deadline if self._warm else None
+            futures[unit] = self._in_flight[unit]
+        was_warm = self._warm
+        if was_warm:
+            if self.controller is not None:
+                deadline = self.controller.window
+            else:
+                deadline = self.deadline
+        else:
+            deadline = None
         self._warm = True
         with trace.span("bounded_wait.collect", cat="train"):
             pending = set(futures.values())
@@ -244,29 +436,94 @@ class BoundedWaitStep:
         with self._round_lock:
             self._round += 1
         arrived = np.zeros((n,), bool)
-        losses, rows = [], []
-        for w in range(n):
-            fut = futures.get(w)
-            result = fut.result() if (fut is not None and fut.done()) else None
-            if result is not None:
-                arrived[w] = True
-                losses.append(result[1])
-                rows.append(result[2])
-            else:
-                losses.append(self._nan_template[0])
-                rows.append(self._nan_template[1])
+        stale = np.zeros((n,), bool)
+        arrival_seconds = np.full((n,), np.inf)
+        losses, rows = [None] * n, [None] * n
+        mom_rows = [None] * n if self.momentum else None
+        digests = [None] * n if self.secure else None
+        for unit in range(self.nb_units):
+            fut = futures.get(unit)
+            result = None
+            if fut is not None and fut.done():
+                try:
+                    result = fut.result()
+                except Exception as exc:
+                    # a worker thread died MID-ROUND (not the donation
+                    # race, _submit_one filtered that): surface it here at
+                    # the barrier instead of silently counting a timeout
+                    raise RuntimeError(
+                        "bounded-wait: submission unit %d died mid-round at "
+                        "step %d" % (unit, step_idx)
+                    ) from exc
+            for j, w in enumerate(self._unit_workers(unit)):
+                if result is not None:
+                    arrival, out = result
+                    arrived[w] = True
+                    arrival_seconds[w] = arrival
+                    grouped = self.grouped
+                    losses[w] = out["loss"][j] if grouped else out["loss"]
+                    row = out["row"][j] if grouped else out["row"]
+                    rows[w] = row
+                    if self.stale_infill:
+                        # the carry pins a duplicate (n, d) buffer on
+                        # device — only pay for it when infill can read it
+                        self._carry[w] = row
+                        self._carry_age[w] = 0
+                    if self.momentum:
+                        mom_rows[w] = (
+                            out["momentum"][j] if grouped else out["momentum"]
+                        )
+                    if self.secure:
+                        digest = out["digest"][j] if grouped else out["digest"]
+                        digests[w] = digest
+                        if self.stale_infill:
+                            self._carry_digest[w] = digest
+                else:
+                    self._carry_age[w] += 1
+                    losses[w] = self._nan_template[0]
+                    if (self.stale_infill and self._carry[w] is not None
+                            and self._carry_age[w] <= self.stale_max_age):
+                        # stale infill: the carry re-enters aggregation —
+                        # and spends the f budget (module docstring)
+                        stale[w] = True
+                        rows[w] = self._carry[w]
+                        if self.secure:
+                            digests[w] = self._carry_digest[w]
+                    else:
+                        rows[w] = self._nan_template[1]
+                        if self.secure:
+                            digests[w] = self._nan_digest
+                    if self.momentum:
+                        # content never read: the aggregate keeps the old
+                        # momentum row wherever ``arrived`` is False
+                        mom_rows[w] = self._zero_row
         self.timeouts_total += ~arrived
+        self.stale_total += stale
+        if self.controller is not None and was_warm:
+            # feed the controller only rounds the deadline governed (the
+            # compile round's arrivals measure XLA, not the fleet)
+            self.controller.observe_round(arrival_seconds)
         if self._c_timeouts is not None:
             for w in np.nonzero(~arrived)[0]:
                 self._c_timeouts.labels(worker=str(int(w))).inc()
-            for w in skipped:
-                self._c_late.labels(worker=str(int(w))).inc()
+            for w in np.nonzero(stale)[0]:
+                self._c_stale.labels(worker=str(int(w))).inc()
+            for unit in skipped:
+                for w in self._unit_workers(unit):
+                    self._c_late.labels(worker=str(int(w))).inc()
             self._c_rounds.inc()
+            if self._g_deadline is not None and deadline is not None:
+                self._g_deadline.set(float(deadline))
         import jax.numpy as jnp
 
+        extras = {}
+        if self.momentum:
+            extras["momentum"] = jnp.stack(mom_rows)
+        if self.secure:
+            extras["digests"] = jnp.stack(digests)
         return self.agg_fn(
             state, jnp.stack(rows), jnp.stack(losses),
-            jnp.asarray(arrived),
+            jnp.asarray(arrived), jnp.asarray(stale), extras,
         )
 
     def _cache_size(self):
@@ -277,5 +534,21 @@ class BoundedWaitStep:
         the expected first compile)."""
         return max(self.grad_fn._cache_size(), self.agg_fn._cache_size())
 
-    def close(self):
+    def close(self, timeout=5.0):
+        """Idempotent shutdown: poison the round id so stalled submission
+        threads never dispatch against freed buffers, cancel everything
+        queued, then JOIN the outstanding threads with a bounded wait (a
+        stalled sleep must not leak a thread holding engine buffers past
+        the step's lifetime — nor hang shutdown forever)."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._round_lock:
+            self._round += 1
         self.pool.shutdown(wait=False, cancel_futures=True)
+        pending = [
+            fut for fut in self._in_flight
+            if fut is not None and not fut.done()
+        ]
+        if pending:
+            wait(pending, timeout=timeout)

@@ -2155,20 +2155,32 @@ class RobustEngine:
 
     def _check_bounded_wait_supported(self):
         if self.sharded:
-            raise UserException(
-                "bounded-wait needs the flat mode: a sharded logical worker "
-                "is a collective submesh whose submission cannot complete "
-                "independently of its peers"
-            )
-        if self.granularity != "vector":
+            in_group = self.mesh.shape[pipe_axis] * self.mesh.shape[model_axis]
+            if in_group != 1:
+                raise UserException(
+                    "sharded bounded-wait needs trivial in-group axes "
+                    "(--mesh W,1,1): a (pipe x model) submesh submission is "
+                    "one collective program whose members cannot time out "
+                    "independently — per-submesh collective timeouts are a "
+                    "different protocol (docs/engine.md, protocol scope)"
+                )
+            if self.granularity != "global":
+                raise UserException(
+                    "sharded bounded-wait aggregates the whole flattened "
+                    "gradient; use granularity global (the sharded spelling "
+                    "of the flat mode's vector)"
+                )
+            if self.worker_momentum is not None:
+                raise UserException(
+                    "sharded bounded-wait does not carry worker momentum: "
+                    "the sharded TrainState.momentum is a per-leaf pytree, "
+                    "not the flat (n, d) buffer the submission body indexes "
+                    "— run the flat engine for momentum + bounded-wait"
+                )
+        elif self.granularity != "vector":
             raise UserException(
                 "bounded-wait aggregates the whole flattened gradient "
                 "(granularity vector); per-leaf selection is not supported"
-            )
-        if self.worker_momentum is not None:
-            raise UserException(
-                "bounded-wait does not carry worker momentum yet (the "
-                "per-worker buffers live in the fused step's TrainState)"
             )
         if self.lossy_link is not None or self.chaos is not None:
             raise UserException(
@@ -2176,25 +2188,31 @@ class RobustEngine:
                 "--chaos in-graph regimes (straggler regimes move to the "
                 "host straggler model, parallel/bounded.py)"
             )
-        if self.secure:
-            raise UserException(
-                "bounded-wait + --secure is not implemented yet (digests "
-                "would ride the per-worker submissions)"
-            )
 
-    def build_worker_grad(self, loss_fn):
-        """One jitted per-worker submission executable: ``grad_fn(params,
-        worker_batch, rng, step, widx) -> (loss, (d,) row)``.
+    def _bounded_submission_body(self, loss_fn):
+        """The shared per-worker submission body of both bounded-wait
+        builders: gradient -> worker momentum -> local attack -> digest ->
+        wire quantization, returning a dict with keys ``loss``, ``row``
+        and (configured) ``momentum`` / ``digest``.
 
-        Compiled ONCE and dispatched n times per step (worker index and
-        step are traced operands, so steady state never recompiles).  The
-        row is what the worker "sends": flattened f32, local attack applied
-        to coalition workers with the fused body's exact key discipline
-        (fold worker, then tag 1), wire-quantized when ``exchange_dtype``
-        is set — bit-compatible with the synchronous step's submissions."""
-        self._check_bounded_wait_supported()
+        ``momentum`` in the argument list is the WHOLE (n, d) buffer from
+        ``TrainState`` (dynamically indexed by the traced worker index, so
+        steady state never recompiles); the returned ``momentum`` entry is
+        the worker's updated (d,) row, which the bounded aggregate writes
+        back only for workers whose submission ARRIVED — a timed-out
+        worker's momentum never updated, exactly as its gradient never
+        shipped.  The submitted row is the bias-corrected momentum
+        (Karimireddy et al. 2021), corrected by the GLOBAL update count:
+        a straggler that missed rounds sends a slightly over-corrected
+        momentum rather than forcing a per-worker count into the compiled
+        signature.  The digest covers the row as submitted (post-attack,
+        pre-quantization — the fused ``_perturb_local`` convention)."""
+        from ..secure.submit import row_digest
 
-        def grad_fn(params, worker_batch, rng, step, widx):
+        beta = self.worker_momentum
+
+        def body(params, worker_batch, rng, step, widx, momentum,
+                 momentum_steps):
             key = jax.random.fold_in(rng, step)
             if self.batch_transform is not None:
                 # fold tag 3: the augmentation stream (same as the fused body)
@@ -2205,33 +2223,120 @@ class RobustEngine:
             row = jnp.concatenate(
                 [leaf.reshape(-1).astype(jnp.float32) for leaf in leaves]
             )
+            out = {"loss": loss}
+            if beta is not None:
+                new_m = beta * momentum[widx] + (1.0 - beta) * row
+                out["momentum"] = new_m
+                correction = 1.0 - beta ** (
+                    jnp.asarray(momentum_steps, jnp.float32) + 1.0
+                )
+                row = new_m / correction
             if self.attack is not None and not self.attack.omniscient:
                 wkey = jax.random.fold_in(key, widx)
                 forged = self.attack.apply_local(row, jax.random.fold_in(wkey, 1))
                 row = jnp.where(widx < self.nb_real_byz, forged, row)
+            if self.secure:
+                out["digest"] = row_digest(row)
             if self.exchange_dtype is not None:
                 row = row.astype(self.exchange_dtype)
-            return loss, row
+            out["row"] = row
+            return out
+
+        return body
+
+    def build_worker_grad(self, loss_fn):
+        """One jitted per-worker submission executable: ``grad_fn(params,
+        worker_batch, rng, step, widx[, momentum, momentum_steps]) ->
+        {loss, row[, momentum][, digest]}`` (the momentum operands appear
+        iff ``worker_momentum`` is set; see ``_bounded_submission_body``).
+
+        Compiled ONCE and dispatched n times per step (worker index and
+        step are traced operands, so steady state never recompiles).  The
+        row is what the worker "sends": flattened f32, worker momentum
+        applied, local attack applied to coalition workers with the fused
+        body's exact key discipline (fold worker, then tag 1), digest-
+        summarized under ``secure``, wire-quantized when
+        ``exchange_dtype`` is set."""
+        self._check_bounded_wait_supported()
+        body = self._bounded_submission_body(loss_fn)
+
+        if self.worker_momentum is not None:
+            def grad_fn(params, worker_batch, rng, step, widx, momentum,
+                        momentum_steps):
+                return body(params, worker_batch, rng, step, widx, momentum,
+                            momentum_steps)
+        else:
+            def grad_fn(params, worker_batch, rng, step, widx):
+                return body(params, worker_batch, rng, step, widx, None, None)
 
         return trace.traced(
             "worker_grad.dispatch", jax.jit(grad_fn), cat="train"
         )
 
+    def build_group_grad(self, loss_fn):
+        """The sharded-mode submission executable: one jitted program per
+        WORKER-AXIS SUBMESH, computing its k = n/W logical workers vmapped —
+        ``group_fn(params, group_batch, rng, step, gidx[, momentum,
+        momentum_steps]) -> {loss: (k,), row: (k, d)[, momentum: (k, d)]
+        [, digest: (k, 4)]}``.
+
+        The group index is a traced operand like the flat mode's worker
+        index (one executable, dispatched W times per round, zero steady-
+        state recompiles); global worker indices are ``gidx * k + j``, so
+        attack coalitions and PRNG streams address workers exactly as the
+        flat submission path does.  Requires trivial in-group axes (the
+        submesh is a single device — ``_check_bounded_wait_supported``):
+        the group's submission then completes independently of its peers,
+        which is what a per-group deadline needs."""
+        self._check_bounded_wait_supported()
+        body = self._bounded_submission_body(loss_fn)
+        k = self.workers_per_device
+
+        def group_body(params, group_batch, rng, step, gidx, momentum,
+                       momentum_steps):
+            def one(j, worker_batch):
+                return body(params, worker_batch, rng, step, gidx * k + j,
+                            momentum, momentum_steps)
+
+            return jax.vmap(one)(jnp.arange(k), group_batch)
+
+        if self.worker_momentum is not None:
+            def group_fn(params, group_batch, rng, step, gidx, momentum,
+                         momentum_steps):
+                return group_body(params, group_batch, rng, step, gidx,
+                                  momentum, momentum_steps)
+        else:
+            def group_fn(params, group_batch, rng, step, gidx):
+                return group_body(params, group_batch, rng, step, gidx,
+                                  None, None)
+
+        return trace.traced(
+            "group_grad.dispatch", jax.jit(group_fn), cat="train"
+        )
+
     def build_bounded_aggregate(self, tx, params_template):
         """The aggregator side of the bounded-wait protocol: ``agg(state,
-        rows, losses, arrived) -> (state, metrics)``, jitted once
-        (``params_template`` fixes the flatten/inflate layout).
+        rows, losses, arrived, stale, extras) -> (state, metrics)``, jitted
+        once (``params_template`` fixes the flatten/inflate layout).
 
-        ``rows`` is the (n, d) submission buffer (missing workers' rows may
-        hold garbage — they are masked in-graph), ``arrived`` the (n,) bool
-        submission mask the host measured against its deadline.  Workers
-        that missed it contribute NaN rows INSIDE the same declared-f
-        budget as Byzantine rows (timeout rows + attack rows <= f for the
-        rule's guarantee to hold — docs/engine.md, "f-accounting"), land in
-        ``metrics["straggler_timeout"]``, and are excluded from the loss
-        sum (the aggregator only averages what it received).  Omniscient
-        attacks, quarantine, reputation, the health probe and the flight
-        recorder ride the same shared code paths as the fused step
+        ``rows`` is the (n, d) submission buffer: fresh rows where
+        ``arrived``, CLEVER carry rows where ``stale`` (the host's stale
+        infill, parallel/bounded.py), garbage elsewhere — masked to NaN
+        in-graph.  A row that is neither fresh nor stale is a NaN drop
+        INSIDE the same declared-f budget as Byzantine rows, and a STALE
+        row spends that budget too (timeouts + stale + attacks <= f for
+        the rule's guarantee to hold — docs/engine.md, "f-accounting": the
+        carry may hold a Byzantine worker's attack row).  Deadline
+        verdicts land in ``metrics["straggler_timeout"]`` /
+        ``metrics["stale_infill"]``; missed workers are excluded from the
+        loss sum (the aggregator only averages what it received).
+        ``extras`` carries the configured optional operands: ``momentum``
+        (the (n, d) updated rows, written back only where ``arrived`` — a
+        timed-out worker's momentum never updated) and ``digests`` (the
+        (n, 4) submission digests the host authenticator signs/verifies
+        one dispatch behind, secure/submit.py).  Omniscient attacks,
+        quarantine, reputation, the health probe and the flight recorder
+        ride the same shared code paths as the fused step
         (``_prepare_rows`` / ``_finalize_step``)."""
         self._check_bounded_wait_supported()
         from ..gars import GAR_KEY_TAG
@@ -2240,12 +2345,14 @@ class RobustEngine:
         # the flattening layout, for inflating the aggregate back to a tree
         flatmap = FlatMap(params_template)
 
-        def agg_fn(state, rows, losses, arrived):
+        def agg_fn(state, rows, losses, arrived, stale, extras):
             key = jax.random.fold_in(state.rng, state.step)
             rows = rows.astype(jnp.float32)
-            # deadline verdict first: a missing worker IS a NaN row — the
-            # exact convention of a fully-lossy link, absorbed by the rule
-            rows = jnp.where(arrived[:, None], rows, jnp.nan)
+            # deadline verdict first: a worker that neither arrived nor
+            # carries a live stale row IS a NaN row — the exact convention
+            # of a fully-lossy link, absorbed by the rule
+            valid = arrived | stale
+            rows = jnp.where(valid[:, None], rows, jnp.nan)
             if self.exchange_dtype is not None:
                 rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
             rows, raw_rows = self._prepare_rows(rows, key, state.reputation)
@@ -2279,18 +2386,52 @@ class RobustEngine:
             worker_nan = None
             if self.health_probe:
                 worker_nan = jnp.any(~jnp.isfinite(rows), axis=1)
+            new_momentum = new_momentum_steps = None
+            if self.worker_momentum is not None:
+                # write back only the rows whose submission ARRIVED: a
+                # timed-out worker's momentum update never completed (its
+                # thread's result was discarded with the round).  Emitted
+                # replicated, like every other plain-jit output here; the
+                # host step re-places init_state's worker-sharded buffer
+                # ONCE so round 0's input layout matches every later
+                # round's (parallel/bounded.py — else both executables
+                # would recompile at round 1)
+                new_momentum = jnp.where(
+                    arrived[:, None], extras["momentum"], state.momentum
+                )
+                new_momentum_steps = state.momentum_steps + 1
+            secure_metrics = None
+            if self.secure:
+                # sent == received by construction on this path (no
+                # in-transit transform between the submission executable
+                # and the host's stack); the host authenticator still
+                # signs and verifies one dispatch behind, and a digest
+                # mismatch there would name a real corruption
+                nobody = jnp.zeros((self.nb_workers,), bool)
+                secure_metrics = {
+                    "digest_sent": extras["digests"],
+                    "digest_recv": extras["digests"],
+                    "forged": nobody,
+                    "rejected": nobody,
+                }
             new_state, metrics = self._finalize_step(
                 state, params=params, opt_state=opt_state, new_carry=None,
-                new_momentum=None, new_momentum_steps=None,
+                new_momentum=new_momentum,
+                new_momentum_steps=new_momentum_steps,
                 total_loss=total_loss, update_norm=jnp.linalg.norm(agg),
                 worker_nan=worker_nan, rep_dist=rep_dist, wdist=wdist,
-                participation=participation, secure_metrics=None, ridx=None,
+                participation=participation, secure_metrics=secure_metrics,
+                ridx=None,
             )
             # deadline evidence AFTER the epilogue: the flight recorder's
             # lane set predates the protocol; forensics/registry consume
-            # these from the metrics dict on the host
+            # these from the metrics dict on the host.  ``nb_timeouts`` is
+            # the round's f-budget spend: NaN drops AND stale infills both
+            # count (the guardian's over-budget escalation input).
             metrics["straggler_timeout"] = ~arrived
+            metrics["stale_infill"] = stale
             metrics["nb_timeouts"] = jnp.sum((~arrived).astype(jnp.int32))
+            metrics["nb_stale"] = jnp.sum(stale.astype(jnp.int32))
             return new_state, metrics
 
         jitted = jax.jit(agg_fn, donate_argnums=(0,))

@@ -1,31 +1,47 @@
-"""Straggler sweep: steps/s vs straggler severity, synchronous vs bounded-wait.
+"""Straggler sweep v2: sync vs fixed-deadline vs ADAPTIVE bounded-wait.
 
-The tentpole measurement of ISSUE 10: a synchronous step takes as long as
-the slowest worker, so its throughput degrades linearly with the injected
-stall; a bounded-wait round closes at the deadline, so its throughput stays
-FLAT while the GAR absorbs the missing rows inside the declared-f budget.
-Both modes run the REAL protocol machinery (parallel/bounded.py over the
-unified engine) — the synchronous baseline is the same per-worker
-submission pipeline with ``deadline=None`` (wait for every arrival), so the
-comparison isolates exactly one variable: whether the aggregator waits.
+ISSUE 10 measured the fixed protocol: a synchronous step degrades with the
+stall while a fixed ``--step-deadline`` holds a rate floor.  ISSUE 12 adds
+the adaptive layer (``parallel/deadline.py`` + stale infill) and this sweep
+measures all three arms against straggler REGIMES instead of flat
+severities — including the drifting and heavy-tail regimes where a fixed
+window forces the operator's bad trade (sized for the tail it wastes the
+common case; sized for the common case it throws the tail away):
 
-Also re-checks the n=8/f=2 breakdown property under bounded-wait: the rule
-sized for the timeout tail (krum, r = f persistent stragglers) keeps a
-finite trajectory; the majority rule (plain average) is poisoned by the
-first timeout.
+- ``calm``        nobody straggles (sanity: all arms within noise);
+- ``steady``      a persistent coalition of f workers stalls far beyond
+                  every window — the fixed arm burns the FULL deadline
+                  every round waiting for workers that never arrive, the
+                  adaptive window converges down to the honest arrivals;
+- ``heavy_tail``  lognormal (jitter) stalls around a median below the
+                  deadline: most late rounds resolve, the tail is dropped;
+- ``drift``       a chaos schedule alternating calm and straggler phases
+                  mid-run — the controller must re-converge at each switch.
 
-Output schema ``aggregathor.straggler.sweep.v1``::
+Every arm runs the REAL protocol machinery (parallel/bounded.py over the
+unified engine): ``sync`` is deadline=None, ``fixed`` the v1 protocol,
+``adaptive`` adds the percentile controller and stale infill.  The
+breakdown probe re-checks the n=8/f=2 budget boundary UNDER STALE INFILL:
+the coalition's local-attack rows re-enter through the carry (laundering),
+krum and trimmed-mean hold at r = f, trimmed-mean (whose coordinate trim
+budget is exactly f) is poisoned at r = f + 1.
+
+Output schema ``aggregathor.straggler.sweep.v2``::
 
     {schema, generated_at, config: {...}, cells: [
-        {mode: "sync"|"bounded", stall_seconds, steps_per_s,
-         losses_finite, timeouts_total, final_loss}... ],
-     breakdown: {krum_finite, average_finite},
-     verdict: {bounded_flat, sync_degrades, breakdown_holds, pass}}
+        {mode: "sync"|"fixed"|"adaptive", regime, steps_per_s,
+         losses_finite, final_loss (per-ARRIVED-worker mean: arms with
+         different timeout counts stay comparable), timeouts_total,
+         stale_total, window_final}... ],
+     breakdown: {at_f_krum_ok, at_f_trimmed_ok, over_f_broken},
+     verdict: {adaptive_beats_both, adaptive_loss_ok, sync_degrades,
+               breakdown_holds, pass}}
 
 Usage::
 
-    python benchmarks/straggler_sweep.py [--steps 10] [--deadline 0.15]
-        [--severities 0,0.2,0.4,0.8] [--out straggler_sweep.json]
+    python benchmarks/straggler_sweep.py [--steps 12] [--deadline 0.3]
+        [--stall 0.6] [--percentile 70] [--regimes calm,steady,heavy_tail,drift]
+        [--out STRAGGLER_r12.json]
 """
 
 import argparse
@@ -37,81 +53,170 @@ import time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCHEMA = "aggregathor.straggler.sweep.v1"
+SCHEMA = "aggregathor.straggler.sweep.v2"
 
-#: bounded-wait is "flat" when its worst cell is within this factor of its
-#: best; the synchronous baseline "degrades" when its best-to-worst ratio
-#: exceeds it (the stall dominates the step)
-FLAT_TOLERANCE = 1.6
+MODES = ("sync", "fixed", "adaptive")
+REGIMES = ("calm", "steady", "heavy_tail", "drift")
+
+#: final-loss tolerance of the adaptive-vs-fixed comparison (their
+#: trajectories legitimately differ: stale rows vs NaN rows)
+LOSS_RTOL = 0.10
+LOSS_ATOL = 0.5
 
 
-def run_cell(mode, stall, args, gar_name="krum"):
+def build_straggler_model(regime, args):
+    """The regime's HostStragglerModel (None for calm)."""
+    from aggregathor_tpu.chaos import ChaosSchedule
+    from aggregathor_tpu.parallel.bounded import HostStragglerModel
+
+    n, f = args.nb_workers, args.nb_byz
+    if regime == "calm":
+        return None
+    if regime == "steady":
+        # persistent coalition of f workers, stall >> every window
+        return HostStragglerModel(n, args.stall, rate=1.0, nb_eligible=f,
+                                  seed=0)
+    if regime == "heavy_tail":
+        # lognormal stalls with median stall/3: most late rounds resolve
+        # inside the fixed deadline, the tail is dropped
+        return HostStragglerModel(n, args.stall / 3.0, rate=0.5,
+                                  nb_eligible=f, seed=0, jitter=1.2)
+    if regime == "drift":
+        # alternating calm/straggler phases through the real chaos DSL:
+        # the controller must re-converge at every switch
+        phase = max(2, args.steps // 4)
+        spec = " ".join(
+            "%d:%s" % (start, "straggle=1.0" if i % 2 else "calm")
+            for i, start in enumerate(range(0, args.steps + 1, phase))
+        )
+        sched = ChaosSchedule(spec, n, args=["straggle-workers:%d" % f])
+        return HostStragglerModel(n, args.stall, chaos=sched, seed=0)
+    raise ValueError("unknown regime %r" % regime)
+
+
+def run_cell(mode, regime, args, gar_name="krum", attack=None, nb_real_byz=0,
+             straggler_model="regime", steps=None):
     import jax
     import numpy as np
 
     from aggregathor_tpu import gars, models
     from aggregathor_tpu.core import build_optimizer, build_schedule
-    from aggregathor_tpu.parallel import RobustEngine, make_mesh
-    from aggregathor_tpu.parallel.bounded import (
-        BoundedWaitStep,
-        HostStragglerModel,
-    )
+    from aggregathor_tpu.parallel import RobustEngine, attacks, make_mesh
+    from aggregathor_tpu.parallel.bounded import BoundedWaitStep
+    from aggregathor_tpu.parallel.deadline import DeadlineController
 
     n, f = args.nb_workers, args.nb_byz
+    steps = steps or args.steps
     exp = models.instantiate("digits", ["batch-size:%d" % args.batch_size])
     gar = gars.instantiate(gar_name, n, f)
     tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
-    engine = RobustEngine(make_mesh(nb_workers=1), gar, n)
+    atk = (attacks.instantiate(attack, n, nb_real_byz, ["deviation:10000.0"])
+           if attack else None)
+    engine = RobustEngine(make_mesh(nb_workers=1), gar, n, attack=atk,
+                          nb_real_byz=nb_real_byz)
     state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
-    model = None
-    if stall > 0:
-        model = HostStragglerModel(
-            n, stall, rate=1.0, nb_eligible=args.stragglers, seed=0
+    model = (build_straggler_model(regime, args)
+             if straggler_model == "regime" else straggler_model)
+    controller = None
+    if mode == "adaptive":
+        controller = DeadlineController(
+            args.deadline, percentile=args.percentile, floor=args.floor,
+            ema=0.5,
         )
     step = BoundedWaitStep(
         engine, exp.loss, tx, jax.device_get(state.params),
-        deadline=args.deadline if mode == "bounded" else None,
-        straggler_model=model,
+        deadline=None if mode == "sync" else args.deadline,
+        straggler_model=model, controller=controller,
+        stale_infill=mode == "adaptive", stale_max_age=args.stale_max_age,
     )
     it = exp.make_train_iterator(n, seed=3)
     losses = []
+
+    def mean_arrived_loss(metrics):
+        # total_loss sums only the ARRIVED workers' losses, so arms with
+        # different timeout counts are not comparable on the raw sum —
+        # normalize to the per-arrived-worker mean
+        total = float(jax.device_get(metrics["total_loss"]))
+        arrived = n - int(jax.device_get(metrics["nb_timeouts"]))
+        return total / max(arrived, 1)
+
     try:
         state, m = step(state, next(it))  # warmup: compiles, deadline off
-        losses.append(float(jax.device_get(m["total_loss"])))
+        losses.append(mean_arrived_loss(m))
         begin = time.perf_counter()
-        for _ in range(args.steps):
+        for _ in range(steps):
             state, m = step(state, next(it))
-            losses.append(float(jax.device_get(m["total_loss"])))
+            losses.append(mean_arrived_loss(m))
         elapsed = time.perf_counter() - begin
         timeouts = int(step.timeouts_total.sum())
+        stale = int(step.stale_total.sum())
     finally:
         step.close()
     return {
         "mode": mode,
+        "regime": regime,
         "gar": gar_name,
-        "stall_seconds": float(stall),
-        "steps_per_s": args.steps / elapsed,
+        "steps_per_s": steps / elapsed,
         "losses_finite": bool(np.isfinite(losses).all()),
         "final_loss": float(losses[-1]),
+        "loss_decreased": bool(np.isfinite(losses).all()
+                               and losses[-1] < losses[0]),
         "timeouts_total": timeouts,
+        "stale_total": stale,
+        "window_final": None if controller is None else controller.window,
+    }
+
+
+def run_breakdown(args):
+    """The stale-laundering budget boundary (tests/test_bounded.py twin):
+    the r coalition workers run a local gaussian attack AND straggle
+    persistently, so their attack rows re-enter via the stale carry.
+    At r = f both rules hold; at r = f + 1 trimmed-mean (exact-f trim
+    budget) is poisoned.  (Krum's selection degrades gracefully past f
+    for uncoordinated rows — see docs/engine.md.)"""
+    from aggregathor_tpu.parallel.bounded import HostStragglerModel
+
+    n, f = args.nb_workers, args.nb_byz
+    steps = max(3, min(args.steps, 5))
+
+    def probe(gar_name, r):
+        model = HostStragglerModel(n, max(args.deadline * 4, 0.5), rate=1.0,
+                                   nb_eligible=r, seed=0)
+        cell = run_cell("adaptive", "steady", args, gar_name=gar_name,
+                        attack="gaussian", nb_real_byz=r,
+                        straggler_model=model, steps=steps)
+        return cell["loss_decreased"]
+
+    return {
+        "at_f_krum_ok": probe("krum", f),
+        "at_f_trimmed_ok": probe("trimmed-mean", f),
+        "over_f_broken": not probe("trimmed-mean", f + 1),
     }
 
 
 def validate(doc):
-    """Schema check for round-tripping consumers (the smoke script)."""
+    """Schema check for round-tripping consumers (the smoke script and
+    tests/test_bounded.py's checked-in-document test)."""
     if doc.get("schema") != SCHEMA:
         raise ValueError("not a %s document" % SCHEMA)
     for key in ("config", "cells", "breakdown", "verdict"):
         if key not in doc:
             raise ValueError("missing %r" % key)
     for cell in doc["cells"]:
-        for key in ("mode", "stall_seconds", "steps_per_s", "losses_finite",
-                    "timeouts_total"):
+        for key in ("mode", "regime", "steps_per_s", "losses_finite",
+                    "final_loss", "loss_decreased", "timeouts_total",
+                    "stale_total", "window_final"):
             if key not in cell:
                 raise ValueError("cell missing %r" % key)
-        if cell["mode"] not in ("sync", "bounded"):
+        if cell["mode"] not in MODES:
             raise ValueError("bad mode %r" % cell["mode"])
-    for key in ("bounded_flat", "sync_degrades", "breakdown_holds", "pass"):
+        if cell["regime"] not in REGIMES:
+            raise ValueError("bad regime %r" % cell["regime"])
+    for key in ("at_f_krum_ok", "at_f_trimmed_ok", "over_f_broken"):
+        if not isinstance(doc["breakdown"].get(key), bool):
+            raise ValueError("breakdown missing bool %r" % key)
+    for key in ("adaptive_beats_both", "adaptive_loss_ok", "sync_degrades",
+                "breakdown_holds", "pass"):
         if not isinstance(doc["verdict"].get(key), bool):
             raise ValueError("verdict missing bool %r" % key)
     return doc
@@ -124,89 +229,117 @@ def load(path):
 
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("--steps", type=int, default=10,
+    parser.add_argument("--steps", type=int, default=12,
                         help="measured steps per cell (after 1 warmup)")
-    parser.add_argument("--deadline", type=float, default=0.15,
-                        help="bounded-wait round deadline (seconds)")
-    parser.add_argument("--severities", default="0,0.2,0.4,0.8",
-                        help="comma-separated straggler stalls (seconds)")
+    parser.add_argument("--deadline", type=float, default=0.3,
+                        help="fixed-arm deadline = adaptive initial/ceiling")
+    parser.add_argument("--stall", type=float, default=0.6,
+                        help="base straggler stall (seconds)")
+    parser.add_argument("--percentile", type=float, default=70.0,
+                        help="adaptive-arm target arrival percentile "
+                             "(<= 100*(n-f-1)/(n-1) so the budgeted "
+                             "coalition cannot pin the ceiling)")
+    parser.add_argument("--floor", type=float, default=0.02,
+                        help="adaptive-arm window floor (seconds)")
+    parser.add_argument("--stale-max-age", type=int, default=4)
+    parser.add_argument("--regimes", default="calm,steady,heavy_tail,drift",
+                        help="comma-separated regime subset")
     parser.add_argument("--nb-workers", type=int, default=8)
     parser.add_argument("--nb-byz", type=int, default=2,
-                        help="declared f (the timeout budget)")
-    parser.add_argument("--stragglers", type=int, default=2,
-                        help="eligible straggler count (must be <= f for "
-                             "the bounded trajectory to stay finite)")
+                        help="declared f (the timeout + stale budget)")
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--out", default=None, help="write the JSON here")
     args = parser.parse_args(argv)
-    severities = [float(x) for x in args.severities.split(",")]
+    regimes = [r for r in args.regimes.split(",") if r]
+    for regime in regimes:
+        if regime not in REGIMES:
+            raise SystemExit("unknown regime %r (know: %s)"
+                             % (regime, ", ".join(REGIMES)))
 
     cells = []
-    for stall in severities:
-        for mode in ("sync", "bounded"):
-            cell = run_cell(mode, stall, args)
+    for regime in regimes:
+        for mode in MODES:
+            cell = run_cell(mode, regime, args)
             cells.append(cell)
-            print("%-8s stall=%.2fs  %6.2f steps/s  timeouts=%d  %s" % (
-                cell["mode"], cell["stall_seconds"], cell["steps_per_s"],
-                cell["timeouts_total"],
-                "finite" if cell["losses_finite"] else "NON-FINITE",
-            ))
+            print("%-9s %-11s %6.2f steps/s  timeouts=%-3d stale=%-3d "
+                  "final=%.2f %s%s" % (
+                      cell["mode"], cell["regime"], cell["steps_per_s"],
+                      cell["timeouts_total"], cell["stale_total"],
+                      cell["final_loss"],
+                      "finite" if cell["losses_finite"] else "NON-FINITE",
+                      ("  window=%.3fs" % cell["window_final"])
+                      if cell["window_final"] is not None else "",
+                  ))
 
-    # breakdown property at the harshest severity: r = f stragglers
-    harshest = max(severities) if max(severities) > 0 else args.deadline * 4
-    b_args = argparse.Namespace(**vars(args))
-    b_args.steps = max(3, min(args.steps, 5))
-    krum_cell = run_cell("bounded", harshest, b_args, gar_name="krum")
-    avg_cell = run_cell("bounded", harshest, b_args, gar_name="average")
-    breakdown = {
-        "stall_seconds": harshest,
-        "krum_finite": krum_cell["losses_finite"],
-        "average_finite": avg_cell["losses_finite"],
-    }
+    breakdown = run_breakdown(args)
 
-    def rate(mode, stall):
-        return next(c["steps_per_s"] for c in cells
-                    if c["mode"] == mode and c["stall_seconds"] == stall)
+    def pick(mode, regime):
+        return next(c for c in cells
+                    if c["mode"] == mode and c["regime"] == regime)
 
-    bounded_rates = [rate("bounded", s) for s in severities]
-    sync_rates = [rate("sync", s) for s in severities]
-    # The protocol guarantee is a FLOOR, not a constant: a bounded round
-    # closes at worst at deadline + compute, whatever the stall (rounds
-    # whose stragglers are still in flight skip them and close even
-    # faster), while the synchronous round time grows with the stall
-    # itself.  "Flat within tolerance" = no bounded cell falls below the
-    # deadline-implied rate; "degrades" = the harshest sync cell loses
-    # more than the tolerance factor vs its own zero-severity rate.
-    base_step = 1.0 / max(sync_rates)  # compute-only step time
-    floor = 1.0 / (args.deadline + base_step)
-    bounded_flat = min(bounded_rates) >= floor / FLAT_TOLERANCE
-    sync_degrades = min(sync_rates) <= max(sync_rates) / FLAT_TOLERANCE
-    breakdown_holds = breakdown["krum_finite"] and not breakdown["average_finite"]
+    # The adaptive claim: under at least one drifting/heavy-tail/steady
+    # regime the controller beats BOTH the synchronous protocol and the
+    # fixed-deadline v1 arm on steps/s, with final loss no worse than
+    # fixed (stale rows vs NaN rows, LOSS_RTOL/_ATOL tolerance).
+    adaptive_beats = {}
+    adaptive_loss_ok = {}
+    for regime in regimes:
+        if regime == "calm":
+            continue
+        adaptive, fixed, sync = (pick(m, regime) for m in
+                                 ("adaptive", "fixed", "sync"))
+        adaptive_beats[regime] = bool(
+            adaptive["steps_per_s"] > fixed["steps_per_s"]
+            and adaptive["steps_per_s"] > sync["steps_per_s"]
+        )
+        adaptive_loss_ok[regime] = bool(
+            adaptive["losses_finite"]
+            and adaptive["final_loss"]
+            <= fixed["final_loss"] * (1.0 + LOSS_RTOL) + LOSS_ATOL
+        )
+    winning = [r for r in adaptive_beats
+               if adaptive_beats[r] and adaptive_loss_ok[r]]
+    sync_degrades = bool(
+        "steady" in [c["regime"] for c in cells]
+        and pick("sync", "steady")["steps_per_s"]
+        < pick("fixed", "steady")["steps_per_s"]
+    )
+    breakdown_holds = all(breakdown.values())
     doc = {
         "schema": SCHEMA,
         "generated_at": time.time(),
         "config": {
             "nb_workers": args.nb_workers, "nb_byz": args.nb_byz,
-            "stragglers": args.stragglers, "deadline": args.deadline,
-            "steps": args.steps, "batch_size": args.batch_size,
-            "severities": severities, "flat_tolerance": FLAT_TOLERANCE,
+            "deadline": args.deadline, "stall": args.stall,
+            "percentile": args.percentile, "floor": args.floor,
+            "stale_max_age": args.stale_max_age, "steps": args.steps,
+            "batch_size": args.batch_size, "regimes": regimes,
+            "loss_rtol": LOSS_RTOL, "loss_atol": LOSS_ATOL,
             "platform": os.environ.get("JAX_PLATFORMS", ""),
         },
         "cells": cells,
         "breakdown": breakdown,
-        "deadline_rate_floor": floor,
+        "adaptive_beats_by_regime": adaptive_beats,
+        "adaptive_loss_ok_by_regime": adaptive_loss_ok,
+        "winning_regimes": winning,
         "verdict": {
-            "bounded_flat": bool(bounded_flat),
-            "sync_degrades": bool(sync_degrades),
-            "breakdown_holds": bool(breakdown_holds),
-            "pass": bool(bounded_flat and sync_degrades and breakdown_holds),
+            "adaptive_beats_both": bool(winning),
+            "adaptive_loss_ok": bool(all(adaptive_loss_ok.values())
+                                     if adaptive_loss_ok else False),
+            "sync_degrades": sync_degrades,
+            "breakdown_holds": breakdown_holds,
+            "pass": bool(winning and breakdown_holds),
         },
     }
     validate(doc)
-    print("verdict: bounded_flat=%s sync_degrades=%s breakdown_holds=%s -> %s"
-          % (doc["verdict"]["bounded_flat"], doc["verdict"]["sync_degrades"],
-             doc["verdict"]["breakdown_holds"],
-             "PASS" if doc["verdict"]["pass"] else "FAIL"))
+    print("breakdown: %s" % breakdown)
+    print("verdict: adaptive_beats_both=%s (regimes: %s) "
+          "sync_degrades=%s breakdown_holds=%s -> %s" % (
+              doc["verdict"]["adaptive_beats_both"],
+              ", ".join(winning) or "none",
+              doc["verdict"]["sync_degrades"],
+              doc["verdict"]["breakdown_holds"],
+              "PASS" if doc["verdict"]["pass"] else "FAIL"))
     if args.out:
         with open(args.out, "w") as fd:
             json.dump(doc, fd, indent=1)

