@@ -2,9 +2,11 @@
 
 The package runs real threads in production paths — the bounded-wait
 submission pool (``parallel/bounded.py``), the input ``ChunkPipeline``
-(``models/datasets.py``), the serve ``MicroBatcher`` dispatcher
-(``serve/batcher.py``), the live exporter (``obs/live.py``) and the
-background checkpoint writer (``obs/checkpoint.py``).  The dynamic tests
+(``models/datasets.py``), the serve ``ContinuousBatcher`` lane pool,
+autoscaler and checkpoint watcher (``serve/continuous.py``,
+``serve/autoscale.py``, ``serve/weights.py``), the live exporter
+(``obs/live.py``) and the background checkpoint writer
+(``obs/checkpoint.py``).  The dynamic tests
 exercise each at one schedule; this checker proves the *pattern* —
 unlocked attribute writes on thread-reachable code paths — is absent (or
 explicitly baselined with its safety argument) package-wide.

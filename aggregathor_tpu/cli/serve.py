@@ -1,11 +1,16 @@
-"""Serving runner: checkpoint -> Byzantine-robust HTTP inference.
+"""Serving runner: checkpoint -> Byzantine-robust HTTP inference (serve/ v2).
 
 The serving sibling of ``cli/runner.py``: loads a trained checkpoint
 (``obs/checkpoint.py`` restore — the authenticator and at-rest cipher are
 honored via the same ``--session-secret`` flags training uses), builds an
 R-way replicated :class:`serve.engine.InferenceEngine` with a GAR vote over
-replica logits, and serves ``/predict`` / ``/healthz`` / ``/metrics``
-through the deadline micro-batcher (docs/serving.md).
+replica logits, and serves ``/predict`` / ``/healthz`` / ``/metrics`` /
+``/status`` through the v2 stack (docs/serving.md): the asyncio front end
+(``serve/frontend.py``), continuous batching on the bucket ladder
+(``serve/continuous.py``, ``--lanes``/``--max-lanes``/``--linger-ms``),
+optional registry-driven autoscaling (``--autoscale``,
+``serve/autoscale.py``) and the zero-downtime weight pipeline
+(``--follow``, ``serve/weights.py``).
 
 Replica sources:
 
@@ -16,29 +21,39 @@ Replica sources:
 
 ``--poison-replica INDEX:MODE[=VALUE]`` (repeatable) injects the chaos
 replica-fault modes (``chaos/replica_faults.py``: nan / scale / zero /
-noise / stale) — the fault-injection hook the smoke script and the serve
-campaign drive to prove the vote masks a corrupted replica in production
-configuration, not just in unit tests.
+noise / stale) — the fault-injection hook the smoke script, the serve
+campaign and the load benchmark drive to prove the vote masks a corrupted
+replica in production configuration, not just in unit tests.  Poison specs
+are RE-APPLIED on every hot swap: a poisoned test replica stays poisoned
+across the weight pipeline, which is what lets ``benchmarks/serve_load.py``
+drive mid-run swaps against a faulty pool.
 
 Chain of custody (docs/security.md): with ``--session-secret``, every
 restored checkpoint's signed lineage manifest (written by ``--secure``
 training) is verified before loading — an unsigned checkpoint is refused
 unless ``--allow-unsigned`` — and ``/healthz`` reports
-``custody_verified``.  ``SIGHUP`` hot-restores the replicas from their
-checkpoint directories through the same verification with zero recompiles
-(requests keep flowing; a bad snapshot keeps the previous weights).
+``custody_verified``.  Hot swaps re-verify through the SAME custody path:
+``--follow`` polls the snapshot directory and swaps newer steps in with
+zero recompiles and zero dropped requests; ``SIGHUP`` forces one reload
+now (requests keep flowing; a bad snapshot keeps the previous weights).
+
+The ``--ready-file`` handshake fires only after the bucket-ladder warmup
+compiles finish AND the front end is bound — a reader of the ready file
+never races a cold bucket with its first request.
 
 Example::
 
   python -m aggregathor_tpu.cli.serve --experiment digits \
       --ckpt-dir out/ckpt --replicas 3 --gar median \
-      --port 8000 --max-latency-ms 10 --max-batch 64
+      --port 8000 --max-batch 64 --lanes 2 --max-lanes 4 --autoscale \
+      --follow
 """
 
 import argparse
 import os
 import signal
 import sys
+import threading
 
 
 def build_parser():
@@ -65,7 +80,8 @@ def build_parser():
                              "(default (R-1)//2)")
     parser.add_argument("--poison-replica", action="append", default=[], metavar="IDX:MODE[=V]",
                         help="chaos tie-in: corrupt replica IDX with a replica fault "
-                             "(nan|scale=X|zero|noise=S|stale); repeatable")
+                             "(nan|scale=X|zero|noise=S|stale); repeatable; re-applied "
+                             "on every hot swap")
     # Restore template: must match the optimizer the snapshot was trained with
     parser.add_argument("--optimizer", default="sgd", help="optimizer the checkpoint was trained with")
     parser.add_argument("--optimizer-args", nargs="*", default=[], help="key:value optimizer arguments")
@@ -83,12 +99,18 @@ def build_parser():
                              "loading and an unsigned checkpoint is REFUSED "
                              "unless this explicit opt-out is passed "
                              "(/healthz then reports custody_verified false)")
-    # Batching / shedding
+    # Scheduling / shedding (serve/continuous.py)
     parser.add_argument("--max-batch", type=int, default=64, help="bucket ladder top / batch cap")
     parser.add_argument("--buckets", default=None, metavar="B1,B2,...",
                         help="explicit bucket ladder (default: powers of two up to --max-batch)")
-    parser.add_argument("--max-latency-ms", type=float, default=10.0,
-                        help="micro-batch dispatch deadline from the oldest queued request")
+    parser.add_argument("--lanes", type=int, default=1,
+                        help="initial dispatch lanes (concurrent in-flight batches over "
+                             "the one compiled ladder)")
+    parser.add_argument("--max-lanes", type=int, default=None,
+                        help="lane ceiling the autoscaler may climb to (default --lanes)")
+    parser.add_argument("--linger-ms", type=float, default=0.0,
+                        help="optional sub-top coalescing window; 0 = pure continuous "
+                             "batching (dispatch the instant a lane frees)")
     parser.add_argument("--queue-bound", type=int, default=256,
                         help="queued-row bound beyond which requests are shed (HTTP 429)")
     parser.add_argument("--flag-threshold", type=float, default=None,
@@ -97,13 +119,31 @@ def build_parser():
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip compiling the bucket ladder up front (first requests "
                              "then pay the compiles)")
+    # Autoscaling (serve/autoscale.py)
+    parser.add_argument("--autoscale", action="store_true",
+                        help="scale lanes (and, under sustained pressure, the vote pool "
+                             "within the declared-f floor) from the live registry")
+    parser.add_argument("--autoscale-args", nargs="*", default=[], metavar="K:V",
+                        help="autoscale knobs (serve/autoscale.py AutoscaleConfig: "
+                             "interval, high-queue, low-queue, high-p99, low-p99, "
+                             "high-shed, low-shed, up-patience, down-patience, "
+                             "cooldown, fault-reserve, min-lanes)")
+    # Weight pipeline (serve/weights.py)
+    parser.add_argument("--follow", action="store_true",
+                        help="follow the checkpoint director(ies): poll for newer "
+                             "snapshots and hot-swap them in (custody re-verified, "
+                             "zero recompiles, zero dropped requests)")
+    parser.add_argument("--follow-interval", type=float, default=2.0, metavar="S",
+                        help="snapshot poll period in seconds for --follow")
     # HTTP / observability
     parser.add_argument("--host", default="127.0.0.1", help="bind address")
     parser.add_argument("--port", type=int, default=8000, help="bind port (0 = ephemeral)")
     parser.add_argument("--ready-file", default=None, metavar="PATH",
-                        help="write 'host port pid' here once serving (harness handshake)")
+                        help="write 'host port pid' here once the warmup compiles are "
+                             "done AND the front end is bound (harness handshake)")
     parser.add_argument("--summary-dir", default=None,
-                        help="JSONL serve_batch/serve_shed event directory (obs/summaries)")
+                        help="JSONL serve_batch/serve_shed/serve_autoscale/"
+                             "serve_weight_swap event directory (obs/summaries)")
     parser.add_argument("--trace-file", default=None, metavar="PATH",
                         help="write a Chrome trace-event JSON of the request "
                              "lifecycle spans (enqueue -> batch -> jit -> reply) "
@@ -118,17 +158,22 @@ def build_parser():
     return parser
 
 
-def load_replicas(args, experiment):
+def load_replicas(args, experiment, step=None):
     """Resolve the replica parameter sets: checkpoint restores + poison specs.
 
-    Returns ``(replicas, sources, custody_verified)`` — ``sources`` is the
-    human-readable per-replica provenance logged at startup and reported by
-    /healthz's operator story ("which checkpoint is replica 2, and is it
-    poisoned?"); ``custody_verified`` is the chain-of-custody verdict (True
-    = every restored checkpoint's signed lineage manifest verified, False =
-    an unsigned restore was allowed through ``--allow-unsigned``, None =
-    no ``--session-secret``, verification not attempted).  Called again on
-    hot restore (SIGHUP), so a fresh custody tally is built per load.
+    Returns ``(replicas, sources, custody_verified, served_step)`` —
+    ``sources`` is the human-readable per-replica provenance logged at
+    startup and reported by /healthz's operator story ("which checkpoint is
+    replica 2, and is it poisoned?"); ``custody_verified`` is the
+    chain-of-custody verdict (True = every restored checkpoint's signed
+    lineage manifest verified, False = an unsigned restore was allowed
+    through ``--allow-unsigned``, None = no ``--session-secret``,
+    verification not attempted); ``served_step`` is the step the non-stale
+    replicas restored at (None when distinct directories restored at
+    different steps — a mixed pool has no one step to tag responses with).
+    ``step`` pins the restore (the weight pipeline's reload path, beating
+    ``args.ckpt_step``).  Called again on every hot swap, so a fresh
+    custody tally is built per load and poison specs are re-applied.
     """
     from .. import config
     from ..chaos.replica_faults import corrupt_params, parse_poison
@@ -191,7 +236,9 @@ def load_replicas(args, experiment):
             raise UserException("--poison-replica: replica %d poisoned twice" % index)
         poisons[index] = (mode, value)
 
+    pinned = step if step is not None else args.ckpt_step
     replicas, sources = [], []
+    steps_seen = set()
     cache = {}
     for index, directory in enumerate(dirs):
         poison = poisons.get(index)
@@ -206,22 +253,24 @@ def load_replicas(args, experiment):
                     "--poison-replica %d:stale needs at least two snapshots in %r"
                     % (index, directory)
                 )
-            params, step = restore(directory, step=on_disk[0])
-            sources.append("%s@%d (stale)" % (directory, step))
+            params, at_step = restore(directory, step=on_disk[0])
+            sources.append("%s@%d (stale)" % (directory, at_step))
         else:
-            key = (directory, args.ckpt_step)
+            key = (directory, pinned)
             if key not in cache:
-                cache[key] = restore(directory, step=args.ckpt_step)
-            params, step = cache[key]
+                cache[key] = restore(directory, step=pinned)
+            params, at_step = cache[key]
+            steps_seen.add(int(at_step))
             if poison is not None:
                 mode, value = poison
                 params = corrupt_params(params, mode, value, seed=args.seed + 31 * index)
-                sources.append("%s@%d (poisoned: %s)" % (directory, step, mode))
+                sources.append("%s@%d (poisoned: %s)" % (directory, at_step, mode))
             else:
-                sources.append("%s@%d" % (directory, step))
+                sources.append("%s@%d" % (directory, at_step))
         replicas.append(params)
     custody_verified = None if custody is None else custody.all_verified
-    return replicas, sources, custody_verified
+    served_step = steps_seen.pop() if len(steps_seen) == 1 else None
+    return replicas, sources, custody_verified, served_step
 
 
 def main(argv=None):
@@ -234,10 +283,16 @@ def main(argv=None):
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    from .. import gars, models
-    from ..obs import SummaryWriter, trace
+    from .. import config, gars, models
+    from ..obs import Checkpoints, SummaryWriter, trace
     from ..obs.summaries import make_run_id
-    from ..serve import InferenceEngine, InferenceServer
+    from ..serve import (
+        AutoscaleConfig,
+        CheckpointWatcher,
+        InferenceEngine,
+        InferenceServer,
+        PoolAutoscaler,
+    )
     from ..utils import Context, UserException, info
 
     run_id = args.run_id if args.run_id else make_run_id()
@@ -247,7 +302,7 @@ def main(argv=None):
 
     with Context("load"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
-        replicas, sources, custody_verified = load_replicas(args, experiment)
+        replicas, sources, custody_verified, served_step = load_replicas(args, experiment)
         nb_replicas = len(replicas)
         for index, source in enumerate(sources):
             info("replica %d: %s" % (index, source))
@@ -273,7 +328,7 @@ def main(argv=None):
     with Context("compile"):
         engine = InferenceEngine(
             experiment, replicas, gar=vote, max_batch=args.max_batch,
-            buckets=buckets, seed=args.seed,
+            buckets=buckets, seed=args.seed, weights_step=served_step,
         )
         if not args.no_warmup:
             engine.warmup()
@@ -281,54 +336,61 @@ def main(argv=None):
     summaries = SummaryWriter(args.summary_dir, run_name="serve", run_id=run_id)
     server = InferenceServer(
         engine, host=args.host, port=args.port,
-        max_latency_s=args.max_latency_ms / 1e3,
         queue_bound=args.queue_bound,
+        lanes=args.lanes, max_lanes=args.max_lanes,
+        linger_s=args.linger_ms / 1e3,
         summaries=summaries,
         request_timeout_s=args.request_timeout,
         flag_threshold=args.flag_threshold,
         custody_verified=custody_verified,
     )
-    host, port = server.server_address[:2]
-    if args.ready_file:
-        tmp = args.ready_file + ".tmp"
-        with open(tmp, "w") as fd:
-            fd.write("%s %d %d\n" % (host, port, os.getpid()))
-        os.replace(tmp, args.ready_file)  # atomic: readers never see a torn line
+
+    def reload_step(step):
+        """The weight pipeline's reload: re-restore every replica at
+        ``step`` through the full custody path (poison specs re-applied),
+        swap atomically, update /healthz's verdict.  Raising keeps the
+        previous weights serving (CheckpointWatcher's contract)."""
+        fresh, fresh_sources, fresh_custody, _ = load_replicas(
+            args, experiment, step=step
+        )
+        engine.swap_replicas(fresh, step=step)
+        server.set_custody_verified(fresh_custody)
+        for index, source in enumerate(fresh_sources):
+            info("hot swap: replica %d <- %s" % (index, source))
+
+    def poll_steps():
+        """Steps available in EVERY checkpoint directory (a multi-dir pool
+        only swaps when all its sources reached the step)."""
+        base_name = (args.checkpoint_base_name
+                     if args.checkpoint_base_name is not None
+                     else config.default_checkpoint_base_name)
+        common = None
+        for directory in dict.fromkeys(args.ckpt_dir):
+            steps = set(Checkpoints(directory, base_name).steps())
+            common = steps if common is None else (common & steps)
+        return sorted(common or ())
+
+    watcher = CheckpointWatcher(
+        poll_steps, reload_step, served_step=served_step,
+        interval_s=args.follow_interval, summaries=summaries,
+    )
+    autoscaler = None
+    if args.autoscale:
+        autoscaler = PoolAutoscaler(server, AutoscaleConfig(args.autoscale_args))
+
+    stop = threading.Event()
 
     def on_signal(signum, frame):
-        # serve_forever runs on THIS (main) thread and shutdown() blocks
-        # until its loop acknowledges — called inline here it would deadlock
-        # (the loop cannot advance while the handler blocks), so it runs on
-        # a helper thread and the handler returns immediately.
-        import threading
-
         info("Signal %d: draining and shutting down" % signum)
-        threading.Thread(target=server.shutdown, daemon=True).start()
-
-    def hot_restore():
-        """Re-restore every replica from its checkpoint directory and swap
-        the engine's parameter stack in place (zero recompiles, requests
-        keep flowing) — provenance RE-verified through the same custody
-        path as startup, /healthz's custody_verified updated.  ANY failure
-        — custody violation, vanished file, torn or undeserializable
-        snapshot — keeps serving the current weights (the catch is broad by
-        design: a bad snapshot must not take the service down)."""
-        try:
-            fresh, fresh_sources, fresh_custody = load_replicas(args, experiment)
-            engine.swap_replicas(fresh)
-            server.set_custody_verified(fresh_custody)
-            for index, source in enumerate(fresh_sources):
-                info("hot restore: replica %d <- %s" % (index, source))
-        except Exception as exc:
-            info("hot restore REFUSED (still serving previous weights): "
-                 "%s: %s" % (type(exc).__name__, exc))
+        stop.set()
 
     def on_reload(signum, frame):
-        # off the signal handler for the same deadlock reason as shutdown
-        import threading
-
+        # off the signal handler: a reload restores checkpoints (seconds of
+        # work) and the watcher lock serializes it against the poll thread
         info("Signal %d: hot checkpoint restore" % signum)
-        threading.Thread(target=hot_restore, daemon=True).start()
+        threading.Thread(
+            target=watcher.check_once, kwargs={"force": True}, daemon=True
+        ).start()
 
     previous = {
         signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
@@ -336,15 +398,35 @@ def main(argv=None):
         signal.SIGHUP: signal.signal(signal.SIGHUP, on_reload),
     }
     try:
+        host, port = server.serve_background()
+        if args.follow:
+            watcher.start()
+            info("weight pipeline: following %r every %gs (served step %r)"
+                 % (list(args.ckpt_dir), args.follow_interval, served_step))
+        if autoscaler is not None:
+            autoscaler.start()
+            info("autoscale: %d capacity rung(s), starting at %d"
+                 % (len(autoscaler.ladder), autoscaler.rung))
+        # The handshake contract: by the time the ready file exists, the
+        # bucket ladder is compiled (warmup ran above, unless explicitly
+        # skipped) and the port accepts connections — a smoke's first
+        # request never races a cold bucket.
+        if args.ready_file:
+            tmp = args.ready_file + ".tmp"
+            with open(tmp, "w") as fd:
+                fd.write("%s %d %d\n" % (host, port, os.getpid()))
+            os.replace(tmp, args.ready_file)  # atomic: readers never see a torn line
         info("Serving %s on http://%s:%d (%d replica(s), vote=%s)"
              % (args.experiment, host, port, nb_replicas,
                 type(vote).__name__ if vote else "none"))
-        server.serve_forever()
+        stop.wait()
     finally:
         for signum, handler in previous.items():
             signal.signal(signum, handler)
-        server.server_close()
-        server.batcher.close()
+        if autoscaler is not None:
+            autoscaler.close()
+        watcher.close()
+        server.shutdown_all()
         summaries.close()
         if args.trace_file:
             written = trace.uninstall(save=True)

@@ -39,11 +39,16 @@ DEFAULT_TOLERANCE = 0.25
 
 #: direction per known metric: "higher" regresses when the current value
 #: falls below baseline*(1-tol); "lower" when it rises above
-#: baseline*(1+tol)
+#: baseline*(1+tol).  The serve_* entries are the serving-side SLO judged
+#: by benchmarks/serve_load.py (docs/serving.md) — same sentinel, same
+#: baseline schema, one more producer.
 DIRECTIONS = {
     "steps_per_s": "higher",
     "gar_seconds_total": "lower",
     "input_overlap_fraction": "higher",
+    "serve_req_per_s": "higher",
+    "serve_p50_ms": "lower",
+    "serve_p99_ms": "lower",
 }
 
 

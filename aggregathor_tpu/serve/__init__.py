@@ -1,37 +1,59 @@
-"""Byzantine-robust batched inference serving.
+"""Byzantine-robust serving at sustained concurrency (serve/ v2).
 
-The inference layer the ROADMAP's "serve heavy traffic" north star asks for:
-trained checkpoints (``obs/checkpoint.py`` restore, authenticator honored)
-answer prediction requests through ONE compiled apply path.
+The inference layer the ROADMAP's "serve heavy traffic" north star asks
+for: trained checkpoints (``obs/checkpoint.py`` restore, authenticator
+honored) answer prediction requests through ONE compiled apply path, as
+four composable subsystems (docs/serving.md — the NET-SA framing:
+front end, scheduler, pool and weight pipeline are architecture, not one
+blocking handler):
 
-- ``engine``:  :class:`InferenceEngine` — a fixed power-of-two **bucket
-  ladder** of padded batch shapes (zero steady-state recompiles, the chaos
-  scheduler's compile discipline applied to serving) and R-way **replicated
-  robust inference**: replica logits stacked ``(R, batch, classes)`` and
-  reduced by the training GARs (``gars/``) with the NaN-last convention, so
-  a crashed/corrupted replica is absorbed exactly like a Byzantine worker's
-  gradient row; per-replica disagreement scores feed quarantine-style
-  flagging.
-- ``batcher``: :class:`MicroBatcher` — deadline micro-batching (dispatch at
-  ``max_latency`` OR a full bucket), bounded queue with explicit
-  **load-shedding** (:class:`LoadShed` -> HTTP 429).
-- ``server``:  :class:`InferenceServer` — stdlib ``ThreadingHTTPServer``
-  exposing ``/predict``, ``/healthz`` and ``/metrics`` (queue depth, batch
-  occupancy, p50/p95/p99, shed count, per-replica disagreement), metrics
-  mirrored as ``obs/summaries`` JSONL events.
-- ``campaign``: the replica-fault resilience harness (fault modes from
+- ``engine``:     :class:`InferenceEngine` — a fixed power-of-two **bucket
+  ladder** of padded batch shapes (zero steady-state recompiles) and R-way
+  **replicated robust inference**: replica logits reduced by the training
+  GARs (``gars/``) with the NaN-last convention; per-replica disagreement
+  scores; a traced **active-replica mask** (pool scaling spends the
+  declared-f budget) and an atomic **hot weight swap** tagged with the
+  served ``weights_step`` — both on the same compiled executables.
+- ``continuous``: :class:`ContinuousBatcher` — continuous (in-flight)
+  batching on the bucket ladder: requests join the next dispatch the
+  moment a lane frees, formation is a PURE synthetic-clock
+  :class:`ContinuousPolicy`, backpressure stays explicit
+  (:class:`LoadShed` -> HTTP 429).
+- ``frontend``:   :class:`InferenceServer` — ONE asyncio event-loop thread
+  serving ``/predict`` / ``/healthz`` / ``/metrics`` / ``/status``
+  (400/429/504 contract kept; in-flight requests cost a coroutine, not a
+  thread).
+- ``autoscale``:  registry-driven pool scaling (queue depth, p99, shed
+  rate -> hysteresis policy) over dispatch lanes and vote replicas, with
+  the declared-f feasibility floor.
+- ``weights``:    :class:`CheckpointWatcher` — the zero-downtime weight
+  pipeline following a training run's snapshot directory (custody
+  verified, zero recompiles, zero dropped requests).
+- ``campaign``:   the replica-fault resilience harness (fault modes from
   ``chaos/replica_faults.py``) proving median-of-replicas serves at the
-  clean bar while plain averaging degrades.
+  clean bar while plain averaging degrades — now through the scheduler.
 
 CLI: ``python -m aggregathor_tpu.cli.serve --ckpt-dir ... --experiment ...
 --replicas R --gar median`` (see ``cli/serve.py``; docs/serving.md).
 """
 
-from .batcher import LoadShed, MicroBatcher, Ticket  # noqa: F401
+from .autoscale import (  # noqa: F401
+    AutoscaleConfig,
+    AutoscalePolicy,
+    CapacityLadder,
+    PoolAutoscaler,
+)
+from .continuous import (  # noqa: F401
+    ContinuousBatcher,
+    ContinuousPolicy,
+    LoadShed,
+    Ticket,
+)
 from .engine import (  # noqa: F401
     InferenceEngine,
     bucket_ladder,
     choose_bucket,
     restore_params,
 )
-from .server import InferenceServer  # noqa: F401
+from .frontend import InferenceServer  # noqa: F401
+from .weights import CheckpointWatcher  # noqa: F401

@@ -21,6 +21,15 @@ The headline claim, as data (asserted by tests/test_serve.py and
 the clean bar while ``average`` degrades — the AggregaThor thesis carried
 into the serving layer.
 
+Since serve/ v2 every cell is served through the CONTINUOUS SCHEDULER
+(``serve/continuous.py``), not by calling the engine directly: the eval
+split is split into request-sized submissions fed concurrently to a
+:class:`~.continuous.ContinuousBatcher` over the cell's engine, so the
+verdicts cover the production dispatch path (batch formation, result
+splitting, lane reuse) and each cell additionally reports the scheduler's
+``batches`` count and the engine ``compile_count`` (the zero-recompile
+contract: one executable per ladder bucket, at every cell).
+
 The model is trained in-process (a short real training run through
 ``parallel.RobustEngine``) unless ``--ckpt-dir`` points at an existing
 checkpoint; ``stale`` replicas snapshot the params early in that run (or the
@@ -38,13 +47,40 @@ import argparse
 import json
 import sys
 
-SCHEMA = "aggregathor.serve.replica-matrix.v1"
+SCHEMA = "aggregathor.serve.replica-matrix.v2"
 
 #: matrix keys every cell must carry (the smoke script asserts these)
 CELL_KEYS = (
     "gar", "fault", "nb_replicas", "nb_faulty", "accuracy", "match_rate",
-    "masked", "disagreement", "suspects",
+    "masked", "disagreement", "suspects", "batches", "compile_count",
 )
+
+
+def validate(doc):
+    """Schema check for round-tripping consumers (the smoke script and
+    tests/test_serve.py's round-trip test)."""
+    if doc.get("schema") != SCHEMA:
+        raise ValueError("not a %s document" % SCHEMA)
+    for key in ("experiment", "nb_replicas", "nb_faulty", "steps_trained",
+                "eval_rows", "match_bar", "clean_accuracy", "cells"):
+        if key not in doc:
+            raise ValueError("missing %r" % key)
+    if not isinstance(doc["cells"], list) or not doc["cells"]:
+        raise ValueError("cells must be a non-empty list")
+    for cell in doc["cells"]:
+        for key in CELL_KEYS:
+            if key not in cell:
+                raise ValueError("cell missing %r" % key)
+        if not isinstance(cell["masked"], bool):
+            raise ValueError("cell 'masked' must be a bool")
+        if cell["batches"] < 1:
+            raise ValueError("cell served zero scheduler batches")
+    return doc
+
+
+def load(path):
+    with open(path) as fd:
+        return validate(json.load(fd))
 
 
 def build_parser():
@@ -74,6 +110,11 @@ def build_parser():
     parser.add_argument("--eval-rows", type=int, default=256,
                         help="eval rows served per cell (0 = the whole test split)")
     parser.add_argument("--max-batch", type=int, default=64, help="bucket ladder top")
+    parser.add_argument("--request-rows", type=int, default=16,
+                        help="rows per scheduler submission (the simulated client "
+                             "request size the continuous batcher coalesces)")
+    parser.add_argument("--lanes", type=int, default=2,
+                        help="dispatch lanes the cell's scheduler runs")
     parser.add_argument("--match-bar", type=float, default=1.0,
                         help="masked verdict: match_rate >= this bar")
     parser.add_argument("--seed", type=int, default=0)
@@ -118,6 +159,41 @@ def train_in_process(experiment, nb_steps, lr, seed, stale_at=None):
         if s + 1 == stale_at:
             stale_params = jax.device_get(state.params)
     return jax.device_get(state.params), stale_params
+
+
+def serve_through_scheduler(engine, x, request_rows=16, lanes=2):
+    """Serve ``x`` through a :class:`~.continuous.ContinuousBatcher` over
+    ``engine`` — the production dispatch path — as a stream of
+    ``request_rows``-sized submissions all in flight at once.
+
+    Returns ``(predictions, disagreement, batches)``: predictions in row
+    order, the rows-weighted mean per-replica disagreement over the
+    dispatched batches (inf/NaN propagate — a faulty replica stays
+    flagged), and the scheduler batch count (< number of submissions
+    proves coalescing happened).
+    """
+    import numpy as np
+
+    from .continuous import ContinuousBatcher
+
+    request_rows = max(1, min(int(request_rows), engine.buckets[-1]))
+    batcher = ContinuousBatcher(
+        engine.predict, buckets=engine.buckets,
+        queue_bound=max(len(x), 1), nb_lanes=lanes, max_lanes=lanes,
+    )
+    try:
+        tickets = [
+            batcher.submit(x[start:start + request_rows])
+            for start in range(0, len(x), request_rows)
+        ]
+        results = [ticket.wait(120.0) for ticket in tickets]
+    finally:
+        batcher.close()
+    predictions = np.concatenate([r["predictions"] for r in results])
+    weights = np.asarray([len(r["predictions"]) for r in results], np.float64)
+    scores = np.stack([np.asarray(r["disagreement"], np.float64) for r in results])
+    disagreement = (scores * (weights / weights.sum())[:, None]).sum(axis=0)
+    return predictions, disagreement, batcher.batch_count
 
 
 def _eval_rows(experiment, limit):
@@ -202,9 +278,11 @@ def run_campaign(args):
                 experiment, replicas, gar=vote, max_batch=args.max_batch,
                 seed=args.seed,
             )
-            served = engine.predict(x_eval)
-            preds = served["predictions"]
-            disagreement = np.asarray(served["disagreement"], np.float64)
+            # v2: through the continuous scheduler — the production path
+            preds, disagreement, batches = serve_through_scheduler(
+                engine, x_eval, request_rows=args.request_rows,
+                lanes=args.lanes,
+            )
             suspects = [
                 int(i) for i, v in enumerate(disagreement) if not np.isfinite(v)
             ]
@@ -221,6 +299,9 @@ def run_campaign(args):
                     float(v) if np.isfinite(v) else None for v in disagreement
                 ],
                 "suspects": suspects,
+                "batches": int(batches),
+                "compile_count": int(engine.compile_count),
+                "nb_buckets": len(engine.buckets),
             }
             cells.append(cell)
             info("  cell %-12s x %-12s accuracy=%.3f match=%.3f masked=%s"
@@ -233,6 +314,8 @@ def run_campaign(args):
         "nb_faulty": args.nb_faulty,
         "steps_trained": int(steps_trained),
         "eval_rows": int(len(y_eval)),
+        "request_rows": int(args.request_rows),
+        "lanes": int(args.lanes),
         "match_bar": args.match_bar,
         "clean_accuracy": clean_accuracy,
         "cells": cells,

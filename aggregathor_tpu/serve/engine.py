@@ -19,8 +19,27 @@ faulty ones), while plain ``average`` is poisoned — the serving-side
 restatement of the AggregaThor thesis.  Per-replica **disagreement scores**
 (mean squared deviation from the voted logits over the valid rows; non-finite
 deviations read +inf) are surfaced per batch for quarantine-style flagging.
+
+Two serving-scale levers ride the SAME compiled executables (both are
+traced operands, so neither ever recompiles a bucket — the serve/ v2
+zero-recompile contract, asserted by tests/test_serve.py):
+
+- **Active-replica mask** (``set_active_replicas``): a retired replica's
+  logits are masked to NaN BEFORE the vote, so it is excluded exactly like
+  a crashed worker — and exactly like one it SPENDS the vote's declared-f
+  budget, which is why the autoscaler (``serve/autoscale.py``) owns the
+  feasibility floor ``retired + fault reserve <= f``.  Whether the rule
+  actually absorbs that many dead rows is PROBED (``vote_absorbs_retired``),
+  not trusted from a flag.
+- **Hot weight swap** (``swap_replicas``): the ``(params, active, step)``
+  triple is ONE atomically-rebound tuple — an in-flight forward finishes
+  on the old stack, the next dispatch reads the new one, and every
+  ``predict`` reports the ``weights_step`` its batch actually ran on (the
+  zero-downtime weight pipeline's wrong-weight check keys on it,
+  ``serve/weights.py``).
 """
 
+import threading
 import warnings
 
 import numpy as np
@@ -142,7 +161,7 @@ class InferenceEngine:
     """
 
     def __init__(self, experiment, replicas, gar=None, max_batch=64,
-                 buckets=None, seed=0):
+                 buckets=None, seed=0, weights_step=None):
         if not replicas:
             raise UserException("InferenceEngine needs at least one replica")
         self.experiment = experiment
@@ -159,19 +178,33 @@ class InferenceEngine:
         if not self.buckets or self.buckets[0] < 1:
             raise UserException("Bucket ladder must hold positive sizes: %r" % (self.buckets,))
         self.sample_shape = tuple(experiment.sample_shape)
-        # One stacked (R, ...) pytree: vmap's in_axes=0 runs every replica
-        # through the same compiled forward — R is a *shape*, not a loop.
-        self._params = jax.device_put(jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *replicas
-        ))
         self._vote_key = jax.random.PRNGKey(seed)
+        # The live serving state is ONE tuple — (stacked params, active
+        # mask, weights step) — rebound atomically by swap_replicas /
+        # set_active_replicas, so a dispatch never reads a torn mix of old
+        # weights with a new step tag.  READS are lock-free (tuple rebind
+        # is atomic); the two MUTATORS are read-modify-writes and hold
+        # _live_lock so a concurrent hot swap (watcher/SIGHUP thread) and
+        # autoscale move cannot silently undo each other's update.
+        self._live_lock = threading.Lock()
+        self._live = (
+            self._stack(replicas),
+            jnp.ones((self.nb_replicas,), jnp.bool_),
+            weights_step,
+        )
         apply_fn = experiment.predict_logits
 
-        def forward(params_stack, x, nb_valid, key):
+        def forward(params_stack, x, nb_valid, key, active):
             logits = jax.vmap(apply_fn, in_axes=(0, None))(params_stack, x)
             logits = logits.astype(jnp.float32)  # GAR math in f32, like training
             nb_r, bucket = logits.shape[0], logits.shape[1]
             flat = logits.reshape((nb_r, -1))
+            # A retired replica is a crashed one as far as the vote can
+            # tell: its row reads NaN and the NaN-last convention excludes
+            # it (nan_row_tolerant rules only — set_active_replicas
+            # enforces that).  ``active`` is a traced operand: scaling the
+            # pool never touches the compiled ladder.
+            flat = jnp.where(active[:, None], flat, jnp.nan)
             if self.gar is None or nb_r == 1:
                 voted = flat[0]
             else:
@@ -179,7 +212,9 @@ class InferenceEngine:
             # Disagreement over the VALID rows only: padding rows are zeros,
             # whose logits would dilute (never inflate) a faulty replica's
             # score.  Non-finite deviation = maximal disagreement (+inf), so
-            # a NaN replica is flagged, not averaged away.
+            # a NaN replica is flagged, not averaged away; a RETIRED replica
+            # reads NaN (not +inf) so the host can tell "scaled out" from
+            # "suspect".
             row_valid = jax.lax.broadcasted_iota(jnp.int32, (bucket,), 0) < nb_valid
             coord_valid = jnp.repeat(row_valid, flat.shape[1] // bucket)
             deviation = (flat - voted[None, :]) ** 2
@@ -187,21 +222,104 @@ class InferenceEngine:
             masked = jnp.where(coord_valid[None, :], deviation, 0.0)
             denom = jnp.maximum(nb_valid * (flat.shape[1] // bucket), 1).astype(jnp.float32)
             disagreement = jnp.sum(masked, axis=1) / denom
+            disagreement = jnp.where(active, disagreement, jnp.nan)
             voted = voted.reshape(logits.shape[1:])
             return jnp.argmax(voted, axis=-1), voted, disagreement
 
         self._fn = jax.jit(forward, donate_argnums=(1,))
 
-    def swap_replicas(self, replicas):
+    @staticmethod
+    def _stack(replicas):
+        # One stacked (R, ...) pytree: vmap's in_axes=0 runs every replica
+        # through the same compiled forward — R is a *shape*, not a loop.
+        return jax.device_put(jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *replicas
+        ))
+
+    @property
+    def weights_step(self):
+        """The training step of the currently-served weights (None when
+        the source checkpoint did not carry one)."""
+        return self._live[2]
+
+    @property
+    def active_replicas(self):
+        """Sorted indices of the replicas currently voting."""
+        mask = np.asarray(self._live[1])
+        return [int(i) for i in np.nonzero(mask)[0]]
+
+    def set_active_replicas(self, indices):
+        """Scale the voting pool: serve with exactly ``indices`` active.
+
+        Retired replicas' logits read NaN and are excluded by the vote —
+        spending the declared-f budget exactly like a crashed replica, so
+        the caller (``serve/autoscale.py``) must keep
+        ``retired + expected faults <= f``.  The mask is a traced operand:
+        ZERO recompiles at any pool size.  Returns the active list.
+        """
+        indices = sorted(set(int(i) for i in indices))
+        if not indices:
+            raise UserException("at least one replica must stay active")
+        if indices[0] < 0 or indices[-1] >= self.nb_replicas:
+            raise UserException(
+                "active replicas %r out of range for R=%d"
+                % (indices, self.nb_replicas)
+            )
+        if len(indices) < self.nb_replicas:
+            if self.gar is None or self.nb_replicas == 1:
+                raise UserException(
+                    "cannot retire replicas without a vote rule: the "
+                    "single/unvoted forward serves replica 0 unconditionally"
+                )
+            if not self.vote_absorbs_retired(self.nb_replicas - len(indices)):
+                raise UserException(
+                    "vote rule %s does not absorb %d retired (NaN) replica "
+                    "row(s) at R=%d: the vote would be poisoned — retire "
+                    "fewer replicas or declare a larger f"
+                    % (type(self.gar).__name__,
+                       self.nb_replicas - len(indices), self.nb_replicas)
+                )
+        mask = np.zeros((self.nb_replicas,), bool)
+        mask[indices] = True
+        with self._live_lock:
+            stack, _, step = self._live
+            self._live = (stack, jnp.asarray(mask), step)
+        return indices
+
+    def vote_absorbs_retired(self, nb_retired):
+        """Concrete feasibility probe: does the vote rule return a finite
+        aggregate with ``nb_retired`` all-NaN rows in the stack?  Retired
+        replicas are NaN rows, and each rule's real absorption boundary
+        (median's order-statistic slots, krum's +inf distances,
+        average-nan's exclusion, plain average's none) is probed rather
+        than trusted from a flag — the same reject-by-measurement
+        discipline as the graftcheck GAR contract checker
+        (docs/analysis.md).  The probe runs the rule eagerly on a tiny
+        host matrix; it never touches the bucket executables."""
+        if self.gar is None:
+            return nb_retired == 0
+        probe = np.ones((self.nb_replicas, 4), np.float32)
+        if nb_retired > 0:
+            probe[self.nb_replicas - nb_retired:] = np.nan
+        try:
+            voted = self.gar.aggregate(jnp.asarray(probe), key=self._vote_key)
+        except Exception:
+            return False
+        return bool(np.isfinite(np.asarray(voted)).all())
+
+    def swap_replicas(self, replicas, step=None):
         """Hot weight swap: replace the replica parameter stack in place.
 
         The new replicas must match the serving topology (same count, same
         treedef, same leaf shapes/dtypes) so every already-compiled bucket
         executable keeps serving — a swap costs one host->device transfer
-        and ZERO recompiles.  The stacked-pytree assignment is an atomic
+        and ZERO recompiles.  The live-tuple assignment is an atomic
         reference swap: an in-flight forward finishes on the old stack, the
-        next dispatch reads the new one.  Used by the serve CLI's hot
-        restore (SIGHUP) after custody verification (docs/security.md).
+        next dispatch reads the new one (and reports the new ``step`` as
+        its ``weights_step`` — never a torn pairing).  The active-replica
+        mask survives the swap.  Used by the checkpoint watcher
+        (``serve/weights.py``) and the serve CLI's SIGHUP hot restore after
+        custody verification (docs/security.md).
         """
         if len(replicas) != self.nb_replicas:
             raise UserException(
@@ -209,10 +327,8 @@ class InferenceEngine:
                 "(the vote rule and compiled forwards are sized R=%d)"
                 % (len(replicas), self.nb_replicas, self.nb_replicas)
             )
-        fresh = jax.device_put(jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *replicas
-        ))
-        old = jax.tree_util.tree_leaves(self._params)
+        fresh = self._stack(replicas)
+        old = jax.tree_util.tree_leaves(self._live[0])
         new = jax.tree_util.tree_leaves(fresh)
         if len(old) != len(new) or any(
             (a.shape, a.dtype) != (b.shape, b.dtype) for a, b in zip(old, new)
@@ -221,7 +337,8 @@ class InferenceEngine:
                 "swap_replicas: the new checkpoints do not match the serving "
                 "topology (leaf shape/dtype mismatch) — restart to change it"
             )
-        self._params = fresh
+        with self._live_lock:
+            self._live = (fresh, self._live[1], step)
         return self.compile_count
 
     @property
@@ -235,10 +352,11 @@ class InferenceEngine:
     def warmup(self):
         """Compile every ladder bucket up front (zeros input), so the first
         real request never pays a compile.  Returns the compile count."""
+        stack, active, _ = self._live
         for bucket in self.buckets:
             pad = jnp.zeros((bucket,) + self.sample_shape, jnp.float32)
             jax.block_until_ready(_quiet_dispatch(
-                self._fn, self._params, pad, jnp.int32(bucket), self._vote_key
+                self._fn, stack, pad, jnp.int32(bucket), self._vote_key, active
             ))
         info(
             "Inference warmup: %d bucket(s) %r compiled, %d replica(s), vote=%s"
@@ -247,7 +365,8 @@ class InferenceEngine:
         )
         return self.compile_count
 
-    def _run_bucket(self, rows):
+    def _run_bucket(self, rows, live):
+        stack, active, _ = live
         bucket = choose_bucket(rows.shape[0], self.buckets)
         # Pad HOST-side: one array and one host->device transfer per call,
         # instead of a device zeros allocation plus a scatter update — the
@@ -260,8 +379,8 @@ class InferenceEngine:
         with trace.span("serve.jit", cat="serve", bucket=int(bucket),
                         rows=int(rows.shape[0])):
             preds, logits, disagreement = _quiet_dispatch(
-                self._fn, self._params, jnp.asarray(pad), jnp.int32(rows.shape[0]),
-                self._vote_key,
+                self._fn, stack, jnp.asarray(pad), jnp.int32(rows.shape[0]),
+                self._vote_key, active,
             )
             n = rows.shape[0]
             return (
@@ -274,9 +393,11 @@ class InferenceEngine:
     def predict(self, x):
         """Serve a batch: ``(n, *sample_shape)`` -> dict with ``predictions``
         (n,) int labels, ``logits`` (n, classes) voted logits,
-        ``disagreement`` (R,) per-replica scores (rows-weighted over chunks),
-        and ``bucket`` (the last bucket used).  Requests beyond the ladder
-        top are chunked at the largest bucket.
+        ``disagreement`` (R,) per-replica scores (rows-weighted over chunks;
+        NaN = retired replica), ``bucket`` (the last bucket used),
+        ``weights_step`` (the checkpoint step this batch served from) and
+        ``active_replicas``.  Requests beyond the ladder top are chunked at
+        the largest bucket.
         """
         x = np.asarray(x, np.float32)
         if x.ndim == len(self.sample_shape):  # single sample convenience
@@ -288,20 +409,28 @@ class InferenceEngine:
             )
         if x.shape[0] == 0:
             raise UserException("Empty inference batch")
+        # ONE read of the live tuple per predict: every chunk of this batch
+        # serves the same weights, and the reported weights_step can never
+        # pair old weights with a new step tag (the hot-swap atomicity the
+        # load benchmark's wrong-weight check leans on).
+        live = self._live
         top = self.buckets[-1]
         preds, logits, scores, weights, bucket = [], [], [], [], None
         for start in range(0, x.shape[0], top):
             chunk = x[start:start + top]
-            p, l, d, bucket = self._run_bucket(chunk)
+            p, l, d, bucket = self._run_bucket(chunk, live)
             preds.append(p)
             logits.append(l)
             scores.append(d)
             weights.append(chunk.shape[0])
         total = float(sum(weights))
         disagreement = sum(s * (w / total) for s, w in zip(scores, weights))
+        active = np.asarray(live[1])
         return {
             "predictions": np.concatenate(preds),
             "logits": np.concatenate(logits),
             "disagreement": np.asarray(disagreement),
             "bucket": bucket,
+            "weights_step": live[2],
+            "active_replicas": [int(i) for i in np.nonzero(active)[0]],
         }

@@ -1,35 +1,146 @@
 """Serving latency/throughput profile: per bucket size and replica count.
 
 Measures the compiled inference path (``serve/engine.InferenceEngine``)
-exactly as the server drives it: padded bucket-shaped batches through the
-R-way replicated robust vote.  For every (bucket, replicas) cell it reports
-compile time (one-off), p50/p95/p99 per-call latency (obs.perf
+exactly as the scheduler drives it: padded bucket-shaped batches through
+the R-way replicated robust vote.  For every (bucket, replicas) cell it
+reports compile time (one-off), p50/p95/p99 per-call latency (obs.perf
 .LatencyHistogram over ``--reps`` timed calls) and rows/s throughput —
-the capacity-planning numbers behind the batcher's deadline/bucket knobs
+the capacity-planning numbers behind the ladder/lane knobs
 (docs/serving.md).
+
+v2 additionally profiles the CONTINUOUS SCHEDULER path per replica count
+(``serve/continuous.py``): ``--clients`` closed-loop clients submit
+``--request-rows``-row requests through a :class:`ContinuousBatcher` over
+the warmed engine, and the cell reports request-level p50/p95/p99,
+achieved requests/s and the mean dispatched-batch occupancy — what a
+client actually sees once batching is emergent (in-flight time) instead of
+imposed (the retired deadline batcher).
 
 Usage::
 
     python benchmarks/serve_latency.py [--experiment digits]
         [--buckets 1,8,64] [--replicas 1,3,5] [--gar median] [--reps 30]
-        [--output profile.json]
+        [--clients 8] [--sched-requests 120] [--output profile.json]
 
 Prints one human table row and one machine-readable JSON line per cell
-(schema ``aggregathor.serve.latency-profile.v1``); ``--output`` additionally
-writes the whole profile as one JSON document.
+(schema ``aggregathor.serve.latency-profile.v2``); ``--output``
+additionally writes the whole profile as one JSON document (validated by
+``validate``/``load`` below — the round-trip the smoke and tests assert).
 """
 
 import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCHEMA = "aggregathor.serve.latency-profile.v1"
+SCHEMA = "aggregathor.serve.latency-profile.v2"
+
+#: keys every engine cell carries
+CELL_KEYS = (
+    "bucket", "replicas", "gar", "ladder_compile_s", "p50_ms", "p95_ms",
+    "p99_ms", "rows_per_s", "reps",
+)
+
+#: keys every scheduler cell carries
+SCHED_KEYS = (
+    "replicas", "gar", "clients", "request_rows", "requests", "p50_ms",
+    "p95_ms", "p99_ms", "req_per_s", "batches", "mean_occupancy",
+    "compile_count", "nb_buckets",
+)
+
+
+def validate(doc):
+    """Schema check for round-tripping consumers (the smoke script and
+    tests/test_serve.py)."""
+    if doc.get("schema") != SCHEMA:
+        raise ValueError("not a %s document" % SCHEMA)
+    for key in ("cells", "scheduler"):
+        if key not in doc or not isinstance(doc[key], list):
+            raise ValueError("missing list %r" % key)
+    if not doc["cells"]:
+        raise ValueError("no engine cells")
+    for cell in doc["cells"]:
+        for key in CELL_KEYS:
+            if key not in cell:
+                raise ValueError("cell missing %r" % key)
+    for cell in doc["scheduler"]:
+        for key in SCHED_KEYS:
+            if key not in cell:
+                raise ValueError("scheduler cell missing %r" % key)
+        if cell["compile_count"] > cell["nb_buckets"]:
+            raise ValueError(
+                "scheduler cell recompiled: %d executables for %d buckets"
+                % (cell["compile_count"], cell["nb_buckets"])
+            )
+    return doc
+
+
+def load(path):
+    with open(path) as fd:
+        return validate(json.load(fd))
+
+
+def profile_scheduler(engine, clients, request_rows, nb_requests, rng):
+    """Closed-loop clients through a ContinuousBatcher over ``engine``;
+    returns the scheduler-path numbers (request tail, req/s, occupancy)."""
+    from aggregathor_tpu.obs import LatencyHistogram
+    from aggregathor_tpu.serve import ContinuousBatcher
+
+    request_rows = max(1, min(request_rows, engine.buckets[-1]))
+    hist = LatencyHistogram()
+    occupancies = []
+    lock = threading.Lock()
+
+    def on_batch(rows, requests, latency_s, output):
+        with lock:
+            occupancies.append(rows / output["bucket"])
+
+    batcher = ContinuousBatcher(
+        engine.predict, buckets=engine.buckets,
+        queue_bound=max(64, clients * request_rows), nb_lanes=1,
+        on_batch=on_batch,
+    )
+    x = rng.random((request_rows,) + engine.sample_shape, np.float32)
+    share = max(1, nb_requests // clients)
+
+    def client():
+        for _ in range(share):
+            t0 = time.perf_counter()
+            batcher.submit(x).wait(120.0)
+            hist.record(time.perf_counter() - t0)
+
+    try:
+        started = time.perf_counter()
+        threads = [threading.Thread(target=client) for _ in range(clients)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        elapsed = time.perf_counter() - started
+    finally:
+        batcher.close()
+    tail = hist.percentiles()
+    with lock:
+        mean_occupancy = float(np.mean(occupancies)) if occupancies else 0.0
+    return {
+        "clients": clients,
+        "request_rows": request_rows,
+        "requests": hist.count,
+        "p50_ms": round(tail["p50"] * 1e3, 4),
+        "p95_ms": round(tail["p95"] * 1e3, 4),
+        "p99_ms": round(tail["p99"] * 1e3, 4),
+        "req_per_s": round(hist.count / max(elapsed, 1e-9), 2),
+        "batches": batcher.batch_count,
+        "mean_occupancy": round(mean_occupancy, 4),
+        "compile_count": engine.compile_count,
+        "nb_buckets": len(engine.buckets),
+    }
 
 
 def build_parser():
@@ -40,6 +151,12 @@ def build_parser():
     parser.add_argument("--replicas", default="1,3", help="comma-separated replica counts")
     parser.add_argument("--gar", default="median", help="vote rule for R > 1 (gars registry)")
     parser.add_argument("--reps", type=int, default=30, help="timed calls per cell")
+    parser.add_argument("--clients", type=int, default=8,
+                        help="closed-loop clients for the scheduler cells")
+    parser.add_argument("--request-rows", type=int, default=1,
+                        help="rows per scheduler request")
+    parser.add_argument("--sched-requests", type=int, default=120,
+                        help="total scheduler requests per replica count (0 = skip)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--output", default=None, metavar="JSON", help="write the full profile here")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
@@ -67,7 +184,7 @@ def main(argv=None):
     rng = np.random.default_rng(args.seed)
 
     platform = jax.devices()[0].platform
-    cells = []
+    cells, sched_cells = [], []
     print("%-8s %-4s %-8s %14s %10s %10s %10s %12s"
           % ("bucket", "R", "vote", "ladder_comp_s", "p50_ms", "p95_ms", "p99_ms", "rows/s"))
     for nb_replicas in replica_counts:
@@ -113,9 +230,31 @@ def main(argv=None):
                   % (bucket, nb_replicas, cell["gar"] or "-", compile_s,
                      cell["p50_ms"], cell["p95_ms"], cell["p99_ms"], throughput))
             print(json.dumps(cell))
+        if args.sched_requests > 0:
+            sched = profile_scheduler(
+                engine, args.clients, args.request_rows, args.sched_requests,
+                rng,
+            )
+            sched.update({
+                "schema": SCHEMA,
+                "experiment": args.experiment,
+                "platform": platform,
+                "replicas": nb_replicas,
+                "gar": args.gar if nb_replicas > 1 else None,
+            })
+            sched_cells.append(sched)
+            print("scheduler R=%d: %d clients x %d-row requests — p50 %.3f ms "
+                  "p99 %.3f ms, %.1f req/s, %d batches (occupancy %.2f)"
+                  % (nb_replicas, sched["clients"], sched["request_rows"],
+                     sched["p50_ms"], sched["p99_ms"], sched["req_per_s"],
+                     sched["batches"], sched["mean_occupancy"]))
+            print(json.dumps(sched))
     if args.output:
         with open(args.output, "w") as fd:
-            json.dump({"schema": SCHEMA, "cells": cells}, fd, indent=1)
+            json.dump(
+                {"schema": SCHEMA, "cells": cells, "scheduler": sched_cells},
+                fd, indent=1,
+            )
     return 0
 
 

@@ -1,0 +1,465 @@
+"""Closed-loop serving load benchmark: sustained concurrency, one poisoned
+replica, mid-run hot weight swaps — judged as an SLO.
+
+The serve/ v2 acceptance harness (docs/serving.md).  One process plays the
+whole production story end to end:
+
+1. **train**: a short real digits run whose parameter snapshots at three
+   increasing steps become the checkpoint stream a concurrently-training
+   run would produce (the first is served at startup; the other two land
+   on disk MID-LOAD and reach the pool through the checkpoint watcher,
+   ``serve/weights.py``);
+2. **serve**: an R-replica :class:`InferenceEngine` under the median vote
+   with ONE POISONED replica (``chaos/replica_faults.py``), fronted by the
+   asyncio server + continuous batcher (``--lanes``, optionally
+   ``--autoscale``), warmed over the bucket ladder;
+3. **load**: ``--clients`` closed-loop HTTP clients fire
+   ``--request-rows``-row ``/predict`` requests for ``--duration`` seconds
+   while the main thread drops the two newer snapshots into the watched
+   directory — every response is checked for status, latency, the
+   ``weights_step`` it served from, and prediction agreement with the
+   CLEAN baseline **of that same step** (the vote must mask the poisoned
+   replica at every step, across every swap);
+4. **judge**: hard invariants (zero dropped requests, >= ``--min-swaps``
+   swaps applied, zero wrong-weight responses — per-client step sequences
+   monotone over the known snapshot steps — zero vote mismatches, compile
+   count == ladder length) plus the latency SLO (p99 < ``--deadline-ms``
+   at >= ``--target-rps`` achieved req/s), and the PR-8 sentinel verdict
+   against a checked-in baseline (``--slo benchmarks/slo_serve_cpu.json``;
+   seed one with ``--slo-capture``): ``serve_req_per_s`` higher-is-better,
+   ``serve_p50_ms``/``serve_p99_ms`` lower-is-better.
+
+Emits one ``aggregathor.serve.load.v1`` document (``validate``/``load``
+below are the round-trip the smoke and tests assert); exit status is the
+overall verdict.
+
+Example (CPU, <60 s)::
+
+    python benchmarks/serve_load.py --duration 8 --clients 6 \
+        --slo benchmarks/slo_serve_cpu.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "aggregathor.serve.load.v1"
+
+
+def validate(doc):
+    """Schema check for round-tripping consumers (the smoke script and
+    tests/test_serve.py's checked-in-baseline test)."""
+    if doc.get("schema") != SCHEMA:
+        raise ValueError("not a %s document" % SCHEMA)
+    for key in ("config", "traffic", "swaps", "vote", "compile", "verdict"):
+        if key not in doc:
+            raise ValueError("missing %r" % key)
+    traffic = doc["traffic"]
+    for key in ("requests", "ok", "sheds", "dropped", "req_per_s", "p50_ms",
+                "p95_ms", "p99_ms"):
+        if key not in traffic:
+            raise ValueError("traffic missing %r" % key)
+    swaps = doc["swaps"]
+    for key in ("applied", "steps", "wrong_weight_responses", "monotonic"):
+        if key not in swaps:
+            raise ValueError("swaps missing %r" % key)
+    vote = doc["vote"]
+    for key in ("poisoned_replica", "mismatches", "masked"):
+        if key not in vote:
+            raise ValueError("vote missing %r" % key)
+    for key in ("count", "nb_buckets", "zero_recompiles"):
+        if key not in doc["compile"]:
+            raise ValueError("compile missing %r" % key)
+    verdict = doc["verdict"]
+    for key in ("zero_dropped", "swaps_ok", "zero_wrong_weight", "masked",
+                "zero_recompiles", "latency_ok", "pass"):
+        if not isinstance(verdict.get(key), bool):
+            raise ValueError("verdict missing bool %r" % key)
+    return doc
+
+
+def load(path):
+    with open(path) as fd:
+        return validate(json.load(fd))
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--experiment", default="digits")
+    parser.add_argument("--experiment-args", nargs="*",
+                        default=["batch-size:16"])
+    parser.add_argument("--train-steps", type=int, default=60,
+                        help="in-process training steps (snapshots at 1/3, "
+                             "2/3 and the end)")
+    parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--poison", default="nan", metavar="MODE[=V]",
+                        help="replica fault injected on the LAST replica "
+                             "(chaos/replica_faults.py; 'none' disables)")
+    parser.add_argument("--gar", default="median", help="vote rule")
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="bucket ladder top")
+    parser.add_argument("--lanes", type=int, default=2)
+    parser.add_argument("--max-lanes", type=int, default=4)
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run the pool autoscaler during the load")
+    parser.add_argument("--queue-bound", type=int, default=512)
+    parser.add_argument("--clients", type=int, default=6,
+                        help="closed-loop HTTP clients")
+    parser.add_argument("--request-rows", type=int, default=4)
+    parser.add_argument("--duration", type=float, default=8.0,
+                        help="load phase seconds (swaps land at 1/3 and 2/3)")
+    parser.add_argument("--min-swaps", type=int, default=2,
+                        help="hard floor on mid-run weight swaps applied")
+    parser.add_argument("--deadline-ms", type=float, default=500.0,
+                        help="the p99 SLO deadline (the default carries real "
+                             "headroom on this 1-core box, whose tail swings "
+                             "~3x run-to-run; a recompile-per-request class "
+                             "bug still blows through it by an order of "
+                             "magnitude)")
+    parser.add_argument("--target-rps", type=float, default=20.0,
+                        help="achieved req/s floor for the latency verdict")
+    parser.add_argument("--slo", default=None, metavar="BASELINE",
+                        help="judge serve_req_per_s / serve_p99_ms through "
+                             "the sentinel against this baseline document")
+    parser.add_argument("--slo-capture", default=None, metavar="BASELINE",
+                        help="seed the baseline from this run instead")
+    parser.add_argument("--slo-tolerance", type=float, default=0.5,
+                        help="base relative tolerance written into a captured "
+                             "baseline: req/s may drop by this fraction "
+                             "(capped at 0.9 — a 'higher' bound of "
+                             "base*(1-tol) must stay positive), latency "
+                             "bounds get 4x of it (this 1-core box's tail "
+                             "swings ~3x run-to-run; the sentinel's job here "
+                             "is the order-of-magnitude regression)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default=None, help="write the JSON here")
+    parser.add_argument("--workdir", default=None,
+                        help="checkpoint directory (default: a fresh tempdir)")
+    parser.add_argument("--platform", default=None)
+    return parser
+
+
+def train_with_snapshots(experiment, nb_steps, lr, seed):
+    """Short real training run; returns [(step, host TrainState)] at
+    1/3, 2/3 and the final step."""
+    import jax
+
+    from aggregathor_tpu import gars
+    from aggregathor_tpu.core import build_optimizer, build_schedule
+    from aggregathor_tpu.parallel import RobustEngine, make_mesh
+
+    n = 4
+    gar = gars.instantiate("average", n, 0)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:%s" % lr]))
+    engine = RobustEngine(make_mesh(nb_workers=1), gar, n)
+    step_fn = engine.build_step(experiment.loss, tx)
+    state = engine.init_state(experiment.init(jax.random.PRNGKey(seed)), tx,
+                              seed=seed + 1)
+    it = experiment.make_train_iterator(n, seed=seed + 2)
+    marks = sorted({max(1, nb_steps // 3), max(2, (2 * nb_steps) // 3), nb_steps})
+    snapshots = []
+    for s in range(nb_steps):
+        state, _ = step_fn(state, engine.shard_batch(next(it)))
+        if s + 1 in marks:
+            snapshots.append((s + 1, jax.device_get(state)))
+    return snapshots
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from aggregathor_tpu import gars, models
+    from aggregathor_tpu.chaos.replica_faults import corrupt_params, parse_poison
+    from aggregathor_tpu.core import build_optimizer, build_schedule
+    from aggregathor_tpu.obs import Checkpoints, LatencyHistogram
+    from aggregathor_tpu.obs import slo as obs_slo
+    from aggregathor_tpu.serve import (
+        AutoscaleConfig,
+        CheckpointWatcher,
+        InferenceEngine,
+        InferenceServer,
+        PoolAutoscaler,
+    )
+    from aggregathor_tpu.serve.engine import restore_params
+
+    poison = None
+    if args.poison and args.poison != "none":
+        _, mode, value = parse_poison("0:%s" % args.poison)
+        poison = (args.replicas - 1, mode, value)
+
+    experiment = models.instantiate(args.experiment, args.experiment_args)
+    tx = build_optimizer("sgd", build_schedule(
+        "fixed", ["initial-rate:%s" % args.learning_rate]))
+
+    # ---- phase 1: train, hold the snapshot stream in memory -------------
+    t0 = time.perf_counter()
+    snapshots = train_with_snapshots(
+        experiment, args.train_steps, args.learning_rate, args.seed
+    )
+    steps = [step for step, _ in snapshots]
+    print("trained %d step(s) in %.1fs; snapshot stream: %r"
+          % (args.train_steps, time.perf_counter() - t0, steps))
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="serve_load_")
+    checkpoints = Checkpoints(workdir)
+    checkpoints.save(snapshots[0][1], step=snapshots[0][0])
+
+    # ---- phase 2: serve the first snapshot with a poisoned pool ---------
+    def replicas_at(step):
+        params, at = restore_params(experiment, workdir, tx, step=step,
+                                    seed=args.seed)
+        replicas = [params] * args.replicas
+        if poison is not None:
+            index, mode, value = poison
+            replicas[index] = corrupt_params(params, mode, value,
+                                             seed=args.seed + 31 * index)
+        return replicas, at
+
+    replicas, served_step = replicas_at(steps[0])
+    vote = gars.instantiate(args.gar, args.replicas, (args.replicas - 1) // 2)
+    engine = InferenceEngine(
+        experiment, replicas, gar=vote, max_batch=args.max_batch,
+        seed=args.seed, weights_step=served_step,
+    )
+    engine.warmup()
+    nb_buckets = len(engine.buckets)
+    server = InferenceServer(
+        engine, port=0, queue_bound=args.queue_bound,
+        lanes=args.lanes, max_lanes=args.max_lanes,
+    )
+
+    def reload_step(step):
+        fresh, at = replicas_at(step)
+        engine.swap_replicas(fresh, step=at)
+
+    watcher = CheckpointWatcher(
+        checkpoints.steps, reload_step, served_step=served_step,
+        interval_s=0.2,
+    )
+    autoscaler = None
+    if args.autoscale:
+        autoscaler = PoolAutoscaler(server, AutoscaleConfig(
+            ["interval:0.25", "cooldown:1", "down-patience:8"]
+        ))
+
+    # The clean per-step baselines every response is judged against: with
+    # identical clean replicas the median vote must EQUAL the clean model
+    # at the step the response reports — across every swap.
+    rng = np.random.default_rng(args.seed)
+    x_eval = np.asarray(experiment.dataset.x_test, np.float32)
+    probe = x_eval[rng.choice(len(x_eval), size=args.request_rows,
+                              replace=False)]
+    baselines = {}
+    for step, state in snapshots:
+        clean = InferenceEngine(experiment, [jax.device_get(state).params],
+                                max_batch=args.max_batch)
+        baselines[step] = [int(p) for p in clean.predict(probe)["predictions"]]
+
+    host, port = server.serve_background()
+    watcher.start()
+    if autoscaler is not None:
+        autoscaler.start()
+    base = "http://%s:%d" % (host, port)
+    body = json.dumps({"inputs": probe.tolist()}).encode()
+
+    # ---- phase 3: closed-loop load with mid-run swaps -------------------
+    hist = LatencyHistogram(capacity=4096)
+    lock = threading.Lock()
+    counts = {"ok": 0, "shed": 0, "dropped": 0}
+    wrong_weight = []
+    mismatches = []
+    per_client_steps = [[] for _ in range(args.clients)]
+    stop_at = time.monotonic() + args.duration
+
+    def client(index):
+        while time.monotonic() < stop_at:
+            started = time.perf_counter()
+            try:
+                req = urllib.request.Request(base + "/predict", data=body)
+                with urllib.request.urlopen(req, timeout=30) as response:
+                    out = json.loads(response.read())
+                    code = response.status
+            except urllib.error.HTTPError as exc:
+                code, out = exc.code, {}
+            except Exception:
+                code, out = -1, {}
+            elapsed = time.perf_counter() - started
+            with lock:
+                if code == 200:
+                    counts["ok"] += 1
+                    hist.record(elapsed)
+                    step = out.get("weights_step")
+                    per_client_steps[index].append(step)
+                    expected = baselines.get(step)
+                    if expected is None:
+                        wrong_weight.append(step)
+                    elif out.get("predictions") != expected:
+                        mismatches.append(step)
+                elif code == 429:
+                    counts["shed"] += 1
+                else:
+                    counts["dropped"] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.clients)]
+    started = time.perf_counter()
+    for thread in threads:
+        thread.start()
+    # the swap schedule: the two newer snapshots land at 1/3 and 2/3.
+    # After each save, wait (bounded) for the watcher to OBSERVE it before
+    # the next lands — a real training run spaces snapshots minutes apart,
+    # and on a saturated 1-core box the watcher thread can otherwise be
+    # starved clean past an intermediate step (one 20->60 swap instead of
+    # two), which is a scheduling artifact, not a pipeline property.
+    for fraction, (step, state) in zip((1 / 3, 2 / 3), snapshots[1:]):
+        delay = started + fraction * args.duration - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        checkpoints.save(state, step=step)
+        print("snapshot step %d landed at t=%.1fs"
+              % (step, time.perf_counter() - started))
+        observe_by = time.monotonic() + args.duration / 3
+        while watcher.served_step != step and time.monotonic() < observe_by:
+            time.sleep(0.05)
+    for thread in threads:
+        thread.join()
+    elapsed = time.perf_counter() - started
+    # one last poll so a snapshot landing in the final instants still swaps
+    watcher.check_once()
+    final_step = watcher.served_step
+    # read the swap counter BEFORE close() unregisters the watcher's gauges
+    families = {f.name: f for f in server.registry.families()}
+    swaps_total = families.get("serve_weight_swaps_total")
+    swaps_applied = int(swaps_total.value) if swaps_total is not None else 0
+    if autoscaler is not None:
+        autoscaler.close()
+    watcher.close()
+    compile_count = engine.compile_count
+    server.shutdown_all()
+
+    # ---- phase 4: judge --------------------------------------------------
+    tail = hist.percentiles() or {"p50": float("inf"), "p95": float("inf"),
+                                  "p99": float("inf")}
+    req_per_s = counts["ok"] / max(elapsed, 1e-9)
+    monotonic = all(
+        all(a <= b for a, b in zip(seq, seq[1:]))
+        for seq in per_client_steps
+    )
+    observed_steps = sorted({s for seq in per_client_steps for s in seq})
+    verdict = {
+        "zero_dropped": counts["dropped"] == 0,
+        "swaps_ok": swaps_applied >= args.min_swaps
+        and final_step == steps[-1],
+        "zero_wrong_weight": not wrong_weight and monotonic,
+        "masked": not mismatches,
+        "zero_recompiles": compile_count == nb_buckets,
+        "latency_ok": tail["p99"] * 1e3 < args.deadline_ms
+        and req_per_s >= args.target_rps,
+    }
+    verdict["pass"] = all(verdict.values())
+
+    current = {
+        "serve_req_per_s": round(req_per_s, 2),
+        "serve_p50_ms": round(tail["p50"] * 1e3, 3),
+        "serve_p99_ms": round(tail["p99"] * 1e3, 3),
+    }
+    slo_section = None
+    if args.slo_capture:
+        tolerances = {
+            "serve_req_per_s": min(args.slo_tolerance, 0.9),
+            "serve_p50_ms": args.slo_tolerance * 4.0,
+            "serve_p99_ms": args.slo_tolerance * 4.0,
+        }
+        obs_slo.capture(args.slo_capture, current, run_id="serve_load",
+                        tolerances=tolerances)
+        slo_section = {"captured": args.slo_capture, "metrics": current}
+        print("SLO baseline captured to %s: %r" % (args.slo_capture, current))
+    elif args.slo:
+        sentinel = obs_slo.Sentinel(args.slo)
+        slo_section = sentinel.verdict(current, run_id="serve_load")
+        print(obs_slo.describe_verdict(slo_section))
+        verdict["pass"] = verdict["pass"] and slo_section["verdict"] == "PASS"
+
+    doc = {
+        "schema": SCHEMA,
+        "config": {
+            "experiment": args.experiment,
+            "replicas": args.replicas,
+            "poison": args.poison,
+            "gar": args.gar,
+            "lanes": args.lanes,
+            "max_lanes": args.max_lanes,
+            "autoscale": bool(args.autoscale),
+            "clients": args.clients,
+            "request_rows": args.request_rows,
+            "duration_s": args.duration,
+            "deadline_ms": args.deadline_ms,
+            "target_rps": args.target_rps,
+            "snapshot_steps": steps,
+        },
+        "traffic": {
+            "requests": counts["ok"] + counts["shed"] + counts["dropped"],
+            "ok": counts["ok"],
+            "sheds": counts["shed"],
+            "dropped": counts["dropped"],
+            "req_per_s": round(req_per_s, 2),
+            "p50_ms": round(tail["p50"] * 1e3, 3),
+            "p95_ms": round(tail["p95"] * 1e3, 3),
+            "p99_ms": round(tail["p99"] * 1e3, 3),
+        },
+        "swaps": {
+            "applied": swaps_applied,
+            "steps": observed_steps,
+            "final_step": final_step,
+            "wrong_weight_responses": len(wrong_weight),
+            "monotonic": monotonic,
+        },
+        "vote": {
+            "poisoned_replica": poison[0] if poison else None,
+            "mismatches": len(mismatches),
+            "masked": not mismatches,
+        },
+        "compile": {
+            "count": compile_count,
+            "nb_buckets": nb_buckets,
+            "zero_recompiles": compile_count == nb_buckets,
+        },
+        "slo": slo_section,
+        "verdict": verdict,
+    }
+    validate(doc)
+    print("serve load: %d ok (%.1f req/s, p99 %.1f ms), %d shed, %d dropped; "
+          "%d swap(s) over steps %r; wrong-weight %d; vote mismatches %d; "
+          "compiles %d/%d — %s"
+          % (counts["ok"], req_per_s, tail["p99"] * 1e3, counts["shed"],
+             counts["dropped"], swaps_applied, observed_steps,
+             len(wrong_weight), len(mismatches), compile_count, nb_buckets,
+             "PASS" if verdict["pass"] else "FAIL"))
+    if args.out:
+        with open(args.out, "w") as fd:
+            json.dump(doc, fd, indent=1)
+            fd.write("\n")
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,7 +101,7 @@ from aggregathor_tpu.serve import InferenceEngine, InferenceServer
 exp = models.instantiate("digits", ["batch-size:16"])
 params = exp.init(jax.random.PRNGKey(0))
 engine = InferenceEngine(exp, [params], max_batch=16)
-server = InferenceServer(engine, port=0, max_latency_s=0.005)
+server = InferenceServer(engine, port=0)
 host, port = server.serve_background()
 base = "http://%s:%d" % (host, port)
 try:

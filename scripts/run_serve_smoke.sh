@@ -1,14 +1,25 @@
 #!/usr/bin/env bash
-# Serving smoke on CPU (<60 s): train a tiny digits model through the real
-# CLI runner, serve it with 3 replicas (one NaN-poisoned via the chaos
-# tie-in) under the median vote, fire concurrent clients, and assert
-# /healthz, a finite p95, a nonzero shed count under burst, and
-# fault-masked predictions (served == clean baseline).  CI-sized version of
-# docs/serving.md.
+# Serving smoke on CPU (<60 s): the serve/ v2 story end to end through the
+# real CLIs (docs/serving.md).
+#
+#   1. train a tiny digits model -> checkpoint stream
+#   2. serve it: 3 replicas (one NaN-poisoned), median vote, asyncio front
+#      end + continuous batching, --follow weight pipeline, --autoscale
+#   3. burst leg: concurrent clients against a tiny queue bound -> 429s
+#   4. calm leg: fault-masked predictions == clean baseline, /status,
+#      compile_count == nb_buckets (zero steady-state recompiles)
+#   5. swap leg: extend training in the same directory -> the watcher
+#      hot-swaps the newer step in with zero recompiles, live
+#   6. autoscale leg: sustained calm shrinks the lane pool to the floor
+#   7. load leg: benchmarks/serve_load.py closed loop (sustained
+#      concurrency, >=2 mid-run swaps, poisoned replica masked, SLO PASS
+#      against the checked-in baseline).  Second arg "capture" re-seeds
+#      benchmarks/slo_serve_cpu.json instead of judging.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 out="${1:-/tmp/aggregathor_serve_smoke}"
+slo_mode="${2:-check}"   # check | capture
 rm -rf "$out"
 mkdir -p "$out"
 
@@ -21,20 +32,28 @@ JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
   --checkpoint-dir "$out/ckpt" --checkpoint-delta 20 --checkpoint-period -1 \
   --summary-delta -1 --summary-period -1
 
-# ---- 2. serve it: 3 replicas, replica 2 NaN-poisoned, median vote.
-# Tiny queue bound + slow deadline make the burst phase shed deterministically.
+# ---- 2. serve it: v2 stack. 3 replicas, replica 2 NaN-poisoned, median
+# vote, 2 lanes, weight pipeline following the checkpoint dir, autoscaler
+# with a fast calm path (the autoscale leg watches the shrink).  Tiny
+# queue bound + a 150 ms linger window make the burst phase shed
+# deterministically: sub-top batches hold their lane for the window, so
+# the 24-deep burst piles onto the 4-row bound instead of draining as
+# fast as the clients can post (the calm phase's 8-row requests fill the
+# ladder top and never linger).
 JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.serve \
   --experiment digits --experiment-args batch-size:16 \
   --ckpt-dir "$out/ckpt" --replicas 3 --gar median --poison-replica 2:nan \
   --port 0 --ready-file "$out/ready" --summary-dir "$out/sum" \
-  --max-batch 8 --max-latency-ms 100 --queue-bound 4 &
+  --max-batch 8 --queue-bound 4 --lanes 2 --max-lanes 2 --linger-ms 150 \
+  --follow --follow-interval 0.5 \
+  --autoscale --autoscale-args interval:0.25 down-patience:4 cooldown:0.5 &
 server_pid=$!
 trap 'kill "$server_pid" 2>/dev/null || true' EXIT
 
 for _ in $(seq 1 60); do [ -f "$out/ready" ] && break; sleep 1; done
 [ -f "$out/ready" ] || { echo "server never became ready"; exit 1; }
 
-# ---- 3. concurrent clients: burst (sheds) then calm (fault-masked answers)
+# ---- 3+4. burst (sheds) then calm (fault-masked answers + v2 status)
 JAX_PLATFORMS=cpu python - "$out" <<'EOF'
 import json, sys, threading, urllib.error, urllib.request
 
@@ -73,6 +92,7 @@ clean = InferenceEngine(experiment, [params], max_batch=8).predict(x)["predictio
 health = get("/healthz")
 assert health["status"] == "ok", health
 assert health["replicas"] == 3, health
+assert health["weights_step"] == step, health
 
 # burst: 24 concurrent single-row posts against queue bound 4 -> sheds
 codes = []
@@ -95,6 +115,12 @@ assert served["predictions"] == [int(p) for p in clean], (
     "served predictions diverge from the clean baseline: %r vs %r"
     % (served["predictions"], list(clean)))
 assert served["disagreement"][2] is None, served  # NaN replica -> null (inf)
+assert served["weights_step"] == step, served
+assert served["active_replicas"] == [0, 1, 2], served
+
+status = get("/status")
+assert status["weights_step"] == step, status
+assert status["compile_count"] == 4, status  # ladder 1,2,4,8 compiled once
 
 metrics = get("/metrics")
 assert metrics["shed_count"] > 0, metrics
@@ -106,7 +132,56 @@ print("serve smoke OK: step-%s checkpoint, %d sheds under burst, p95=%.1f ms, "
       "poisoned replica masked + flagged" % (step, metrics["shed_count"], p95))
 EOF
 
-# ---- 4. graceful shutdown (SIGTERM must not wedge the serve loop)
+# ---- 5. swap leg: extend the training run -> the watcher swaps live
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 4 --nb-devices 1 \
+  --max-step 60 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/ckpt" --checkpoint-delta 20 --checkpoint-period -1 \
+  --summary-delta -1 --summary-period -1 > /dev/null
+
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import json, sys, time, urllib.request
+
+out = sys.argv[1]
+host, port, _pid = open("%s/ready" % out).read().split()
+base = "http://%s:%s" % (host, port)
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.loads(r.read())
+
+# the watcher polls every 0.5 s: the newer step must swap in live
+deadline = time.monotonic() + 20.0
+status = get("/status")
+while status["weights_step"] != 60 and time.monotonic() < deadline:
+    time.sleep(0.25)
+    status = get("/status")
+assert status["weights_step"] == 60, (
+    "watcher never hot-swapped step 60 (still %r)" % status["weights_step"])
+assert status["compile_count"] == 4, status  # the swap recompiled NOTHING
+
+# ---- 6. autoscale leg: sustained calm shrinks lanes to the floor
+deadline = time.monotonic() + 20.0
+while status["lanes"] != 1 and time.monotonic() < deadline:
+    time.sleep(0.25)
+    status = get("/status")
+assert status["lanes"] == 1, "calm never shrank the lane pool: %r" % status
+
+# a post-swap, post-shrink request still serves (and reports the new step)
+row = [0.0] * 64
+req = urllib.request.Request(
+    base + "/predict", data=json.dumps({"inputs": [row]}).encode(),
+    headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=30) as r:
+    served = json.loads(r.read())
+assert served["weights_step"] == 60, served
+print("swap + autoscale legs OK: weights_step 60 live (0 recompiles), "
+      "lanes shrunk to 1 under calm")
+EOF
+
+# ---- graceful shutdown (SIGTERM must not wedge the event loop)
 kill "$server_pid"
 for _ in $(seq 1 20); do kill -0 "$server_pid" 2>/dev/null || break; sleep 0.5; done
 if kill -0 "$server_pid" 2>/dev/null; then
@@ -114,7 +189,7 @@ if kill -0 "$server_pid" 2>/dev/null; then
 fi
 trap - EXIT
 
-# the summary stream carries the serve events
+# the summary stream carries the serve events (incl. swap + autoscale)
 python - "$out/sum" <<'EOF'
 import json, os, sys
 sum_dir = sys.argv[1]
@@ -123,10 +198,25 @@ events = [json.loads(line)
           for line in open(os.path.join(sum_dir, name))]
 batches = [e for e in events if e.get("event") == "serve_batch"]
 sheds = [e for e in events if e.get("event") == "serve_shed"]
+swaps = [e for e in events if e.get("event") == "serve_weight_swap"]
+scales = [e for e in events if e.get("event") == "serve_autoscale"]
 assert batches, "no serve_batch summary events"
 assert sheds, "no serve_shed summary events"
-print("summary stream OK: %d serve_batch + %d serve_shed event(s)"
-      % (len(batches), len(sheds)))
+assert swaps, "no serve_weight_swap summary events"
+assert scales, "no serve_autoscale summary events"
+print("summary stream OK: %d serve_batch + %d serve_shed + %d "
+      "serve_weight_swap + %d serve_autoscale event(s)"
+      % (len(batches), len(sheds), len(swaps), len(scales)))
 EOF
+
+# ---- 7. load leg: the closed loop, judged against the checked-in SLO
+if [ "$slo_mode" = "capture" ]; then
+  JAX_PLATFORMS=cpu python benchmarks/serve_load.py --duration 5 \
+    --out "$out/load.json" --slo-capture benchmarks/slo_serve_cpu.json
+  echo "serve SLO baseline re-seeded (benchmarks/slo_serve_cpu.json)"
+else
+  JAX_PLATFORMS=cpu python benchmarks/serve_load.py --duration 5 \
+    --out "$out/load.json" --slo benchmarks/slo_serve_cpu.json
+fi
 
 echo "serve smoke PASSED"

@@ -424,15 +424,14 @@ def test_serve_custody_and_hot_swap(tmp_path):
             "--ckpt-dir", str(tmp_path), "--replicas", "2", "--gar", "median",
             "--session-secret", "s3", "--max-batch", "4"]
     args = serve_cli.build_parser().parse_args(argv)
-    replicas, sources, verified = serve_cli.load_replicas(args, experiment)
+    replicas, sources, verified, served_step = serve_cli.load_replicas(args, experiment)
     assert verified is True and len(replicas) == 2
+    assert served_step == 5
 
     engine = InferenceEngine(experiment, replicas, max_batch=4)
     engine.warmup()
     compiles = engine.compile_count
     server = InferenceServer(engine, port=0, custody_verified=verified)
-    # serve_forever must RUN before shutdown_all can join it (BaseServer's
-    # shutdown waits on an event only serve_forever sets)
     server.serve_background()
     try:
         assert server.health_payload()["custody_verified"] is True
@@ -451,7 +450,7 @@ def test_serve_custody_and_hot_swap(tmp_path):
     with pytest.raises(UserException, match="custody manifest"):
         serve_cli.load_replicas(args, experiment)
     args = serve_cli.build_parser().parse_args(argv + ["--allow-unsigned"])
-    _, _, verified = serve_cli.load_replicas(args, experiment)
+    _, _, verified, _ = serve_cli.load_replicas(args, experiment)
     assert verified is False
 
 

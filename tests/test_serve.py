@@ -1,8 +1,13 @@
-"""serve/ tests: bucket ladder, deadline batching, load-shedding, replica
-vote fault-masking, zero-recompile steady state, and the end-to-end
-train -> checkpoint -> HTTP serve round trip on the digits experiment."""
+"""serve/ tests: bucket ladder, replica vote fault-masking, the traced
+active-replica mask + atomic hot weight swap, registry-driven autoscaling
+over a real engine, zero-recompile steady state under ALL serving levers,
+and the end-to-end train -> checkpoint -> HTTP serve round trip on the
+digits experiment.  (Pure scheduler/policy math lives in
+tests/test_serve_sched.py.)"""
 
 import json
+import os
+import sys
 import threading
 import time
 import urllib.error
@@ -15,15 +20,18 @@ import pytest
 from aggregathor_tpu import gars, models
 from aggregathor_tpu.chaos import corrupt_params, parse_poison
 from aggregathor_tpu.obs import LatencyHistogram
+from aggregathor_tpu.obs.metrics import MetricsRegistry
 from aggregathor_tpu.serve import (
+    AutoscaleConfig,
     InferenceEngine,
     InferenceServer,
-    LoadShed,
-    MicroBatcher,
+    PoolAutoscaler,
     bucket_ladder,
     choose_bucket,
 )
 from aggregathor_tpu.utils import UserException
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 # --------------------------------------------------------------------- #
@@ -75,146 +83,6 @@ def test_latency_histogram_small_sample_degrades_to_max():
 
 
 # --------------------------------------------------------------------- #
-# micro-batcher (engine-agnostic: fake runners)
-
-
-def _echo_runner(log=None):
-    def run(rows):
-        if log is not None:
-            log.append(rows.shape[0])
-        return {
-            "predictions": np.arange(rows.shape[0]),
-            "disagreement": np.array([0.0, 0.0]),
-            "bucket": 8,
-        }
-    return run
-
-
-def test_batcher_deadline_flushes_partial_batch():
-    """A lone sub-cap request is dispatched at the deadline, not held for a
-    full batch."""
-    sizes = []
-    batcher = MicroBatcher(_echo_runner(sizes), max_latency_s=0.10, max_batch=8,
-                           queue_bound=64)
-    try:
-        started = time.monotonic()
-        ticket = batcher.submit(np.zeros((2, 4)))
-        result = ticket.wait(5.0)
-        waited = time.monotonic() - started
-        assert sizes == [2]
-        assert list(result["predictions"]) == [0, 1]
-        assert waited >= 0.08, "dispatched before the deadline with no cap pressure"
-        assert waited < 2.0
-    finally:
-        batcher.close()
-
-
-def test_batcher_cap_dispatches_before_deadline():
-    """Reaching max_batch dispatches immediately — a full bucket gains
-    nothing by waiting for a distant deadline."""
-    sizes = []
-    batcher = MicroBatcher(_echo_runner(sizes), max_latency_s=30.0, max_batch=4,
-                           queue_bound=64)
-    try:
-        tickets = [batcher.submit(np.zeros((1, 4))) for _ in range(4)]
-        for ticket in tickets:
-            ticket.wait(5.0)  # would TimeoutError if held until the deadline
-        assert sum(sizes) == 4
-    finally:
-        batcher.close()
-
-
-def test_batcher_splits_results_per_request_with_shared_extras():
-    batcher = MicroBatcher(_echo_runner(), max_latency_s=0.02, max_batch=8,
-                           queue_bound=64)
-    try:
-        t1 = batcher.submit(np.zeros((2, 4)))
-        t2 = batcher.submit(np.zeros((1, 4)))
-        r1, r2 = t1.wait(5.0), t2.wait(5.0)
-        # per-row outputs split by request...
-        assert r1["predictions"].shape == (2,) and r2["predictions"].shape == (1,)
-        # ...shared extras broadcast intact, even when their length could
-        # collide with a row count (disagreement has length 2 here)
-        assert r1["disagreement"].shape == (2,) and r2["disagreement"].shape == (2,)
-        assert r1["bucket"] == r2["bucket"] == 8
-    finally:
-        batcher.close()
-
-
-def test_batcher_load_shed_under_overload():
-    """Once queued rows pass the bound, submit fails fast with LoadShed
-    (429), and the queue drains correctly afterwards."""
-    release = threading.Event()
-    entered = threading.Event()
-
-    def slow_runner(rows):
-        entered.set()
-        release.wait(10.0)
-        return {"predictions": np.arange(rows.shape[0])}
-
-    batcher = MicroBatcher(slow_runner, max_latency_s=0.0, max_batch=4,
-                           queue_bound=4)
-    try:
-        first = batcher.submit(np.zeros((1, 4)))
-        assert entered.wait(5.0)  # dispatcher is now wedged inside the runner
-        held = [batcher.submit(np.zeros((1, 4))) for _ in range(4)]
-        assert batcher.queue_depth == 4
-        with pytest.raises(LoadShed):
-            batcher.submit(np.zeros((1, 4)))
-        assert batcher.shed_count == 1
-        release.set()
-        for ticket in [first] + held:
-            ticket.wait(10.0)
-        assert batcher.queue_depth == 0
-        assert batcher.served_rows == 5
-    finally:
-        release.set()
-        batcher.close()
-
-
-def test_batcher_timeout_cancels_queued_request():
-    """A ticket whose wait times out is REMOVED from the queue: the engine
-    never runs dead work for a caller that already got its 504."""
-    release = threading.Event()
-    entered = threading.Event()
-    sizes = []
-
-    def slow_runner(rows):
-        entered.set()
-        release.wait(10.0)
-        sizes.append(rows.shape[0])
-        return {"predictions": np.arange(rows.shape[0])}
-
-    batcher = MicroBatcher(slow_runner, max_latency_s=0.0, max_batch=4,
-                           queue_bound=8)
-    try:
-        first = batcher.submit(np.zeros((1, 4)))
-        assert entered.wait(5.0)  # dispatcher wedged in the runner
-        doomed = batcher.submit(np.zeros((2, 4)))
-        with pytest.raises(TimeoutError):
-            doomed.wait(0.05)
-        assert batcher.queue_depth == 0  # cancelled rows left the queue
-        survivor = batcher.submit(np.zeros((1, 4)))
-        release.set()
-        first.wait(10.0)
-        survivor.wait(10.0)
-        assert sizes == [1, 1], "cancelled rows were still dispatched"
-    finally:
-        release.set()
-        batcher.close()
-
-
-def test_batcher_rejects_oversized_and_closed():
-    batcher = MicroBatcher(_echo_runner(), max_latency_s=0.0, max_batch=4,
-                           queue_bound=64)
-    with pytest.raises(ValueError):
-        batcher.submit(np.zeros((5, 4)))  # request larger than any batch
-    batcher.close()
-    with pytest.raises(RuntimeError):
-        batcher.submit(np.zeros((1, 4)))
-
-
-# --------------------------------------------------------------------- #
 # replica faults (chaos/replica_faults.py)
 
 
@@ -241,7 +109,7 @@ def test_corrupt_params_modes():
 
 
 # --------------------------------------------------------------------- #
-# inference engine: vote + zero recompiles
+# inference engine: vote + zero recompiles + the two serving levers
 
 _DIGITS = None
 
@@ -328,6 +196,258 @@ def test_engine_validates_shapes_and_gar_arity():
     assert engine.predict(np.zeros((8, 8, 1), np.float32))["predictions"].shape == (1,)
 
 
+def test_engine_active_replica_mask_spends_f_and_stays_compiled():
+    """The pool-scaling lever: retiring a replica excludes it from the vote
+    exactly like a crashed one (disagreement reads NaN, predictions stay at
+    the clean bar), the absorption depth is PROBED per rule, and the mask
+    is a traced operand — zero recompiles at any pool size."""
+    from conftest import assert_zero_recompiles
+
+    exp, params = _digits()
+    x = np.asarray(exp.dataset.x_test[:16], np.float32)
+    clean = InferenceEngine(exp, [params], max_batch=8).predict(x)
+    vote = gars.instantiate("median", 3, 1)
+    engine = InferenceEngine(exp, [params] * 3, gar=vote, max_batch=8)
+    engine.warmup()
+    compiled = len(engine.buckets)
+
+    # the probe: median at R=3 absorbs one NaN row, not two
+    assert engine.vote_absorbs_retired(0)
+    assert engine.vote_absorbs_retired(1)
+    assert not engine.vote_absorbs_retired(2)
+
+    assert engine.set_active_replicas([0, 2]) == [0, 2]
+    served = engine.predict(x)
+    np.testing.assert_array_equal(served["predictions"], clean["predictions"])
+    assert np.isnan(served["disagreement"][1])  # retired: NaN, not suspect
+    assert served["active_replicas"] == [0, 2]
+    with pytest.raises(UserException):
+        engine.set_active_replicas([0])  # two retired: median would poison
+    with pytest.raises(UserException):
+        engine.set_active_replicas([])
+    with pytest.raises(UserException):
+        engine.set_active_replicas([0, 7])
+    # re-admit: full pool again, still the same executables
+    engine.set_active_replicas([0, 1, 2])
+    np.testing.assert_array_equal(
+        engine.predict(x)["predictions"], clean["predictions"]
+    )
+    assert_zero_recompiles(engine, expect=compiled)
+
+    # without a vote there is nothing to absorb a retired replica
+    solo = InferenceEngine(exp, [params], max_batch=4)
+    assert solo.set_active_replicas([0]) == [0]  # the full pool is legal
+    with pytest.raises(UserException):
+        solo.set_active_replicas([])
+    unvoted = InferenceEngine(exp, [params] * 2, max_batch=4)
+    with pytest.raises(UserException):
+        unvoted.set_active_replicas([0])
+    # average never absorbs a NaN row: any retirement refuses
+    averaged = InferenceEngine(
+        exp, [params] * 3, gar=gars.instantiate("average", 3, 1), max_batch=4
+    )
+    assert not averaged.vote_absorbs_retired(1)
+    with pytest.raises(UserException):
+        averaged.set_active_replicas([0, 1])
+
+
+def test_engine_hot_swap_is_atomic_tagged_and_recompile_free():
+    """The weight-pipeline lever: swap_replicas atomically rebinds
+    (params, mask, step) — predictions flip to the new weights, every
+    response reports the step it served from, topology changes refuse, and
+    the compiled ladder is untouched."""
+    from conftest import assert_zero_recompiles
+
+    exp, params = _digits()
+    fresh = exp.init(jax.random.PRNGKey(7))
+    x = np.asarray(exp.dataset.x_test[:8], np.float32)
+    engine = InferenceEngine(exp, [params] * 2, max_batch=8, weights_step=10)
+    engine.warmup()
+    compiled = len(engine.buckets)
+    before = engine.predict(x)
+    assert before["weights_step"] == 10 and engine.weights_step == 10
+
+    engine.set_active_replicas([0, 1])  # no-op mask, must survive the swap
+    engine.swap_replicas([fresh] * 2, step=20)
+    after = engine.predict(x)
+    assert after["weights_step"] == 20 and engine.weights_step == 20
+    expected = InferenceEngine(exp, [fresh], max_batch=8).predict(x)
+    np.testing.assert_array_equal(after["predictions"], expected["predictions"])
+    assert_zero_recompiles(engine, expect=compiled)
+
+    with pytest.raises(UserException):
+        engine.swap_replicas([fresh])  # replica-count change
+    with pytest.raises(UserException):
+        bad = jax.tree_util.tree_map(lambda l: np.zeros((3, 3), np.float32), fresh)
+        engine.swap_replicas([bad] * 2)  # leaf-shape change
+    assert engine.weights_step == 20  # refused swaps left the stack alone
+
+
+def test_engine_live_mutators_are_serialized():
+    """swap_replicas and set_active_replicas are read-modify-writes of the
+    one live tuple and run from different threads in production (watcher
+    vs autoscaler) — both must hold the live lock, or an interleaving
+    silently reverts the other's update (e.g. serving old weights while
+    reporting the new step)."""
+    exp, params = _digits()
+    vote = gars.instantiate("median", 3, 1)
+    engine = InferenceEngine(exp, [params] * 3, gar=vote, max_batch=4,
+                             buckets=(4,), weights_step=1)
+    done = {"swap": False, "mask": False}
+
+    def swap():
+        engine.swap_replicas([params] * 3, step=2)
+        done["swap"] = True
+
+    def mask():
+        engine.set_active_replicas([0, 2])
+        done["mask"] = True
+
+    for name, fn in (("swap", swap), ("mask", mask)):
+        engine._live_lock.acquire()
+        thread = threading.Thread(target=fn, daemon=True)
+        thread.start()
+        thread.join(0.3)
+        assert not done[name], "%s mutated _live without the live lock" % name
+        engine._live_lock.release()
+        thread.join(5.0)
+        assert done[name]
+    # both updates landed: neither clobbered the other
+    assert engine.weights_step == 2
+    assert engine.active_replicas == [0, 2]
+
+
+# --------------------------------------------------------------------- #
+# autoscaler over a REAL engine (policy math in test_serve_sched.py)
+
+
+def _make_server(engine, **kwargs):
+    """An InferenceServer on a PRIVATE registry, scheduler only (no HTTP
+    bind) — what the autoscaler drives."""
+    registry = MetricsRegistry()
+    server = InferenceServer(engine, port=0, registry=registry, **kwargs)
+    return server, registry
+
+
+def test_autoscaler_climbs_lanes_then_retires_then_recovers():
+    """The capacity ladder end to end on a real median pool: sustained
+    pressure first opens lanes, then (at the lane ceiling) retires the
+    most-suspect replica within the f budget; sustained calm re-admits the
+    replica BEFORE dropping lanes.  Zero recompiles throughout."""
+    from conftest import assert_zero_recompiles
+
+    exp, params = _digits()
+    vote = gars.instantiate("median", 3, 1)
+    engine = InferenceEngine(exp, [params] * 3, gar=vote, max_batch=4,
+                             buckets=(4,))
+    engine.warmup()
+    server, registry = _make_server(engine, lanes=1, max_lanes=2)
+    try:
+        config = AutoscaleConfig([
+            "up-patience:1", "down-patience:1", "cooldown:0",
+            "fault-reserve:0",
+        ])
+        scaler = PoolAutoscaler(server, config, registry=registry,
+                                clock=lambda: 0.0)
+        # ladder: (1 lane, 0) -> (2, 0) -> (2, 1 retired); retirement depth
+        # probed against median@R=3 and capped by f - fault_reserve = 1
+        assert [scaler.ladder.rung(i) for i in range(len(scaler.ladder))] == [
+            (1, 0), (2, 0), (2, 1)
+        ]
+        # replica 1 is the flagged one: it must be retired first
+        with server._lock:
+            server._last_disagreement = [0.0, 9.0, 0.0]
+
+        pressure = {"queue_rows": 999.0, "p99_s": None, "shed_rate": 0.0}
+        calm = {"queue_rows": 0.0, "p99_s": None, "shed_rate": 0.0}
+        scaler.sample = lambda now: (
+            sample["queue_rows"], sample["p99_s"], sample["shed_rate"])
+
+        sample = pressure
+        assert scaler.tick(now=1.0) == "expand"
+        assert server.scheduler.nb_lanes == 2
+        assert engine.active_replicas == [0, 1, 2]
+        assert scaler.tick(now=2.0) == "expand"
+        assert engine.active_replicas == [0, 2], "most-suspect not retired"
+        # pinned at the ceiling: pressure keeps demanding, nothing to give
+        assert scaler.tick(now=3.0) is None
+        families = {f.name: f for f in registry.families()}
+        assert families["serve_autoscale_at_ceiling"].value == 1.0
+        sample = calm
+        assert scaler.tick(now=4.0) == "shrink"
+        assert engine.active_replicas == [0, 1, 2], (
+            "redundancy must be restored before lanes drop"
+        )
+        assert server.scheduler.nb_lanes == 2
+        assert scaler.tick(now=5.0) == "shrink"
+        assert server.scheduler.nb_lanes == 1
+        assert scaler.tick(now=6.0) is None  # at the floor
+        assert families["serve_autoscale_at_ceiling"].value == 0.0
+        assert_zero_recompiles(engine, expect=1)
+        scaler.close()
+    finally:
+        server.shutdown_all()
+
+
+def test_autoscaler_stale_p99_reads_as_unmeasured():
+    """The latency reservoir is all-time: with no request completed since
+    the last tick its p99 is a FROZEN reading, not a live signal — sample()
+    must report None (calm-compatible) or one past burst would pin the
+    pool expanded forever on an idle server."""
+    exp, params = _digits()
+    engine = InferenceEngine(exp, [params], max_batch=4, buckets=(4,))
+    server, registry = _make_server(engine, lanes=1, max_lanes=2)
+    try:
+        scaler = PoolAutoscaler(server, AutoscaleConfig([]),
+                                registry=registry, clock=lambda: 0.0)
+        server.latency.record(9.0)  # one terrible request, long ago
+        _, p99, _ = scaler.sample(now=1.0)
+        assert p99 == pytest.approx(9.0)  # fresh observation: real signal
+        _, p99, _ = scaler.sample(now=2.0)
+        assert p99 is None, "a stale reservoir reading was treated as live"
+        server.latency.record(0.01)
+        _, p99, _ = scaler.sample(now=3.0)
+        assert p99 is not None  # traffic resumed: the signal is live again
+        scaler.close()
+    finally:
+        server.shutdown_all()
+
+
+def test_autoscaler_feasibility_floor_blocks_retirement():
+    """fault-reserve keeps declared-f budget for REAL faults: with the
+    whole budget reserved (or a vote that cannot absorb a NaN row) the
+    ladder simply has no retirement rung."""
+    exp, params = _digits()
+    vote = gars.instantiate("median", 3, 1)
+    engine = InferenceEngine(exp, [params] * 3, gar=vote, max_batch=4,
+                             buckets=(4,))
+    server, registry = _make_server(engine, lanes=1, max_lanes=2)
+    try:
+        reserved = PoolAutoscaler(
+            server, AutoscaleConfig(["fault-reserve:1"]), registry=registry,
+            clock=lambda: 0.0,
+        )
+        assert reserved.ladder.rungs == ((1, 0), (2, 0))
+        reserved.close()
+    finally:
+        server.shutdown_all()
+    # average-of-replicas: the probe refuses every retirement depth
+    averaged = InferenceEngine(
+        exp, [params] * 3, gar=gars.instantiate("average", 3, 1),
+        max_batch=4, buckets=(4,),
+    )
+    server, registry = _make_server(averaged, lanes=1, max_lanes=2)
+    try:
+        scaler = PoolAutoscaler(
+            server, AutoscaleConfig(["fault-reserve:0"]), registry=registry,
+            clock=lambda: 0.0,
+        )
+        assert scaler.ladder.rungs == ((1, 0), (2, 0))
+        scaler.close()
+    finally:
+        server.shutdown_all()
+
+
 # --------------------------------------------------------------------- #
 # end to end: train -> checkpoint -> serve over HTTP
 
@@ -352,9 +472,11 @@ def _get(base, path, timeout=10):
 def test_train_checkpoint_serve_round_trip(tmp_path):
     """The full serving story: train digits through the real CLI runner,
     restore the checkpoint through cli.serve's replica loader (one replica
-    poisoned via the chaos tie-in), serve over HTTP, and verify the voted
-    predictions match a clean in-process engine — plus /healthz flags the
-    poisoned replica and /metrics reports the serving gauges."""
+    poisoned via the chaos tie-in), serve over HTTP through the asyncio
+    front end + continuous scheduler, and verify the voted predictions
+    match a clean in-process engine — plus /healthz flags the poisoned
+    replica, /status reports the served weights step, and /metrics reports
+    the serving gauges."""
     from aggregathor_tpu.cli import runner
     from aggregathor_tpu.cli import serve as serve_cli
 
@@ -376,14 +498,19 @@ def test_train_checkpoint_serve_round_trip(tmp_path):
         "--poison-replica", "1:nan", "--max-batch", "8",
     ])
     experiment = models.instantiate("digits", ["batch-size:16"])
-    replicas, sources, custody_verified = serve_cli.load_replicas(args, experiment)
+    replicas, sources, custody_verified, served_step = serve_cli.load_replicas(
+        args, experiment
+    )
     assert len(replicas) == 3 and "poisoned: nan" in sources[1]
     assert custody_verified is None  # no --session-secret: not attempted
+    assert served_step == 30
 
     vote = gars.instantiate("median", 3, 1)
-    engine = InferenceEngine(experiment, replicas, gar=vote, max_batch=8)
+    engine = InferenceEngine(experiment, replicas, gar=vote, max_batch=8,
+                             weights_step=served_step)
     engine.warmup()
-    server = InferenceServer(engine, port=0, max_latency_s=0.005, queue_bound=64)
+    server = InferenceServer(engine, port=0, queue_bound=64, lanes=2,
+                             max_lanes=2, registry=MetricsRegistry())
     host, port = server.serve_background()
     base = "http://%s:%d" % (host, port)
     try:
@@ -396,16 +523,25 @@ def test_train_checkpoint_serve_round_trip(tmp_path):
         assert code == 200
         np.testing.assert_array_equal(np.asarray(out["predictions"]), expected)
         assert out["disagreement"][1] is None  # NaN replica -> null (inf)
+        assert out["weights_step"] == 30
+        assert out["active_replicas"] == [0, 1, 2]
 
         health = _get(base, "/healthz")
         assert health["status"] == "ok"
         assert health["suspect_replicas"] == [1]
         assert health["replicas"] == 3
+        assert health["weights_step"] == 30
+
+        status = _get(base, "/status")
+        assert status["weights_step"] == 30
+        assert status["lanes"] == 2
+        assert status["compile_count"] == len(engine.buckets)
 
         metrics = _get(base, "/metrics")
         for key in ("queue_depth", "batch_count", "served_rows", "shed_count",
                     "latency_ms", "batch_occupancy", "per_replica_disagreement",
-                    "compile_count"):
+                    "compile_count", "lanes", "in_flight", "active_replicas",
+                    "weights_step", "cancelled_count"):
             assert key in metrics, key
         assert metrics["served_rows"] >= 8
         assert metrics["latency_ms"]["p95"] is not None
@@ -413,18 +549,31 @@ def test_train_checkpoint_serve_round_trip(tmp_path):
 
         code, out = _post(base, "/predict", {"inputs": [[1.0, 2.0]]})
         assert code == 400  # malformed input
+        code, out = _post(base, "/predict", {"wrong": []})
+        assert code == 400
     finally:
         server.shutdown_all()
 
 
 def test_server_sheds_under_synthetic_overload():
     """HTTP-level load-shedding: with a tiny queue bound and a wedged
-    engine, concurrent /predict bursts return 429 and the shed count lands
-    in /metrics."""
+    dispatch lane, concurrent /predict bursts return 429 and the shed
+    count lands in /metrics."""
     exp, params = _digits()
     engine = InferenceEngine(exp, [params], max_batch=4, buckets=(4,))
     engine.warmup()
-    server = InferenceServer(engine, port=0, max_latency_s=0.2, queue_bound=2)
+    server = InferenceServer(engine, port=0, queue_bound=2,
+                             registry=MetricsRegistry())
+    # wedge the (single) dispatch lane inside its first batch so the burst
+    # piles onto the 2-row queue bound deterministically
+    release = threading.Event()
+    inner = server.scheduler.runner
+
+    def slow_runner(rows):
+        release.wait(10.0)
+        return inner(rows)
+
+    server.scheduler.runner = slow_runner
     host, port = server.serve_background()
     base = "http://%s:%d" % (host, port)
     try:
@@ -440,6 +589,8 @@ def test_server_sheds_under_synthetic_overload():
         threads = [threading.Thread(target=fire) for _ in range(12)]
         for thread in threads:
             thread.start()
+        time.sleep(0.3)  # let the burst pile up behind the wedged lane
+        release.set()
         for thread in threads:
             thread.join()
         assert set(codes) <= {200, 429}
@@ -448,18 +599,128 @@ def test_server_sheds_under_synthetic_overload():
         metrics = _get(base, "/metrics")
         assert metrics["shed_count"] > 0
     finally:
+        release.set()
+        server.shutdown_all()
+
+
+def test_server_times_out_and_cancels_stuck_requests():
+    """The 504 path: a request whose batch cannot complete inside
+    request_timeout_s is answered 504 and its queued rows are cancelled."""
+    exp, params = _digits()
+    engine = InferenceEngine(exp, [params], max_batch=4, buckets=(4,))
+    engine.warmup()
+    server = InferenceServer(engine, port=0, queue_bound=64,
+                             request_timeout_s=0.3,
+                             registry=MetricsRegistry())
+    release = threading.Event()
+    entered = threading.Event()
+    inner = server.scheduler.runner
+
+    def wedged_runner(rows):
+        entered.set()
+        release.wait(10.0)
+        return inner(rows)
+
+    server.scheduler.runner = wedged_runner
+    host, port = server.serve_background()
+    base = "http://%s:%d" % (host, port)
+    try:
+        x0 = np.zeros((1, 8, 8, 1), np.float32).tolist()
+        wedge = threading.Thread(
+            target=_post, args=(base, "/predict", {"inputs": x0}))
+        wedge.start()
+        assert entered.wait(5.0)
+        code, out = _post(base, "/predict", {"inputs": x0})
+        assert code == 504, out
+        release.set()
+        wedge.join()
+        metrics = _get(base, "/metrics")
+        assert metrics["cancelled_count"] >= 1
+    finally:
+        release.set()
+        server.shutdown_all()
+
+
+def test_refused_oversize_body_closes_the_connection():
+    """A Content-Length over the cap is answered 400 WITHOUT draining the
+    body, so the reply must carry Connection: close — under keep-alive the
+    undrained bytes would be parsed as the next request line."""
+    import socket
+
+    from aggregathor_tpu.serve.frontend import MAX_BODY_BYTES
+
+    exp, params = _digits()
+    engine = InferenceEngine(exp, [params], max_batch=4, buckets=(4,))
+    engine.warmup()
+    server = InferenceServer(engine, port=0, registry=MetricsRegistry())
+    host, port = server.serve_background()
+    try:
+        with socket.create_connection((host, port), timeout=10) as sock:
+            sock.sendall((
+                "POST /predict HTTP/1.1\r\n"
+                "Content-Length: %d\r\n\r\n" % (MAX_BODY_BYTES + 1)
+            ).encode())
+            sock.settimeout(10)
+            data = b""
+            while True:  # read to EOF: the server must hang up after the 400
+                chunk = sock.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            head = data.decode("latin1")
+            assert head.startswith("HTTP/1.1 400"), head
+            assert "connection: close" in head.lower(), head
+    finally:
+        server.shutdown_all()
+
+
+def test_serving_levers_compose_with_zero_recompiles():
+    """Acceptance: continuous batching + live lane scaling + pool
+    retirement + hot weight swaps, all while serving varied sizes —
+    compile_count stays exactly len(buckets)."""
+    from conftest import assert_zero_recompiles
+
+    exp, params = _digits()
+    fresh = exp.init(jax.random.PRNGKey(3))
+    vote = gars.instantiate("median", 3, 1)
+    engine = InferenceEngine(exp, [params] * 3, gar=vote, max_batch=8,
+                             weights_step=1)
+    engine.warmup()
+    compiled = len(engine.buckets)
+    server = InferenceServer(engine, port=0, queue_bound=256, lanes=1,
+                             max_lanes=3, registry=MetricsRegistry())
+    x = np.asarray(exp.dataset.x_test[:8], np.float32)
+    try:
+        def burst():
+            tickets = [server.scheduler.submit(x[:k]) for k in (1, 3, 8, 5, 2)]
+            return [t.wait(30.0) for t in tickets]
+
+        first = burst()
+        assert {r["weights_step"] for r in first} == {1}
+        server.scheduler.set_lanes(3)
+        engine.set_active_replicas([0, 2])
+        mid = burst()
+        engine.swap_replicas([fresh] * 3, step=2)
+        last = burst()
+        assert {r["weights_step"] for r in last} == {2}
+        # the retired-replica mask survived the swap
+        assert all(r["active_replicas"] == [0, 2] for r in last)
+        server.scheduler.set_lanes(1)
+        assert len(mid) == len(last) == 5
+        assert_zero_recompiles(engine, expect=compiled)
+    finally:
         server.shutdown_all()
 
 
 # --------------------------------------------------------------------- #
-# serve campaign (chaos tie-in harness)
+# serve campaign (chaos tie-in harness, v2: through the scheduler)
 
 
-def test_replica_campaign_matrix_and_verdicts():
+def test_replica_campaign_matrix_and_verdicts(tmp_path):
     """The campaign-style harness proves the serving thesis as data: the
     median vote keeps served predictions at the clean bar under a NaN
-    replica, plain average does not; the matrix carries the asserted
-    schema."""
+    replica, plain average does not; the matrix round-trips its v2 schema
+    and reports the scheduler batches + compile counts per cell."""
     from aggregathor_tpu.serve import campaign
 
     args = campaign.build_parser().parse_args([
@@ -469,12 +730,82 @@ def test_replica_campaign_matrix_and_verdicts():
     ])
     matrix = campaign.run_campaign(args)
     assert matrix["schema"] == campaign.SCHEMA
+    path = str(tmp_path / "matrix.json")
+    with open(path, "w") as fd:
+        json.dump(matrix, fd)
+    assert campaign.load(path)["schema"] == campaign.SCHEMA  # round trip
     for cell in matrix["cells"]:
         for key in campaign.CELL_KEYS:
             assert key in cell, key
+        assert cell["compile_count"] <= cell["nb_buckets"]
+        assert cell["batches"] >= 1
     by = {(c["gar"], c["fault"]): c for c in matrix["cells"]}
     assert by[("median", "nan")]["masked"], by[("median", "nan")]
     assert by[("median", "clean")]["masked"]
     assert not by[("average", "nan")]["masked"], by[("average", "nan")]
     # the faulty replica is named by its disagreement score
     assert by[("median", "nan")]["suspects"] == [2]
+    # 64 rows in 16-row submissions coalesced below one-batch-per-request
+    assert by[("median", "clean")]["batches"] <= 4
+    # a mutated document is rejected
+    bad = json.loads(json.dumps(matrix))
+    del bad["cells"][0]["batches"]
+    with pytest.raises(ValueError):
+        campaign.validate(bad)
+
+
+# --------------------------------------------------------------------- #
+# load benchmark schema + the checked-in serving SLO baseline
+
+
+def test_serve_load_schema_and_checked_in_slo_baseline():
+    """The aggregathor.serve.load.v1 validator accepts the benchmark's own
+    document shape and rejects mutations; the checked-in serving SLO
+    baseline loads through the PR-8 sentinel and judges its own capture
+    PASS (directions: req/s higher, p50/p99 lower)."""
+    from aggregathor_tpu.obs import slo as obs_slo
+
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "benchmarks"))
+    try:
+        import serve_load
+    finally:
+        sys.path.pop(0)
+
+    doc = {
+        "schema": serve_load.SCHEMA,
+        "config": {"experiment": "digits"},
+        "traffic": {"requests": 10, "ok": 10, "sheds": 0, "dropped": 0,
+                    "req_per_s": 100.0, "p50_ms": 5.0, "p95_ms": 9.0,
+                    "p99_ms": 10.0},
+        "swaps": {"applied": 2, "steps": [20, 40, 60], "final_step": 60,
+                  "wrong_weight_responses": 0, "monotonic": True},
+        "vote": {"poisoned_replica": 2, "mismatches": 0, "masked": True},
+        "compile": {"count": 4, "nb_buckets": 4, "zero_recompiles": True},
+        "slo": None,
+        "verdict": {"zero_dropped": True, "swaps_ok": True,
+                    "zero_wrong_weight": True, "masked": True,
+                    "zero_recompiles": True, "latency_ok": True,
+                    "pass": True},
+    }
+    assert serve_load.validate(doc) is doc
+    bad = json.loads(json.dumps(doc))
+    del bad["swaps"]["wrong_weight_responses"]
+    with pytest.raises(ValueError):
+        serve_load.validate(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["verdict"]["pass"] = "yes"
+    with pytest.raises(ValueError):
+        serve_load.validate(bad)
+
+    baseline_path = os.path.join(_REPO_ROOT, "benchmarks", "slo_serve_cpu.json")
+    sentinel = obs_slo.Sentinel(baseline_path)
+    metrics = sentinel.baseline["metrics"]
+    assert set(metrics) == {"serve_req_per_s", "serve_p50_ms", "serve_p99_ms"}
+    assert sentinel.baseline["directions"]["serve_req_per_s"] == "higher"
+    assert sentinel.baseline["directions"]["serve_p99_ms"] == "lower"
+    verdict = sentinel.verdict(dict(metrics))
+    assert verdict["verdict"] == "PASS"
+    # a 10x tail IS a regression under the checked-in tolerances
+    slow = dict(metrics)
+    slow["serve_p99_ms"] = metrics["serve_p99_ms"] * 10.0
+    assert sentinel.verdict(slow)["verdict"] == "REGRESS"
