@@ -26,6 +26,12 @@ each at a small feasible ``(n, f)`` found by probing, and verifies under
   and reputation EMA consume).
 - **GC004 dtype/shape drift** — float32 ``(n, d)`` in, float32 ``(d,)``
   out, proven abstractly by ``jax.eval_shape`` (no compile, no FLOPs).
+- **GC005 int8-wire survival** — the compressed-exchange contract
+  (parallel/compress.py, ``--exchange int8``): finite rows squeezed
+  through the int8 wire round-trip (quantization moves every value and
+  zeroes small coordinates exactly) must still aggregate finite.  A rule
+  that silently breaks under the quantized wire is a GC finding, not a
+  surprise at the first compressed run.
 - **GC000 probe crash** — any probe raising something other than the
   contract's expected exception is itself a finding: a rule the checker
   cannot exercise is a rule the next PR can silently break.
@@ -121,8 +127,8 @@ def check_spec(spec):
     base_key = jax.random.PRNGKey(0)
     # one derived key per probe (fresh fold_in data each — the hygiene the
     # prng checker enforces on this file like any other)
-    shape_key, clean_key, nan_key, part_key = (
-        jax.random.fold_in(base_key, tag) for tag in range(4)
+    shape_key, clean_key, nan_key, part_key, int8_key = (
+        jax.random.fold_in(base_key, tag) for tag in range(5)
     )
     rng = np.random.default_rng(0x6A2)
     grads = rng.normal(size=(n, PROBE_D)).astype(np.float32)
@@ -189,6 +195,33 @@ def check_spec(spec):
                 "NaN-tolerance probe crashed: %s: %s"
                 % (type(exc).__name__, exc),
             ))
+
+    # GC005: int8-wire survival — quantized finite rows aggregate finite
+    # (the probe the compressed exchange relies on; run_compress_smoke.sh
+    # exercises it through the real CLI).  One coordinate per row is
+    # amplified 1000x before the round-trip: the per-row scale then
+    # quantizes every small coordinate to an EXACT zero — real gradient
+    # rows have heavy coordinates, and that zeroing is precisely the
+    # structure a fragile rule breaks on.
+    try:
+        from ..parallel.compress import Int8Codec
+
+        spiky = grads.copy()
+        spiky[:, 0] *= 1000.0
+        quantized = Int8Codec().roundtrip_rows(jnp.asarray(spiky))
+        out = np.asarray(gar.aggregate(quantized, key=int8_key))
+        if not np.all(np.isfinite(out)):
+            findings.append(_finding(
+                "GC005", spec, "int8-wire",
+                "aggregate of int8-roundtripped finite gradients is not "
+                "finite at (n=%d, f=%d) — the rule breaks under the "
+                "compressed exchange (--exchange int8)" % (n, f),
+            ))
+    except Exception as exc:
+        findings.append(_finding(
+            "GC000", spec, "int8-probe",
+            "int8-wire probe crashed: %s: %s" % (type(exc).__name__, exc),
+        ))
 
     # GC003: participation scatter sums to 1
     try:

@@ -58,7 +58,23 @@ def build_parser():
     parser.add_argument(
         "--exchange-dtype", default=None, choices=["float32", "bfloat16"],
         help="wire precision of the gradient exchange (bfloat16 halves the "
-             "collective bytes; GAR math stays float32)",
+             "collective bytes; GAR math stays float32).  Subsumed by "
+             "--exchange, which also reaches int8/top-k",
+    )
+    parser.add_argument(
+        "--exchange", default=None, metavar="SPEC",
+        help="wire codec of the gradient exchange (parallel/compress.py, "
+             "docs/engine.md 'The wire'): f32 | bf16 | int8[:ef] | "
+             "topk:k=K[,ef] | topk:frac=F[,ef].  int8 quantizes each row "
+             "symmetrically with a traced per-row scale (~4x fewer bytes); "
+             "topk ships only the k largest-|value| coordinates; ef adds "
+             "per-worker error feedback (the residual rides TrainState.ef, "
+             "checkpointed).  Rows are encoded after the worker-local "
+             "attacks and decoded at the aggregation boundary, so every "
+             "GAR sees float32; digests sign the wire image; "
+             "bytes_on_wire_total / exchange_compression_ratio land on the "
+             "metrics registry.  int8/topk need the flat engine and refuse "
+             "--secure-mask (the fixed-point pads need the exact rows)",
     )
     parser.add_argument(
         "--worker-momentum", type=float, default=None, metavar="BETA",
@@ -225,6 +241,15 @@ def build_parser():
         "--stale-max-age", type=int, default=4, metavar="ROUNDS",
         help="bounded-wait stale infill: a carry older than this many "
              "consecutive missed rounds degrades back to a NaN drop",
+    )
+    parser.add_argument(
+        "--incremental-aggregation", action="store_true",
+        help="bounded-wait: fold each submission's decoded row into the "
+             "aggregate-side device buffer the instant it lands instead of "
+             "stacking at the round barrier — decode/transfer overlaps the "
+             "submissions still outstanding (exchange_overlap_fraction on "
+             "the registry measures it).  Needs --step-deadline and the "
+             "flat engine; numerics identical to the stacked path",
     )
     parser.add_argument(
         "--backend-timeout", type=float, default=300.0, metavar="SECONDS",
@@ -552,6 +577,7 @@ def main(argv=None):
     from ..obs import slo as obs_slo
     from ..obs.summaries import make_run_id
     from ..parallel import RobustEngine, attacks, make_mesh
+    from ..parallel import compress
     from ..parallel.lossy import LossyLink
     from ..utils import Context, UserException, info, replicate_streams, warning
 
@@ -564,6 +590,37 @@ def main(argv=None):
             "--secure/--secure-mask derive their per-worker keys and mask "
             "pads from --session-secret; pass it"
         )
+    # The wire codec (--exchange, parallel/compress.py): parsed up front so
+    # a bad spec or an infeasible composition fails before any compilation.
+    exchange_codec = None
+    if args.exchange:
+        if args.exchange_dtype:
+            raise UserException(
+                "--exchange generalizes --exchange-dtype (bf16 is spelled "
+                "--exchange bf16); pass only one"
+            )
+        spec_dtype, exchange_codec = compress.parse_exchange_spec(args.exchange)
+        if spec_dtype is not None:
+            # bf16 normalizes onto the historical dtype twin (works on
+            # BOTH engines, bit-compatible with existing runs)
+            args.exchange_dtype = "bfloat16"
+            args.exchange = None
+    if exchange_codec is not None:
+        if args.mesh:
+            raise UserException(
+                "--exchange %s needs the flat engine (drop --mesh): the "
+                "sharded per-(worker, leaf) submissions would need per-leaf "
+                "codec state — --exchange bf16 works everywhere"
+                % exchange_codec.spec()
+            )
+        if args.secure_mask:
+            raise UserException(
+                "--exchange %s + --secure-mask is not supported: the "
+                "fixed-point pairwise pads cancel exactly over the EXACT "
+                "float32 rows, and a lossy wire codec would corrupt the "
+                "cancellation — run masking on the f32/bf16 wire"
+                % exchange_codec.spec()
+            )
     if args.flight < 0:
         raise UserException("--flight wants a nonnegative ring capacity")
     if args.flight_dump and not args.flight:
@@ -874,6 +931,17 @@ def main(argv=None):
                     "collective program whose members cannot time out "
                     "independently (docs/engine.md, protocol scope)"
                 )
+            if args.incremental_aggregation and mesh_axes is not None:
+                raise UserException(
+                    "--incremental-aggregation folds per-WORKER rows; the "
+                    "sharded mode's per-submesh submissions need a "
+                    "per-group fold layout — run the flat engine"
+                )
+            if args.incremental_aggregation and args.step_deadline is None:
+                raise UserException(
+                    "--incremental-aggregation overlaps decode with the "
+                    "deadline window; pass --step-deadline"
+                )
             if mesh_axes is not None and args.microbatches is not None:
                 raise UserException(
                     "--step-deadline on the sharded engine computes per-"
@@ -947,11 +1015,21 @@ def main(argv=None):
                     "protocol never times anyone out"
                 )
         elif (args.deadline_percentile is not None or args.stale_infill
-                or args.straggler_jitter > 0):
+                or args.straggler_jitter > 0 or args.incremental_aggregation):
             raise UserException(
-                "--deadline-percentile/--stale-infill/--straggler-jitter "
-                "are bounded-wait options; pass --step-deadline (or "
-                "--straggler-stall for the synchronous baseline)"
+                "--deadline-percentile/--stale-infill/--straggler-jitter/"
+                "--incremental-aggregation are bounded-wait options; pass "
+                "--step-deadline (or --straggler-stall for the synchronous "
+                "baseline)"
+            )
+        if (exchange_codec is not None and exchange_codec.uses_ef
+                and jax.process_count() > 1):
+            raise UserException(
+                "--exchange %s is single-process: the error-feedback "
+                "residual is a worker-sharded buffer the checkpoint path "
+                "serializes (a multi-host device_get cannot see every "
+                "shard) — drop :ef or run one process"
+                % exchange_codec.spec()
             )
 
         def make_regularized_loss(base_loss, l1, l2):
@@ -1074,7 +1152,8 @@ def main(argv=None):
             else:
                 engine = RobustEngine(
                     mesh, gar, n, nb_real_byz=r, attack=attack, lossy_link=lossy,
-                    exchange_dtype=args.exchange_dtype, worker_momentum=args.worker_momentum,
+                    exchange_dtype=args.exchange_dtype, exchange=exchange_codec,
+                    worker_momentum=args.worker_momentum,
                     batch_transform=experiment.device_transform(),
                     worker_metrics=args.worker_metrics,
                     reputation_decay=ov.reputation_decay,
@@ -1113,6 +1192,7 @@ def main(argv=None):
                         controller=deadline_controller,
                         stale_infill=args.stale_infill,
                         stale_max_age=args.stale_max_age,
+                        incremental=args.incremental_aggregation,
                     )
                     ts.step_fn = ts.bounded_step
                 else:
@@ -1604,6 +1684,26 @@ def main(argv=None):
     g_gar_probe = registry.gauge(
         "gar_probe_seconds", "Last measured single-aggregation GAR wall time"
     )
+    # Wire accounting (parallel/compress.py, docs/engine.md "The wire"):
+    # bytes of the (n, d) submission stack per step under the configured
+    # exchange — a static function of the run's geometry, counted per
+    # dispatched step so the compression win is a number, not a claim.
+    # Constant across guardian rebuilds (the ladder never changes d or the
+    # exchange), so computed once here.
+    c_wire_bytes = registry.counter(
+        "bytes_on_wire_total",
+        "Gradient-exchange submission bytes shipped over the wire",
+    )
+    g_wire_ratio = registry.gauge(
+        "exchange_compression_ratio",
+        "f32-wire bytes over configured-exchange bytes (>= 1)",
+    )
+    wire_step_bytes = n * compress.bytes_per_row(
+        ts.model_dim, dtype=ts.engine.exchange_dtype, codec=ts.engine.codec
+    )
+    g_wire_ratio.set(compress.compression_ratio(
+        ts.model_dim, dtype=ts.engine.exchange_dtype, codec=ts.engine.codec
+    ))
     # guardian recovery counters — the third subsystem on the one registry
     g_rollbacks = registry.counter(
         "guardian_rollbacks_total", "Guardian rollbacks to last-known-good"
@@ -2193,6 +2293,7 @@ def main(argv=None):
                     pending_metrics = metrics
                     pending_start = step
                 step += chunk
+                c_wire_bytes.inc(chunk * wire_step_bytes)
                 live_state["step"] = step
                 if xprof is not None:
                     xprof.maybe_stop(step)

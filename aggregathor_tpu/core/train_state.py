@@ -59,6 +59,16 @@ class TrainState:
     #: restore or rollback re-initializes an empty ring (stale rows from
     #: an abandoned timeline must not masquerade as fresh evidence)
     flight: object = None
+    #: (nb_workers, d) per-worker error-feedback residuals of the
+    #: compressed wire codec (parallel/compress.py): worker w transmits
+    #: C(g + ef[w]) and carries the quantization residual forward.
+    #: Worker-sharded like carry/momentum but — unlike them — SERIALIZED
+    #: (conditionally, below): a residual is accumulated signal, and
+    #: zeroing it on restore would silently re-bias the first post-restore
+    #: submissions.  Checkpoint/restore/rollback round-trips preserve it
+    #: bit-exactly (tests/test_compress.py); EF runs are single-process
+    #: (the runner refuses multi-host EF), so the device_get is addressable
+    ef: object = None
 
     @classmethod
     def create(cls, params, tx, rng=None, carry=None, momentum=None):
@@ -81,7 +91,14 @@ def _to_state_dict(state):
     # and break restore of snapshots taken before the fields existed.  A
     # restarted run re-zeroes them (for CLEVER, exactly the reference's
     # freshly-allocated reassembly buffer; for momentum, a short re-warmup).
-    return {f: flax.serialization.to_state_dict(getattr(state, f)) for f in _SERIALIZED_FIELDS}
+    # The error-feedback residual is the exception (see the field doc):
+    # serialized CONDITIONALLY, so snapshots of EF-less runs keep their
+    # historical layout and pre-EF snapshots restore into EF runs (the
+    # target's zeroed buffer stands in, exactly a fresh codec's state).
+    out = {f: flax.serialization.to_state_dict(getattr(state, f)) for f in _SERIALIZED_FIELDS}
+    if state.ef is not None:
+        out["ef"] = flax.serialization.to_state_dict(state.ef)
+    return out
 
 
 def _from_state_dict(target, state_dict):
@@ -89,6 +106,10 @@ def _from_state_dict(target, state_dict):
         f: flax.serialization.from_state_dict(getattr(target, f), state_dict[f], name=f)
         for f in _SERIALIZED_FIELDS
     }
+    if target.ef is not None and "ef" in state_dict:
+        restored["ef"] = flax.serialization.from_state_dict(
+            target.ef, state_dict["ef"], name="ef"
+        )
     return target.replace(**restored)
 
 

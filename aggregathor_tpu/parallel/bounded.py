@@ -183,11 +183,23 @@ class BoundedWaitStep:
       rounds — after that (or before any row ever arrived) it degrades
       back to the NaN drop.  Stale rows spend the declared-f budget
       exactly like timeouts (module docstring).
+    - ``incremental``: fold each submission's DECODED row into an
+      aggregate-side device buffer **the instant it lands**
+      (``engine.build_incremental_fold``) instead of stacking everything
+      at the round barrier — decode/transfer work overlaps the
+      submissions still outstanding, which is where a compressed wire's
+      decode cost goes to die.  The barrier-side aggregate then consumes
+      the already-decoded buffer (``rows_form="decoded"``); numerics are
+      identical to the stacked path (same decoder, same rows).  A fold
+      issued while at least one submission is still pending counts as
+      OVERLAPPED — ``exchange_overlap_fraction`` on the registry is the
+      measured fraction (the win is a number, not a claim).  Flat
+      submission units only (a per-submesh fold is a different layout).
     """
 
     def __init__(self, engine, loss_fn, tx, params_template, deadline=None,
                  straggler_model=None, registry=None, controller=None,
-                 stale_infill=False, stale_max_age=4):
+                 stale_infill=False, stale_max_age=4, incremental=False):
         if deadline is not None and deadline <= 0.0:
             raise UserException("--step-deadline must be > 0 seconds")
         if stale_infill and deadline is None and controller is None:
@@ -209,11 +221,20 @@ class BoundedWaitStep:
         self.model = straggler_model
         self.momentum = engine.worker_momentum is not None
         self.secure = bool(engine.secure)
+        self.codec = engine.codec
+        self.ef = bool(engine.carries_ef)
+        self.incremental = bool(incremental)
         # Submission units (module docstring): the flat mode dispatches one
         # executable per WORKER; the sharded mode one per worker-axis
         # SUBMESH (its k logical workers vmapped inside — per-group
         # deadlines: the group arrives, and times out, as a whole).
         self.grouped = bool(engine.sharded)
+        if self.incremental and self.grouped:
+            raise UserException(
+                "--incremental-aggregation folds per-WORKER rows; the "
+                "sharded mode's per-submesh submissions need a per-group "
+                "fold layout, a different protocol — run the flat engine"
+            )
         if self.grouped:
             self.group_size = engine.workers_per_device
             self.nb_units = engine.nb_devices
@@ -222,7 +243,10 @@ class BoundedWaitStep:
             self.group_size = 1
             self.nb_units = self.nb_workers
             self.grad_fn = engine.build_worker_grad(loss_fn)
-        self.agg_fn = engine.build_bounded_aggregate(tx, params_template)
+        self.agg_fn = engine.build_bounded_aggregate(
+            tx, params_template,
+            rows_form="decoded" if self.incremental else "wire",
+        )
         self.pool = ThreadPoolExecutor(
             max_workers=self.nb_units, thread_name_prefix="bw-submit"
         )
@@ -237,16 +261,29 @@ class BoundedWaitStep:
         # deadline would time out every worker of step 0 (the perf report
         # excludes the compile step for the same reason)
         self._warm = False
-        # one committed NaN row + zero loss reused for every missing slot
+        # one committed miss row + zero loss reused for every missing slot:
+        # a NaN row on the dtype wire, a zeroed payload under a codec (its
+        # content is irrelevant — the aggregate masks non-valid slots to
+        # NaN AFTER decoding; only the pytree structure must match)
         d = sum(
             int(np.prod(np.shape(leaf)))
             for leaf in jax.tree_util.tree_leaves(params_template)
         )
+        self.d = d
         row_dtype = np.dtype(engine.exchange_dtype or np.float32)
-        self._nan_template = (
-            np.zeros((), np.float32), np.full((d,), np.nan, row_dtype),
-        )
+        if self.codec is not None:
+            miss_row = self.codec.payload_zeros(d)
+        else:
+            miss_row = np.full((d,), np.nan, row_dtype)
+        self._nan_template = (np.zeros((), np.float32), miss_row)
         self._zero_row = np.zeros((d,), np.float32)
+        # incremental mode: the fold executable + the per-round fresh
+        # buffer (engine.build_incremental_fold); the fold is our own
+        # dispatch against our own buffer, so it shares no donation race
+        # with the submissions
+        self._fold_fn = self._fresh_buffer = None
+        if self.incremental:
+            self._fold_fn, self._fresh_buffer = engine.build_incremental_fold(d)
         self._nan_digest = None
         if self.secure:
             from ..secure.submit import row_digest
@@ -254,11 +291,14 @@ class BoundedWaitStep:
             # the digest of the NaN drop row — what "arrived" for a slot
             # nobody submitted; sender and receiver agree by construction,
             # so the host authenticator verifies it without a forgery
-            # verdict (a timeout is named by forensics, not by crypto)
+            # verdict (a timeout is named by forensics, not by crypto).
+            # Digested over the f32 drop row on every wire — under a codec
+            # the "row" is a payload pytree, but the drop's wire IMAGE is
+            # still the NaN row the aggregate masks in
             import jax.numpy as jnp
 
             self._nan_digest = np.asarray(jax.device_get(
-                row_digest(jnp.asarray(self._nan_template[1], jnp.float32))
+                row_digest(jnp.full((d,), jnp.nan, jnp.float32))
             ))
         # CLEVER carry for stale infill: the last row each worker actually
         # delivered (post-attack, post-momentum — exactly what the PS
@@ -270,8 +310,14 @@ class BoundedWaitStep:
         self._carry_age = np.zeros((self.nb_workers,), np.int64)
         self.timeouts_total = np.zeros((self.nb_workers,), np.int64)
         self.stale_total = np.zeros((self.nb_workers,), np.int64)
+        # incremental-overlap accounting (measured, not presumed): a fold
+        # issued while >= 1 submission was still pending is OVERLAPPED
+        self.folds_total = 0
+        self.overlapped_folds_total = 0
+        self.last_overlap_fraction = 0.0
         self._c_timeouts = self._c_rounds = self._g_deadline = None
         self._c_late = self._c_stale = None
+        self._c_folds = self._c_overlapped = self._g_overlap = None
         if registry is not None:
             self._c_timeouts = registry.counter(
                 "straggler_timeouts_total",
@@ -298,6 +344,21 @@ class BoundedWaitStep:
             )
             if deadline is not None:
                 self._g_deadline.set(float(deadline))
+            if self.incremental:
+                self._c_folds = registry.counter(
+                    "exchange_folds_total",
+                    "Submissions folded into the aggregate-side buffer "
+                    "as they landed (incremental aggregation)",
+                )
+                self._c_overlapped = registry.counter(
+                    "exchange_overlapped_folds_total",
+                    "Incremental folds issued while at least one "
+                    "submission was still outstanding",
+                )
+                self._g_overlap = registry.gauge(
+                    "exchange_overlap_fraction",
+                    "Last round's overlapped-fold fraction",
+                )
 
     # ------------------------------------------------------------------ #
 
@@ -352,19 +413,22 @@ class BoundedWaitStep:
         if self._closed:
             raise RuntimeError("BoundedWaitStep was closed")
         n, k = self.nb_workers, self.group_size
-        if self.momentum:
+        if self.momentum or self.ef:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            if state.momentum.sharding.spec != PartitionSpec():
-                # one-time re-placement (round 0): init_state worker-shards
-                # the buffer for the fused shard_map dataflow, but the
-                # bounded executables are plain jits whose outputs
-                # canonicalize to replicated — one layout for every round
-                # keeps the steady-state compile count at 1
-                state = state.replace(momentum=jax.device_put(
-                    state.momentum,
-                    NamedSharding(self.engine.mesh, PartitionSpec()),
-                ))
+            replicated = NamedSharding(self.engine.mesh, PartitionSpec())
+            # one-time re-placement (round 0): init_state worker-shards
+            # the (n, d) side buffers for the fused shard_map dataflow,
+            # but the bounded executables are plain jits whose outputs
+            # canonicalize to replicated — one layout for every round
+            # keeps the steady-state compile count at 1
+            if (self.momentum
+                    and state.momentum.sharding.spec != PartitionSpec()):
+                state = state.replace(
+                    momentum=jax.device_put(state.momentum, replicated)
+                )
+            if self.ef and state.ef.sharding.spec != PartitionSpec():
+                state = state.replace(ef=jax.device_put(state.ef, replicated))
         # the previous dispatch materialized the step counter; this read is
         # a host copy, not a device sync
         step_idx = int(jax.device_get(state.step))
@@ -403,6 +467,8 @@ class BoundedWaitStep:
             args = [params, unit_batch, rng, step_idx, unit]
             if self.momentum:
                 args += [state.momentum, state.momentum_steps]
+            if self.ef:
+                args += [state.ef]
             self._in_flight[unit] = self.pool.submit(
                 self._submit_one, self._round, step_idx, unit, round_begin,
                 args,
@@ -417,20 +483,55 @@ class BoundedWaitStep:
         else:
             deadline = None
         self._warm = True
+        # incremental mode: fold each submission into the round's buffer
+        # the instant its future completes — while its peers are still
+        # computing/stalling, which is what "overlap" measures.  A fold
+        # that fails (worker death) is left for the barrier loop below to
+        # surface; a fold issued when no submission is pending anymore is
+        # counted but not overlapped.
+        buffer = self._fresh_buffer() if self.incremental else None
+        folded = set()
+        nb_folds = nb_overlapped = 0
+        fut_unit = {fut: unit for unit, fut in futures.items()}
+
+        def fold_done(done, pending):
+            nonlocal buffer, nb_folds, nb_overlapped
+            for fut in done:
+                if fut.cancelled() or fut.exception() is not None:
+                    continue  # the barrier loop surfaces worker deaths
+                result = fut.result()
+                if result is None:
+                    continue
+                _, out = result
+                buffer = self._fold_fn(buffer, out["row"], fut_unit[fut])
+                folded.add(fut_unit[fut])
+                nb_folds += 1
+                nb_overlapped += bool(pending)
+
         with trace.span("bounded_wait.collect", cat="train"):
             pending = set(futures.values())
-            if deadline is None:
+            if deadline is None and not self.incremental:
                 if pending:
                     wait(pending)
             else:
-                deadline_at = time.monotonic() + deadline
+                deadline_at = (
+                    None if deadline is None else time.monotonic() + deadline
+                )
                 while pending:
-                    remaining = deadline_at - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    done, pending = wait(
-                        pending, timeout=remaining, return_when=FIRST_COMPLETED
-                    )
+                    if deadline_at is None:
+                        done, pending = wait(
+                            pending, return_when=FIRST_COMPLETED
+                        )
+                    else:
+                        remaining = deadline_at - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        done, pending = wait(
+                            pending, timeout=remaining,
+                            return_when=FIRST_COMPLETED,
+                        )
+                    if self.incremental:
+                        fold_done(done, pending)
         # close the round: submissions that wake up from now on must not
         # dispatch against buffers the aggregate below will donate
         with self._round_lock:
@@ -440,6 +541,7 @@ class BoundedWaitStep:
         arrival_seconds = np.full((n,), np.inf)
         losses, rows = [None] * n, [None] * n
         mom_rows = [None] * n if self.momentum else None
+        ef_rows = [None] * n if self.ef else None
         digests = [None] * n if self.secure else None
         for unit in range(self.nb_units):
             fut = futures.get(unit)
@@ -473,6 +575,9 @@ class BoundedWaitStep:
                         mom_rows[w] = (
                             out["momentum"][j] if grouped else out["momentum"]
                         )
+                    if self.ef:
+                        # flat-only (codec exchange refuses grouped mode)
+                        ef_rows[w] = out["ef"]
                     if self.secure:
                         digest = out["digest"][j] if grouped else out["digest"]
                         digests[w] = digest
@@ -497,12 +602,36 @@ class BoundedWaitStep:
                         # content never read: the aggregate keeps the old
                         # momentum row wherever ``arrived`` is False
                         mom_rows[w] = self._zero_row
+                    if self.ef:
+                        # content never read (same mask as momentum)
+                        ef_rows[w] = self._zero_row
+        if self.incremental:
+            # barrier-side completion of the buffer: submissions that
+            # landed between the deadline expiring and the round closing
+            # were never folded (count them, not overlapped), and stale
+            # carries re-enter through the same fold (decode included)
+            for w in range(n):
+                if arrived[w] and w not in folded:
+                    buffer = self._fold_fn(buffer, rows[w], w)
+                    nb_folds += 1
+                elif stale[w]:
+                    buffer = self._fold_fn(buffer, rows[w], w)
+                    nb_folds += 1
+            self.folds_total += nb_folds
+            self.overlapped_folds_total += nb_overlapped
+            self.last_overlap_fraction = (
+                nb_overlapped / nb_folds if nb_folds else 0.0
+            )
         self.timeouts_total += ~arrived
         self.stale_total += stale
         if self.controller is not None and was_warm:
             # feed the controller only rounds the deadline governed (the
             # compile round's arrivals measure XLA, not the fleet)
             self.controller.observe_round(arrival_seconds)
+        if self._c_folds is not None:
+            self._c_folds.inc(nb_folds)
+            self._c_overlapped.inc(nb_overlapped)
+            self._g_overlap.set(self.last_overlap_fraction)
         if self._c_timeouts is not None:
             for w in np.nonzero(~arrived)[0]:
                 self._c_timeouts.labels(worker=str(int(w))).inc()
@@ -519,20 +648,34 @@ class BoundedWaitStep:
         extras = {}
         if self.momentum:
             extras["momentum"] = jnp.stack(mom_rows)
+        if self.ef:
+            extras["ef"] = jnp.stack(ef_rows)
         if self.secure:
             extras["digests"] = jnp.stack(digests)
+        if self.incremental:
+            rows_in = buffer  # already decoded, rows_form="decoded"
+        else:
+            # tree-stack: plain (d,) rows on the dtype wire, the encoded
+            # payload pytrees under a codec (decoded inside the aggregate)
+            rows_in = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *rows
+            )
         return self.agg_fn(
-            state, jnp.stack(rows), jnp.stack(losses),
+            state, rows_in, jnp.stack(losses),
             jnp.asarray(arrived), jnp.asarray(stale), extras,
         )
 
     def _cache_size(self):
         """Compile-count surface for the zero-recompile assertions AND the
-        runner's CompileWatch: the MAX over the two bounded-wait
-        executables, so steady state reads 1 like every fused step (a sum
-        would read 2 and trip the watch's cache_size > 1 retrace alarm on
-        the expected first compile)."""
-        return max(self.grad_fn._cache_size(), self.agg_fn._cache_size())
+        runner's CompileWatch: the MAX over the bounded-wait executables
+        (submission, aggregate and — incremental mode — the fold), so
+        steady state reads 1 like every fused step (a sum would read 2+
+        and trip the watch's cache_size > 1 retrace alarm on the expected
+        first compile)."""
+        sizes = [self.grad_fn._cache_size(), self.agg_fn._cache_size()]
+        if self._fold_fn is not None:
+            sizes.append(self._fold_fn._cache_size())
+        return max(sizes)
 
     def close(self, timeout=5.0):
         """Idempotent shutdown: poison the round id so stalled submission

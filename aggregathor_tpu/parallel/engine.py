@@ -208,7 +208,7 @@ class RobustEngine:
     """
 
     def __init__(self, mesh, gar, nb_workers=None, nb_real_byz=0, attack=None, lossy_link=None,
-                 exchange_dtype=None, worker_momentum=None, batch_transform=None,
+                 exchange_dtype=None, exchange=None, worker_momentum=None, batch_transform=None,
                  worker_metrics=False, reputation_decay=None, quarantine_threshold=0.0,
                  granularity=None, leaf_bucketing="auto", trace_ops=False, chaos=None,
                  health_probe=True, secure=False, flight=None,
@@ -376,6 +376,42 @@ class RobustEngine:
         # float32 normalizes to None (no quantization path compiled in).
         dt = jnp.dtype(exchange_dtype) if exchange_dtype else None
         self.exchange_dtype = None if dt == jnp.float32 else dt
+        # Generalized wire codec (parallel/compress.py, docs/engine.md "The
+        # wire"): ``exchange`` accepts a spec string (int8[:ef] /
+        # topk:... / bf16 / f32) or a WireCodec.  bf16/f32 normalize onto
+        # the dtype twin above (bit-compatible with existing runs);
+        # int8/topk engage the codec in the submission pipeline — encoded
+        # after the worker-local attacks, decoded at the aggregation
+        # boundary so every GAR sees float32 rows.  Feasibility (masked
+        # fixed-point path, sharded mode, topk budget) refuses HERE, which
+        # is also the guardian escalation rebuild path — a ladder rung
+        # that re-builds the stack re-validates the codec.
+        self.codec = None
+        if exchange is not None:
+            from .compress import parse_exchange_spec
+
+            if self.exchange_dtype is not None:
+                raise UserException(
+                    "pass either exchange= (the wire codec spec) or "
+                    "exchange_dtype=, not both — bf16 is spelled "
+                    "exchange='bf16' on the codec surface"
+                )
+            spec_dtype, self.codec = parse_exchange_spec(exchange)
+            if spec_dtype is not None:
+                self.exchange_dtype = spec_dtype
+        if self.codec is not None:
+            if self.sharded:
+                raise UserException(
+                    "--exchange %s needs the flat engine: the sharded "
+                    "dataflow's per-(worker, leaf) submissions would need "
+                    "per-leaf codec/error-feedback state, a different "
+                    "protocol (bf16/f32 wire dtypes work everywhere)"
+                    % self.codec.spec()
+                )
+            self.codec.validate_for(gar=gar)
+        #: the per-worker error-feedback residual rides TrainState.ef
+        #: (worker-sharded, serialized — core/train_state.py)
+        self.carries_ef = self.codec is not None and self.codec.uses_ef
         # Logical workers are decoupled from worker-axis slots in BOTH
         # modes: k = n/W workers are vmapped per slot (flat: per device;
         # sharded: per (pipe x model) submesh).  ``nb_mesh_workers`` is the
@@ -438,19 +474,21 @@ class RobustEngine:
         flatmap = FlatMap(jax.tree_util.tree_map(lambda g: g[0], grads))
         return losses, gvecs, flatmap
 
-    def _perturb_local(self, gvecs, key, carry=None, ridx=None):
-        """Apply local attack + lossy link + chaos regime + the submission-
-        forgery pipeline to each local worker's own slot.
+    def _perturb_local(self, gvecs, key, carry=None, ridx=None, ef=None):
+        """Apply local attack + wire codec + lossy link + chaos regime +
+        the submission-forgery pipeline to each local worker's own slot.
 
-        Returns (perturbed (k, d), new_carry, secure_info) — ``new_carry``
-        is the post-transport gradients, i.e. what "the PS received" this
-        step: exactly the stale value a lost packet keeps under CLEVER
-        infill, and the value a stale-mode straggler keeps re-submitting (a
-        worker late k steps in a row re-sends the same gradient k times).
-        ``secure_info`` (None unless ``secure``) carries the per-local-
-        worker submitted/received digests and the forge/reject verdicts —
-        what the host-side authenticator signs and verifies one dispatch
-        behind (secure/submit.py).
+        Returns (perturbed (k, d), new_carry, secure_info, new_ef) —
+        ``new_carry`` is the post-transport gradients, i.e. what "the PS
+        received" this step: exactly the stale value a lost packet keeps
+        under CLEVER infill, and the value a stale-mode straggler keeps
+        re-submitting (a worker late k steps in a row re-sends the same
+        gradient k times).  ``secure_info`` (None unless ``secure``)
+        carries the per-local-worker submitted/received digests and the
+        forge/reject verdicts — what the host-side authenticator signs and
+        verifies one dispatch behind (secure/submit.py).  ``ef`` is the
+        local (k, d) error-feedback shard when the codec carries it;
+        ``new_ef`` the updated residuals (None otherwise).
         """
         from ..secure.submit import FORGE_SCALE, row_digest, tamper_row
 
@@ -459,6 +497,7 @@ class RobustEngine:
         chaos_forgery = self.chaos is not None and self.chaos.has_forgery
         out = []
         carry_rows = []  # post-transport, PRE-forgery (see carry note below)
+        ef_rows = [] if ef is not None else None
         sec = {"digest_sent": [], "digest_recv": [], "forged": [], "rejected": []}
         for j in range(k):
             gidx = didx * k + j
@@ -471,6 +510,20 @@ class RobustEngine:
             if self.chaos is not None and self.chaos.has_local_attacks:
                 forged = self.chaos.apply_local_attacks(ridx, g, jax.random.fold_in(wkey, 1))
                 g = jnp.where(gidx < self.nb_real_byz, forged, g)
+            if self.codec is not None:
+                # THE WIRE (parallel/compress.py): the row is encoded here
+                # — after the worker-local attacks (an attacker forges what
+                # it transmits; its forgery crosses the same lossy wire)
+                # and BEFORE the transport faults below, so packet-loss NaN
+                # masking lands on the DECODED image (a dropped packet of
+                # int8 payload is still a NaN coordinate run —
+                # parallel/lossy.py).  From here on, ``g`` is the wire
+                # image: what the aggregator's decoder emits.
+                if ef is not None:
+                    g, new_ef_row = self.codec.ef_roundtrip(g, ef[j])
+                    ef_rows.append(new_ef_row)
+                else:
+                    g = self.codec.roundtrip(g)
             if self.lossy_link is not None:
                 g = self.lossy_link.apply(g, jax.random.fold_in(wkey, 2), gidx, previous=previous)
             if self.chaos is not None:
@@ -545,7 +598,8 @@ class RobustEngine:
             secure_info = {
                 key_: jnp.stack(values) for key_, values in sec.items()
             }
-        return stacked, carry, secure_info
+        new_ef = jnp.stack(ef_rows, axis=0) if ef_rows is not None else None
+        return stacked, carry, secure_info, new_ef
 
     def _reshard_to_blocks(self, gvecs, d):
         """(k, d) worker-sharded -> (n, d_block) dimension-sharded column block."""
@@ -582,9 +636,12 @@ class RobustEngine:
             byz_mask = jnp.arange(self.nb_workers) < self.nb_real_byz
             rows = self.chaos.apply_omniscient_attacks(ridx, rows, byz_mask, attack_key)
             forged = True
-        if forged and self.exchange_dtype is not None:
-            # forged rows crossed the same quantized wire as honest ones
-            rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+        if forged:
+            # forged rows crossed the same quantized wire as honest ones —
+            # the one helper owning the precision-loss semantics
+            from .compress import wire_roundtrip
+
+            rows = wire_roundtrip(rows, dtype=self.exchange_dtype, codec=self.codec)
         raw_rows = rows
         if self.quarantine_threshold:
             qmask = quarantine_mask(
@@ -803,7 +860,7 @@ class RobustEngine:
     def _finalize_step(self, state, *, params, opt_state, new_carry,
                        new_momentum, new_momentum_steps, total_loss,
                        update_norm, worker_nan, rep_dist, wdist,
-                       participation, secure_metrics, ridx):
+                       participation, secure_metrics, ridx, new_ef=None):
         """Everything after the optimizer update, shared by the flat and the
         sharded step bodies (and the bounded-wait aggregator): reputation
         EMA, health probe, the metrics dict, and the flight-recorder write.
@@ -839,6 +896,7 @@ class RobustEngine:
             carry=new_carry, momentum=new_momentum,
             momentum_steps=new_momentum_steps,
             reputation=new_reputation, loss_ema=new_loss_ema,
+            ef=new_ef if self.carries_ef else state.ef,
         )
         metrics = {
             "total_loss": total_loss,
@@ -897,7 +955,22 @@ class RobustEngine:
             reputation=P() if self.reputation_decay is not None else None,
             loss_ema=P() if self.health_probe else None,
             flight=P() if self.flight is not None else None,
+            ef=P(worker_axis) if self.carries_ef else None,
         )
+
+    def _flat_out_shardings(self):
+        """Explicit jit out_shardings for the flat builders: pin the output
+        state to the ``_state_spec`` layout.  Without this the compiler
+        canonicalizes size-1 mesh axes to replicated specs, so a run with
+        a worker-sharded side buffer (momentum, CLEVER carry, the codec's
+        error-feedback residual) would see a differently-committed state
+        on its SECOND dispatch and retrace once — the same fix the sharded
+        builders ship (see ``_sharded_build_step``)."""
+        state_shardings = jax.tree.map(
+            lambda spec: None if spec is None else NamedSharding(self.mesh, spec),
+            self._state_spec(), is_leaf=_is_spec,
+        )
+        return (state_shardings, NamedSharding(self.mesh, P()))
 
     def _make_flat_body(self, loss_fn, tx):
         """The per-step SPMD body shared by build_step and build_multi_step."""
@@ -930,6 +1003,10 @@ class RobustEngine:
 
                 batch = jax.vmap(aug_one)(batch, jnp.arange(k))
             losses, gvecs, flatmap = self._worker_gradients(state.params, batch, loss_fn)
+            if self.codec is not None:
+                # the codec budget is validated at the first trace, which
+                # is also every guardian-escalation rebuild
+                self.codec.validate_d(gvecs.shape[-1])
             mark("losses+gradients done: local loss sum {l}", l=jnp.sum(losses))
             new_momentum, new_momentum_steps = None, None
             if self.worker_momentum is not None:
@@ -943,8 +1020,9 @@ class RobustEngine:
                 new_momentum = beta * state.momentum + (1.0 - beta) * gvecs
                 new_momentum_steps = state.momentum_steps + 1
                 gvecs = new_momentum / (1.0 - beta ** new_momentum_steps.astype(jnp.float32))
-            gvecs, new_carry, secure_info = self._perturb_local(
-                gvecs, key, carry=state.carry, ridx=ridx
+            gvecs, new_carry, secure_info, new_ef = self._perturb_local(
+                gvecs, key, carry=state.carry, ridx=ridx,
+                ef=state.ef if self.carries_ef else None,
             )
             d = gvecs.shape[-1]
             if self.granularity == "leaf":
@@ -1020,7 +1098,7 @@ class RobustEngine:
                 total_loss=total_loss, update_norm=jnp.linalg.norm(agg),
                 worker_nan=worker_nan, rep_dist=rep_dist, wdist=wdist,
                 participation=participation, secure_metrics=secure_metrics,
-                ridx=ridx,
+                ridx=ridx, new_ef=new_ef,
             )
 
         return body
@@ -1048,7 +1126,10 @@ class RobustEngine:
         # on or off (tests/test_obs.py asserts), and attribute access
         # (``_cache_size``) falls through to the jit.
         return trace.traced(
-            "train_step.dispatch", jax.jit(sharded, donate_argnums=(0,)), cat="train"
+            "train_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,),
+                    out_shardings=self._flat_out_shardings()),
+            cat="train",
         )
 
     def _flat_build_multi_step(self, loss_fn, tx, repeat_steps=None):
@@ -1091,7 +1172,9 @@ class RobustEngine:
             check_vma=False,
         )
         return trace.traced(
-            "train_multi_step.dispatch", jax.jit(sharded, donate_argnums=(0,)),
+            "train_multi_step.dispatch",
+            jax.jit(sharded, donate_argnums=(0,),
+                    out_shardings=self._flat_out_shardings()),
             cat="train",
         )
 
@@ -1158,7 +1241,9 @@ class RobustEngine:
         )
         return trace.traced(
             "train_sampled_multi_step.dispatch",
-            jax.jit(sharded, donate_argnums=(0,)), cat="train",
+            jax.jit(sharded, donate_argnums=(0,),
+                    out_shardings=self._flat_out_shardings()),
+            cat="train",
         )
 
     def _flat_build_gar_probe(self, d, seed=0):
@@ -1300,13 +1385,15 @@ class RobustEngine:
     def _flat_put_state(self, state):
         """Device_put a TrainState with the engine's state sharding — fully
         replicated except the worker-sharded side buffers (restore path)."""
-        carry, momentum = state.carry, state.momentum
-        placed = self.replicate(state.replace(carry=None, momentum=None))
+        carry, momentum, ef = state.carry, state.momentum, state.ef
+        placed = self.replicate(state.replace(carry=None, momentum=None, ef=None))
         if carry is not None:
             carry = self._worker_sharded(carry)
         if momentum is not None:
             momentum = self._worker_sharded(momentum)
-        return placed.replace(carry=carry, momentum=momentum)
+        if ef is not None:
+            ef = self._worker_sharded(ef)
+        return placed.replace(carry=carry, momentum=momentum, ef=ef)
 
     def _flat_init_state(self, params, tx, seed=0):
         """Create a replicated TrainState, plus zeroed worker-sharded side
@@ -1323,6 +1410,14 @@ class RobustEngine:
                 momentum=self._worker_sharded(None, d),
                 momentum_steps=self.replicate(jnp.zeros((), jnp.int32)),
             )
+        if self.codec is not None:
+            # the codec budget is validated as soon as d is known — which
+            # includes every guardian-escalation rebuild
+            self.codec.validate_d(d)
+        if self.carries_ef:
+            # fresh codec state: zero residuals (restore overwrites them —
+            # the EF buffer is serialized, unlike carry/momentum)
+            state = state.replace(ef=self._worker_sharded(None, d))
         if self.reputation_decay is not None:
             # everyone starts trusted; quarantine only after evidence accrues
             state = state.replace(
@@ -1601,9 +1696,13 @@ class RobustEngine:
                 lambda m: self.chaos.apply_omniscient_attacks(ridx, m, byz_mask, key)
             )(rows)
             forged = True
-        if forged and self.exchange_dtype is not None:
+        if forged:
             # forged rows crossed the same quantized wire as honest ones
-            rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+            # (sharded mode refuses codecs, so this is the dtype twin —
+            # elementwise, shape-agnostic over the bucket stack)
+            from .compress import wire_roundtrip
+
+            rows = wire_roundtrip(rows, dtype=self.exchange_dtype)
         return rows
 
     def _bucket_distances(self, rows, spec):
@@ -2191,28 +2290,36 @@ class RobustEngine:
 
     def _bounded_submission_body(self, loss_fn):
         """The shared per-worker submission body of both bounded-wait
-        builders: gradient -> worker momentum -> local attack -> digest ->
-        wire quantization, returning a dict with keys ``loss``, ``row``
-        and (configured) ``momentum`` / ``digest``.
+        builders: gradient -> worker momentum -> local attack -> wire
+        encode -> digest, returning a dict with keys ``loss``, ``row``
+        and (configured) ``momentum`` / ``ef`` / ``digest``.
 
-        ``momentum`` in the argument list is the WHOLE (n, d) buffer from
-        ``TrainState`` (dynamically indexed by the traced worker index, so
-        steady state never recompiles); the returned ``momentum`` entry is
-        the worker's updated (d,) row, which the bounded aggregate writes
-        back only for workers whose submission ARRIVED — a timed-out
-        worker's momentum never updated, exactly as its gradient never
-        shipped.  The submitted row is the bias-corrected momentum
-        (Karimireddy et al. 2021), corrected by the GLOBAL update count:
-        a straggler that missed rounds sends a slightly over-corrected
-        momentum rather than forcing a per-worker count into the compiled
-        signature.  The digest covers the row as submitted (post-attack,
-        pre-quantization — the fused ``_perturb_local`` convention)."""
+        ``momentum`` / ``ef`` in the argument list are the WHOLE (n, d)
+        buffers from ``TrainState`` (dynamically indexed by the traced
+        worker index, so steady state never recompiles); the returned
+        entries are the worker's updated (d,) rows, which the bounded
+        aggregate writes back only for workers whose submission ARRIVED —
+        a timed-out worker's momentum (and error-feedback residual) never
+        updated, exactly as its gradient never shipped.  The submitted row
+        is the bias-corrected momentum (Karimireddy et al. 2021),
+        corrected by the GLOBAL update count: a straggler that missed
+        rounds sends a slightly over-corrected momentum rather than
+        forcing a per-worker count into the compiled signature.
+
+        The wire: under a codec (parallel/compress.py) ``row`` is the
+        ENCODED payload pytree — what actually crosses the host boundary,
+        so the (n, d) f32 stack never does — and the digest covers the
+        wire IMAGE (the exact f32 rows the aggregation-side decoder
+        emits, a deterministic function of the encoded bytes: tampering
+        the payload moves the image and therefore the digest).  On the
+        dtype twin the digest keeps its historical convention (post-
+        attack, pre-quantization — the fused ``_perturb_local``'s)."""
         from ..secure.submit import row_digest
 
         beta = self.worker_momentum
 
         def body(params, worker_batch, rng, step, widx, momentum,
-                 momentum_steps):
+                 momentum_steps, ef):
             key = jax.random.fold_in(rng, step)
             if self.batch_transform is not None:
                 # fold tag 3: the augmentation stream (same as the fused body)
@@ -2235,6 +2342,17 @@ class RobustEngine:
                 wkey = jax.random.fold_in(key, widx)
                 forged = self.attack.apply_local(row, jax.random.fold_in(wkey, 1))
                 row = jnp.where(widx < self.nb_real_byz, forged, row)
+            if self.codec is not None:
+                if ef is not None:
+                    payload, image, new_ef = self.codec.ef_encode(row, ef[widx])
+                    out["ef"] = new_ef
+                else:
+                    payload = self.codec.encode(row)
+                    image = self.codec.decode(payload, row.shape[-1])
+                if self.secure:
+                    out["digest"] = row_digest(image)
+                out["row"] = payload
+                return out
             if self.secure:
                 out["digest"] = row_digest(row)
             if self.exchange_dtype is not None:
@@ -2256,18 +2374,25 @@ class RobustEngine:
         applied, local attack applied to coalition workers with the fused
         body's exact key discipline (fold worker, then tag 1), digest-
         summarized under ``secure``, wire-quantized when
-        ``exchange_dtype`` is set."""
+        ``exchange_dtype`` is set — or the ENCODED codec payload when a
+        wire codec is configured (``momentum`` and the error-feedback
+        ``ef`` buffer append to the operand list in that order, each iff
+        configured)."""
         self._check_bounded_wait_supported()
         body = self._bounded_submission_body(loss_fn)
+        with_momentum = self.worker_momentum is not None
+        with_ef = self.carries_ef
 
-        if self.worker_momentum is not None:
-            def grad_fn(params, worker_batch, rng, step, widx, momentum,
-                        momentum_steps):
-                return body(params, worker_batch, rng, step, widx, momentum,
-                            momentum_steps)
-        else:
-            def grad_fn(params, worker_batch, rng, step, widx):
-                return body(params, worker_batch, rng, step, widx, None, None)
+        def grad_fn(params, worker_batch, rng, step, widx, *extra):
+            momentum = momentum_steps = ef = None
+            i = 0
+            if with_momentum:
+                momentum, momentum_steps = extra[0], extra[1]
+                i = 2
+            if with_ef:
+                ef = extra[i]
+            return body(params, worker_batch, rng, step, widx, momentum,
+                        momentum_steps, ef)
 
         return trace.traced(
             "worker_grad.dispatch", jax.jit(grad_fn), cat="train"
@@ -2295,8 +2420,10 @@ class RobustEngine:
         def group_body(params, group_batch, rng, step, gidx, momentum,
                        momentum_steps):
             def one(j, worker_batch):
+                # codec exchange is flat-engine-only (__init__), so the
+                # group body never sees an ef operand
                 return body(params, worker_batch, rng, step, gidx * k + j,
-                            momentum, momentum_steps)
+                            momentum, momentum_steps, None)
 
             return jax.vmap(one)(jnp.arange(k), group_batch)
 
@@ -2314,47 +2441,74 @@ class RobustEngine:
             "group_grad.dispatch", jax.jit(group_fn), cat="train"
         )
 
-    def build_bounded_aggregate(self, tx, params_template):
+    def build_bounded_aggregate(self, tx, params_template, rows_form="wire"):
         """The aggregator side of the bounded-wait protocol: ``agg(state,
         rows, losses, arrived, stale, extras) -> (state, metrics)``, jitted
         once (``params_template`` fixes the flatten/inflate layout).
 
-        ``rows`` is the (n, d) submission buffer: fresh rows where
-        ``arrived``, CLEVER carry rows where ``stale`` (the host's stale
-        infill, parallel/bounded.py), garbage elsewhere — masked to NaN
-        in-graph.  A row that is neither fresh nor stale is a NaN drop
-        INSIDE the same declared-f budget as Byzantine rows, and a STALE
-        row spends that budget too (timeouts + stale + attacks <= f for
-        the rule's guarantee to hold — docs/engine.md, "f-accounting": the
-        carry may hold a Byzantine worker's attack row).  Deadline
-        verdicts land in ``metrics["straggler_timeout"]`` /
-        ``metrics["stale_infill"]``; missed workers are excluded from the
-        loss sum (the aggregator only averages what it received).
-        ``extras`` carries the configured optional operands: ``momentum``
-        (the (n, d) updated rows, written back only where ``arrived`` — a
-        timed-out worker's momentum never updated) and ``digests`` (the
-        (n, 4) submission digests the host authenticator signs/verifies
-        one dispatch behind, secure/submit.py).  Omniscient attacks,
-        quarantine, reputation, the health probe and the flight recorder
-        ride the same shared code paths as the fused step
-        (``_prepare_rows`` / ``_finalize_step``)."""
+        ``rows`` is the (n, ...) submission buffer in one of two forms
+        (fixed at build time — one compiled signature per step):
+
+        - ``rows_form="wire"``: what crossed the wire — (n, d) rows in
+          the exchange dtype, or the stacked ENCODED payload pytree under
+          a codec, decoded HERE so the GAR (and everything downstream)
+          sees float32 rows;
+        - ``rows_form="decoded"``: already-decoded float32 (n, d) rows —
+          the incremental as-rows-land mode (parallel/bounded.py folds
+          each submission into the buffer the instant it arrives, so the
+          barrier only pays the aggregation).
+
+        Fresh rows where ``arrived``, CLEVER carry rows where ``stale``
+        (the host's stale infill, parallel/bounded.py), garbage elsewhere
+        — masked to NaN in-graph AFTER decoding.  A row that is neither
+        fresh nor stale is a NaN drop INSIDE the same declared-f budget
+        as Byzantine rows, and a STALE row spends that budget too
+        (timeouts + stale + attacks <= f for the rule's guarantee to hold
+        — docs/engine.md, "f-accounting": the carry may hold a Byzantine
+        worker's attack row).  Deadline verdicts land in
+        ``metrics["straggler_timeout"]`` / ``metrics["stale_infill"]``;
+        missed workers are excluded from the loss sum (the aggregator
+        only averages what it received).  ``extras`` carries the
+        configured optional operands: ``momentum`` / ``ef`` (the (n, d)
+        updated rows, written back only where ``arrived`` — a timed-out
+        worker's momentum and error-feedback residual never updated) and
+        ``digests`` (the (n, 4) submission digests the host authenticator
+        signs/verifies one dispatch behind, secure/submit.py).
+        Omniscient attacks, quarantine, reputation, the health probe and
+        the flight recorder ride the same shared code paths as the fused
+        step (``_prepare_rows`` / ``_finalize_step``)."""
         self._check_bounded_wait_supported()
+        if rows_form not in ("wire", "decoded"):
+            raise UserException(
+                "rows_form must be 'wire' or 'decoded' (got %r)" % (rows_form,)
+            )
         from ..gars import GAR_KEY_TAG
         from ..gars.common import pairwise_sq_distances
 
+        from .compress import wire_roundtrip
+
         # the flattening layout, for inflating the aggregate back to a tree
         flatmap = FlatMap(params_template)
+        d = flatmap.size
+        if self.codec is not None:
+            self.codec.validate_d(d)
 
         def agg_fn(state, rows, losses, arrived, stale, extras):
             key = jax.random.fold_in(state.rng, state.step)
-            rows = rows.astype(jnp.float32)
+            if rows_form == "wire" and self.codec is not None:
+                # decode at the aggregation boundary: every GAR sees f32
+                rows = self.codec.decode_rows(rows, d)
+            else:
+                rows = rows.astype(jnp.float32)
             # deadline verdict first: a worker that neither arrived nor
             # carries a live stale row IS a NaN row — the exact convention
             # of a fully-lossy link, absorbed by the rule
             valid = arrived | stale
             rows = jnp.where(valid[:, None], rows, jnp.nan)
-            if self.exchange_dtype is not None:
-                rows = rows.astype(self.exchange_dtype).astype(jnp.float32)
+            if rows_form == "wire" and self.codec is None:
+                # the dtype twin's wire image (no-op on the f32 wire; the
+                # codec/decoded forms already ARE the wire image)
+                rows = wire_roundtrip(rows, dtype=self.exchange_dtype)
             rows, raw_rows = self._prepare_rows(rows, key, state.reputation)
             dist2 = None
             if self.gar.needs_distances:
@@ -2400,6 +2554,12 @@ class RobustEngine:
                     arrived[:, None], extras["momentum"], state.momentum
                 )
                 new_momentum_steps = state.momentum_steps + 1
+            new_ef = None
+            if self.carries_ef:
+                # same convention as momentum: a timed-out worker's
+                # error-feedback residual never updated (its submission —
+                # and the quantization error it absorbed — never shipped)
+                new_ef = jnp.where(arrived[:, None], extras["ef"], state.ef)
             secure_metrics = None
             if self.secure:
                 # sent == received by construction on this path (no
@@ -2421,7 +2581,7 @@ class RobustEngine:
                 total_loss=total_loss, update_norm=jnp.linalg.norm(agg),
                 worker_nan=worker_nan, rep_dist=rep_dist, wdist=wdist,
                 participation=participation, secure_metrics=secure_metrics,
-                ridx=None,
+                ridx=None, new_ef=new_ef,
             )
             # deadline evidence AFTER the epilogue: the flight recorder's
             # lane set predates the protocol; forensics/registry consume
@@ -2436,6 +2596,43 @@ class RobustEngine:
 
         jitted = jax.jit(agg_fn, donate_argnums=(0,))
         return trace.traced("bounded_aggregate.dispatch", jitted, cat="train")
+
+    def build_incremental_fold(self, d):
+        """The incremental-aggregation fold (parallel/bounded.py): write ONE
+        worker's decoded submission into the aggregate-side (n, d) float32
+        buffer the instant it lands, instead of stacking everything at the
+        round barrier.  ``fold(buffer, wire_row, widx) -> buffer`` — the
+        buffer is donated (an in-place row write), the worker index is a
+        traced operand, and the decode runs here, overlapped with the
+        submissions still outstanding — so the barrier-side aggregate
+        consumes already-decoded rows (``rows_form="decoded"``).  Returns
+        ``(fold, fresh)`` where ``fresh()`` allocates the round's zeroed
+        buffer (content under never-written slots is irrelevant: the
+        aggregate masks non-arrived, non-stale slots to NaN)."""
+        self._check_bounded_wait_supported()
+        codec, dt = self.codec, self.exchange_dtype
+        if codec is not None:
+            codec.validate_d(d)
+        n = self.nb_workers
+
+        del dt  # the dtype twin's row arrives ALREADY in its wire dtype
+
+        def fold(buffer, wire_row, widx):
+            if codec is not None:
+                row = codec.decode(wire_row, d)
+            else:
+                row = wire_row.astype(jnp.float32)
+            return buffer.at[widx].set(row)
+
+        # the fresh buffer commits REPLICATED like every fold output (the
+        # submission payloads carry the mesh's replicated NamedSharding),
+        # so the first fold of every round hits the same trace as the rest
+        fresh = jax.jit(
+            lambda: jnp.zeros((n, d), jnp.float32),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
+        jitted = jax.jit(fold, donate_argnums=(0,))
+        return trace.traced("bounded_fold.dispatch", jitted, cat="train"), fresh
 
 
 class ShardedRobustEngine(RobustEngine):

@@ -17,6 +17,18 @@ simply retains last step's bytes where nothing arrived.  The engine carries
 the per-worker previously-received gradients in ``TrainState.carry``
 (worker-sharded, so the (n, d) matrix never lands on one device) and
 supplies each worker's row via ``previous=``.
+
+**Ordering under a compressed exchange** (parallel/compress.py): the wire
+codec encodes/decodes BEFORE this module's masking runs — a dropped packet
+drops ENCODED bytes, so the NaN runs must land on the DECODED row image
+(``RobustEngine._perturb_local`` applies codec -> lossy in that order).
+The inverse order would be wrong two ways: masking the pre-encode row would
+let int8's per-row scale read the NaN (``max|row|`` of a NaN row is NaN),
+poisoning the WHOLE row instead of one packet run, and top-k would
+transmit the NaN coordinates as its largest magnitudes — a single lost
+datagram silently consuming the entire sparsity budget.  A dropped packet
+of int8 payload is still a NaN coordinate run, exactly this module's
+semantics (regression-pinned by tests/test_compress.py).
 """
 
 import jax
