@@ -14,6 +14,10 @@ invariants the tests only sample at the configs they happen to run:
   its declared contract (NaN tolerance, parse-time feasibility,
   participation scatter, dtype preservation) under ``eval_shape`` + tiny
   concrete probes.
+- **events** (``events_check.py``): every journal ``emit`` anywhere in the
+  package names an event type DECLARED in the ``obs/events.py`` schema
+  registry (EV001 — an undeclared or dynamic emit would raise at decision
+  time, or defeat validation entirely).
 
 Run as a CLI (``python -m aggregathor_tpu.analysis``), as tier-1 tests
 (``tests/test_analysis.py``) and from ``scripts/run_analysis.sh``.
@@ -21,7 +25,16 @@ Accepted findings live in ``baseline.json`` with per-entry justifications;
 new findings, stale entries and empty justifications all fail the gate.
 """
 
-from . import baseline, concurrency, core, gar_contract, prng, report, retrace
+from . import (
+    baseline,
+    concurrency,
+    core,
+    events_check,
+    gar_contract,
+    prng,
+    report,
+    retrace,
+)
 from .core import Finding
 
 #: name -> (module, needs_source): the checker registry the CLI and tests
@@ -32,6 +45,7 @@ CHECKERS = {
     "prng": prng,
     "concurrency": concurrency,
     "gar-contract": gar_contract,
+    "events": events_check,
 }
 
 #: finding-code prefixes owned by each checker (plus the pass's own):
@@ -43,6 +57,7 @@ CHECKER_CODES = {
     "prng": ("PK",),
     "concurrency": ("CC",),
     "gar-contract": ("GC",),
+    "events": ("EV",),
 }
 
 

@@ -24,7 +24,7 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m aggregathor_tpu.analysis",
         description="graftcheck: repo-native static analysis "
-                    "(retrace, prng, concurrency, gar-contract)",
+                    "(retrace, prng, concurrency, gar-contract, events)",
     )
     parser.add_argument("--root", default=None,
                         help="package root to scan (default: the installed "

@@ -368,6 +368,17 @@ def build_parser():
              "context (docs/observability.md); implies --worker-metrics",
     )
     parser.add_argument(
+        "--journal", default=None, metavar="JSONL",
+        help="causal run journal (obs/events.py, docs/observability.md "
+             "'The control room'): append every decision event — guardian "
+             "rollbacks/escalations, deadline-window moves, bounded-wait "
+             "timeouts/stale infill, forgery verdicts, flight post-mortems "
+             "— as typed JSONL (schema aggregathor.obs.events.v1) with "
+             "run_id, step, wall+monotonic time; cross-referenced from the "
+             "forensics report and served fleet-wide by obs/fleet.py; "
+             "host-side only, zero added recompiles; lead process only",
+    )
+    parser.add_argument(
         "--metrics-file", default=None, metavar="PATH",
         help="dump the process-wide metrics registry as Prometheus text "
              "exposition here at every summary fire and at exit (the "
@@ -570,6 +581,7 @@ def main(argv=None):
         SummaryWriter,
         trace,
     )
+    from ..obs import events as obs_events
     from ..obs import flight as obs_flight
     from ..obs import live as obs_live
     from ..obs import metrics as obs_metrics
@@ -770,6 +782,19 @@ def main(argv=None):
         trace.install(path, run_id=run_id)
         info("Span tracing to %r (run_id %s)" % (path, run_id))
 
+    # Causal run journal (obs/events.py): installed BEFORE the graph phase
+    # so escalation/deadline/forgery decisions from step 0 on land in one
+    # timeline.  Lead-only, like summaries/forensics — the decisions it
+    # records are host policy, which is lead-side by construction.
+    if args.journal and jax.process_index() == 0:
+        obs_events.install(args.journal, run_id=run_id)
+        obs_events.emit(
+            "run_start", role="train", experiment=args.experiment,
+            aggregator=args.aggregator, nb_workers=n, declared_f=f,
+            pid=os.getpid(),
+        )
+        info("Run journal to %r (run_id %s)" % (args.journal, run_id))
+
     # Guardian recovery layer (guardian/, docs/guardian.md): parsed up front
     # so a bad ladder/threshold fails before any compilation.
     from ..guardian import (
@@ -778,6 +803,7 @@ def main(argv=None):
         GuardianConfig,
         Overrides,
         Watchdog,
+        note_escalation,
     )
     from ..guardian import probe as health
 
@@ -1889,6 +1915,10 @@ def main(argv=None):
             if ledger is not None:
                 ledger.attach_flight(at_step, reason, path=path,
                                      window_summary=summary)
+            # journal cross-ref: the event points at the dump that holds
+            # the per-step evidence (one file -> the other)
+            obs_events.emit("flight_postmortem", step=at_step, reason=reason,
+                            path=path, rows=summary.get("rows", 0))
             return path
 
         # Secure submission feed (secure/submit.py): the host-side HMAC
@@ -2075,6 +2105,7 @@ def main(argv=None):
                         "overrides": overrides.describe(),
                     })
                     g_escalations.inc()
+                    note_escalation(rstep, rung, overrides)
                     if ledger is not None:
                         ledger.note_guardian(rstep, "escalation", {
                             "rung": rung.describe(),
@@ -2417,6 +2448,23 @@ def main(argv=None):
             # design, so shutdown is the only place they can land).
             flush("secure-drain", feed_pending_secure)
             flush("forensics-drain", feed_pending_forensics)
+            if args.journal and obs_events.installed() is not None:
+                # run_end closes the causal timeline BEFORE the forensics
+                # report is written, so the report's journal section counts
+                # every event of the run (incl. this one)
+                def journal_run_end():
+                    journal = obs_events.installed()
+                    obs_events.emit(
+                        "run_end", step=step, diverged=diverged,
+                        aborting=aborting,
+                        forensics=args.forensics if ledger is not None else None,
+                    )
+                    if ledger is not None:
+                        ledger.note_journal(
+                            journal.path, journal.counts_by_type()
+                        )
+
+                flush("journal-end", journal_run_end)
             if ledger is not None:
                 def save_forensics():
                     md_path = (
@@ -2440,6 +2488,13 @@ def main(argv=None):
                         info("Span trace -> %r (run_id %s)" % (written, run_id))
 
                 flush("trace", save_span_trace)
+            if args.journal and obs_events.installed() is not None:
+                def close_journal():
+                    written = obs_events.uninstall()
+                    if written:
+                        info("Run journal -> %r (run_id %s)" % (written, run_id))
+
+                flush("journal-close", close_journal)
             if live is not None:
                 flush("live-exporter", live.shutdown_all)
             perf.report()

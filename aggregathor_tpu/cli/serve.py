@@ -148,6 +148,12 @@ def build_parser():
                         help="write a Chrome trace-event JSON of the request "
                              "lifecycle spans (enqueue -> batch -> jit -> reply) "
                              "here at shutdown — Perfetto-loadable (obs/trace)")
+    parser.add_argument("--journal", default=None, metavar="JSONL",
+                        help="causal run journal (obs/events.py): append every "
+                             "serving decision — autoscale moves, weight swaps "
+                             "and their failures — as typed JSONL (schema "
+                             "aggregathor.obs.events.v1); merged fleet-wide by "
+                             "obs/fleet.py /fleet/journal")
     parser.add_argument("--run-id", default=None, metavar="ID",
                         help="run id stamped on summary lines and trace metadata "
                              "(default: generated)")
@@ -299,6 +305,13 @@ def main(argv=None):
     if args.trace_file:
         # installed BEFORE compile so the warmup's serve.jit spans land too
         trace.install(args.trace_file, run_id=run_id)
+    if args.journal:
+        from ..obs import events as obs_events
+
+        obs_events.install(args.journal, run_id=run_id)
+        obs_events.emit("run_start", role="serve",
+                        experiment=args.experiment, pid=os.getpid())
+        info("Run journal to %r (run_id %s)" % (args.journal, run_id))
 
     with Context("load"):
         experiment = models.instantiate(args.experiment, args.experiment_args)
@@ -428,6 +441,13 @@ def main(argv=None):
         watcher.close()
         server.shutdown_all()
         summaries.close()
+        if args.journal:
+            from ..obs import events as obs_events
+
+            if obs_events.installed() is not None:
+                obs_events.emit("run_end", role="serve")
+                written = obs_events.uninstall()
+                info("Run journal -> %r (run_id %s)" % (written, run_id))
         if args.trace_file:
             written = trace.uninstall(save=True)
             if written:

@@ -25,6 +25,7 @@ from .escalate import (  # noqa: F401
     RNG_PERTURB_TAG,
     EscalationLadder,
     Overrides,
+    note_escalation,
 )
 from .probe import (  # noqa: F401
     EMA_DECAY,

@@ -189,6 +189,18 @@ def _parse_rung(spec):
     )
 
 
+def note_escalation(step, rung, overrides):
+    """Journal one APPLIED escalation rung (obs/events.py): called by the
+    runner's rollback path after the rebuilt training stack is live, so the
+    event records what the run actually trains with from ``step`` on — a
+    rejected rung (infeasible under the new f, unmaskable GAR) never
+    journals.  Pure side-channel: no engine state is touched here."""
+    from ..obs import events
+
+    events.emit("guardian_escalation", step=step, rung=rung.describe(),
+                overrides=overrides.describe())
+
+
 class EscalationLadder:
     """Parsed ladder: ``rung(i)`` is the override to stack on attempt i+1
     (None past the end — later retries keep the last escalated config and

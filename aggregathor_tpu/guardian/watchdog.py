@@ -25,7 +25,7 @@ rollback count; past it the runner declares the run failed.
 
 import math
 
-from ..obs import trace
+from ..obs import events, trace
 from ..utils import parse_keyval
 from .escalate import DEFAULT_LADDER, EscalationLadder
 
@@ -121,6 +121,9 @@ class Watchdog:
                 self.recovering = False
                 trace.instant("guardian.recovered", cat="guardian", step=int(step),
                               attempts=self.attempts)
+                events.emit("guardian_recovered", step=step,
+                            attempts=self.attempts,
+                            healthy_streak=self.healthy_streak)
                 return "recovered"
             return None
         self.unhealthy_streak += 1
@@ -130,6 +133,8 @@ class Watchdog:
             self.last_reason = "non-finite loss at step %d" % step
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="non-finite")
+            events.emit("guardian_rollback_decision", step=step,
+                        reason="non-finite")
             return "rollback"
         if step >= self.cooldown_until and self.unhealthy_streak >= self.config.patience:
             self.last_reason = (
@@ -139,6 +144,9 @@ class Watchdog:
             )
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="spike", spike=float(spike))
+            events.emit("guardian_rollback_decision", step=step,
+                        reason="spike", spike=float(spike),
+                        streak=self.unhealthy_streak)
             return "rollback"
         return None
 
@@ -162,6 +170,10 @@ class Watchdog:
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="straggler_timeouts",
                           nb_timeouts=int(nb_timeouts), budget=int(budget))
+            events.emit("guardian_rollback_decision", step=step,
+                        reason="straggler_timeouts",
+                        nb_timeouts=int(nb_timeouts), budget=int(budget),
+                        streak=self.timeout_streak)
             return "rollback"
         return None
 
@@ -189,6 +201,9 @@ class Watchdog:
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="deadline_ceiling",
                           streak=int(self.ceiling_streak))
+            events.emit("guardian_rollback_decision", step=step,
+                        reason="deadline_ceiling",
+                        streak=int(self.ceiling_streak))
             return "rollback"
         return None
 
@@ -210,4 +225,7 @@ class Watchdog:
         trace.instant("guardian.rollback", cat="guardian",
                       restore_step=int(restore_step), attempt=attempt,
                       cooldown_until=int(self.cooldown_until))
+        events.emit("guardian_rollback", step=restore_step,
+                    reason=self.last_reason, attempt=attempt,
+                    cooldown_until=int(self.cooldown_until))
         return attempt

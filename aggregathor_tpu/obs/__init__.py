@@ -36,8 +36,21 @@ The device-side layer (docs/observability.md "Device-side observability"):
   ``/metrics`` + ``/status`` HTTP endpoint
 - ``slo``             regression sentinel: baseline documents (schema
   ``aggregathor.obs.slo.v1``) judged PASS/REGRESS at run end
+
+The control room (docs/observability.md "The control room"):
+
+- ``events``          causal run journal — typed, append-only JSONL
+  decision events (schema ``aggregathor.obs.events.v1``): guardian
+  rollbacks/escalations, deadline-window moves, stale infill, forgery
+  verdicts, autoscale actions, weight swaps — ONE ``emit()`` API, every
+  event type declared (graftcheck EV001 proves it statically)
+- ``fleet``           one-scrape federation — ``FleetCollector`` polls N
+  child ``/metrics`` + ``/status`` endpoints and serves
+  ``/fleet/metrics`` / ``/fleet/status`` / ``/fleet/journal`` from one
+  port; a dead instance reads ``down`` with its last sample HELD
 """
 
+from . import events  # noqa: F401
 from . import flight  # noqa: F401
 from . import live  # noqa: F401
 from . import metrics  # noqa: F401

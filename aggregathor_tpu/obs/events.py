@@ -1,0 +1,342 @@
+"""Causal run journal: typed, append-only decision events (JSONL).
+
+The decisions that steer a run — guardian escalations, deadline-window
+moves, stale infill, forgery verdicts, autoscale actions, weight swaps —
+were scattered across info lines, summary events, forensics records and
+trace instants with no single causal timeline.  The journal is that
+timeline: ONE append-only JSONL file per process (schema
+``aggregathor.obs.events.v1``), one :func:`emit` API threaded through the
+guardian, the deadline controller, bounded-wait, the secure verdicts and
+serve's autoscaler/weight-watcher, so a post-mortem starts from one file
+instead of five.
+
+Design rules (the trace.py discipline, docs/observability.md):
+
+- **Host-side only, zero compiles touched.**  Every emit is a dict + one
+  buffered line write; the jitted programs never see the journal (compile
+  counts asserted equal with it on and off, tests/test_events.py).
+- **Typed, fail-loud.**  Every event type is DECLARED in
+  :data:`EVENT_TYPES`; emitting an undeclared type raises even when no
+  journal is installed — the graftcheck EV001 probe
+  (``analysis/events_check.py``) proves the same property statically over
+  the whole package.
+- **Causally orderable.**  Every event carries the run id, the step it
+  speaks about (None for step-less serving events), a ``seq`` strictly
+  increasing per file, wall time (``t_wall``, joins across processes) and
+  monotonic time (``t_mono``, orders within one) — so ``/fleet/journal``
+  (obs/fleet.py) can merge several processes' journals into one timeline.
+- **Cross-referenced.**  Events carry pointers into the OTHER evidence
+  stores instead of duplicating them: a ``flight_postmortem`` event names
+  the dump path (obs/flight.py), ``run_end`` names the forensics report,
+  and the forensics report's ``journal`` section points back here.
+- **Near-zero cost disabled.**  ``emit`` without an installed journal is a
+  dict-membership check and a return.
+
+Non-finite floats are encoded as tagged strings (``"nan"``/``"inf"``/
+``"-inf"``, the flight-recorder idiom) so every line is strict JSON;
+:func:`decode_event` restores them.  :func:`load_journal` validates a
+whole file and is what the smoke scripts and ``/fleet/journal`` read
+through.
+
+Usage::
+
+    from aggregathor_tpu.obs import events
+    events.install("run.journal.jsonl", run_id=run_id)
+    events.emit("guardian_rollback", step=120, reason="spike", attempt=0)
+    events.uninstall()     # flush + close
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+SCHEMA = "aggregathor.obs.events.v1"
+
+#: the declared event catalog: type -> one-line meaning.  EVERY ``emit``
+#: call in the package must name one of these (enforced at runtime here
+#: and statically by graftcheck EV001); docs/observability.md "The control
+#: room" is the long-form catalog.
+EVENT_TYPES = {
+    "run_start": "a process opened its journal (role, config description)",
+    "run_end": "a process closed its journal (final step, verdict, "
+               "cross-refs to the forensics report / flight dumps)",
+    "guardian_rollback_decision": "the watchdog decided to roll back "
+                                  "(reason: non-finite / spike / "
+                                  "straggler_timeouts / deadline_ceiling)",
+    "guardian_rollback": "a rollback executed: restore step, attempt "
+                         "index, cooldown horizon",
+    "guardian_escalation": "an escalation-ladder rung applied (rung spec, "
+                           "resulting overrides)",
+    "guardian_recovered": "the run stayed healthy long enough after a "
+                          "rollback to be declared recovered",
+    "deadline_window": "the adaptive bounded-wait window moved, censored, "
+                       "or changed its at-ceiling verdict",
+    "bounded_round": "a bounded-wait round closed with timeouts, stale "
+                     "infills or skipped (still-in-flight) units",
+    "forgery_verdict": "submission tags failed HMAC verification "
+                       "(reject-and-name, secure/submit.py)",
+    "serve_autoscale": "the serving autoscaler applied a capacity-rung "
+                       "move (lanes / retired replicas)",
+    "serve_weight_swap": "the weight pipeline hot-swapped a newer "
+                         "snapshot in",
+    "serve_weight_swap_failed": "a reload was refused or failed; previous "
+                                "weights kept serving",
+    "flight_postmortem": "a flight-recorder window was dumped "
+                         "(cross-ref: the dump path holds the per-step "
+                         "evidence)",
+}
+
+#: fields every event carries; ``emit`` keyword fields may not shadow them
+BASE_FIELDS = ("schema", "type", "run_id", "seq", "step", "t_wall", "t_mono")
+
+#: the process-wide installed journal (None = journaling disabled)
+_journal = None
+
+
+def _encode(value):
+    """Strict-JSON encoding: numpy scalars/arrays unwrapped, non-finite
+    floats as tagged strings (the flight-recorder idiom — a journal must
+    keep the difference between NaN and ±inf)."""
+    if isinstance(value, dict):
+        return {str(k): _encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    if isinstance(value, np.ndarray):
+        return [_encode(v) for v in value.tolist()]
+    if isinstance(value, (bool, np.bool_)):
+        return bool(value)
+    if isinstance(value, (int, np.integer)):
+        return int(value)
+    if isinstance(value, (float, np.floating)):
+        value = float(value)
+        if value != value:
+            return "nan"
+        if value in (float("inf"), float("-inf")):
+            return "inf" if value > 0 else "-inf"
+        return value
+    if value is None or isinstance(value, str):
+        return value
+    return str(value)
+
+
+def decode_value(value):
+    """Inverse of the non-finite tagging (recursive): the exact strings
+    ``"nan"``/``"inf"``/``"-inf"`` become floats again.  Event fields that
+    legitimately hold those strings must spell them differently."""
+    if isinstance(value, dict):
+        return {k: decode_value(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_value(v) for v in value]
+    if value == "nan":
+        return float("nan")
+    if value == "inf":
+        return float("inf")
+    if value == "-inf":
+        return float("-inf")
+    return value
+
+
+def decode_event(record):
+    """A copy of one journal record with tagged non-finite floats restored."""
+    return {key: decode_value(value) for key, value in record.items()}
+
+
+class Journal:
+    """One append-only JSONL journal file.  Use the module-level
+    :func:`install` / :func:`emit` / :func:`uninstall` in application code;
+    construct directly only in tests (clocks injectable)."""
+
+    def __init__(self, path, run_id=None, wall_clock=None, mono_clock=None):
+        self.path = path
+        self.run_id = run_id
+        self._wall = wall_clock if wall_clock is not None else time.time
+        self._mono = mono_clock if mono_clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._counts = {}
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        # append mode: a journal survives the process that wrote it and a
+        # resumed run extends the same causal file instead of replacing it
+        self._fd = open(path, "a")
+
+    def emit(self, etype, step=None, **fields):
+        """Append one event; returns the written record (decoded form)."""
+        if etype not in EVENT_TYPES:
+            raise ValueError(
+                "undeclared journal event type %r (declare it in "
+                "obs.events.EVENT_TYPES; registered: %s)"
+                % (etype, ", ".join(sorted(EVENT_TYPES)))
+            )
+        clash = sorted(set(fields) & set(BASE_FIELDS))
+        if clash:
+            raise ValueError(
+                "journal event %r fields %r shadow the base fields" % (etype, clash)
+            )
+        with self._lock:
+            if self._fd is None:
+                raise ValueError(
+                    "journal %r is closed; emit of %r refused" % (self.path, etype)
+                )
+            record = {
+                "schema": SCHEMA,
+                "type": etype,
+                "run_id": self.run_id,
+                "seq": self._seq,
+                "step": None if step is None else int(step),
+                "t_wall": self._wall(),
+                "t_mono": self._mono(),
+            }
+            record.update(_encode(fields))
+            self._seq += 1
+            self._counts[etype] = self._counts.get(etype, 0) + 1
+            self._fd.write(json.dumps(record) + "\n")
+            self._fd.flush()
+        return record
+
+    def counts_by_type(self):
+        """{event_type: emitted count} for THIS journal instance — what the
+        forensics report's ``journal`` section records."""
+        with self._lock:
+            return dict(self._counts)
+
+    @property
+    def nb_events(self):
+        with self._lock:
+            return self._seq
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                self._fd.close()
+                self._fd = None
+
+
+# --------------------------------------------------------------------- #
+# module-level lifecycle (the trace.py shape)
+
+
+def install(path, run_id=None, wall_clock=None, mono_clock=None):
+    """Enable journaling process-wide, appending to ``path``.  Installing
+    over a live journal closes the old one first."""
+    global _journal
+    if _journal is not None:
+        _journal.close()
+    _journal = Journal(path, run_id=run_id, wall_clock=wall_clock,
+                       mono_clock=mono_clock)
+    return _journal
+
+
+def installed():
+    """The active journal, or None when journaling is disabled."""
+    return _journal
+
+
+def emit(etype, step=None, **fields):
+    """Append one event to the installed journal (validates the type even
+    when disabled — an undeclared emit must fail in every configuration)."""
+    journal = _journal
+    if journal is None:
+        if etype not in EVENT_TYPES:
+            raise ValueError(
+                "undeclared journal event type %r (declare it in "
+                "obs.events.EVENT_TYPES)" % (etype,)
+            )
+        return None
+    return journal.emit(etype, step=step, **fields)
+
+
+def uninstall():
+    """Disable journaling; flush + close.  Returns the journal's path (or
+    None when nothing was installed)."""
+    global _journal
+    journal, _journal = _journal, None
+    if journal is not None:
+        journal.close()
+        return journal.path
+    return None
+
+
+# --------------------------------------------------------------------- #
+# validation + load (tests, smoke scripts, /fleet/journal)
+
+
+def validate_event(record):
+    """Structural check of one journal record (encoded form).  Returns the
+    record; raises ``ValueError`` on violations."""
+    if not isinstance(record, dict):
+        raise ValueError("journal event is not an object: %r" % (record,))
+    if record.get("schema") != SCHEMA:
+        raise ValueError(
+            "expected schema %r, got %r" % (SCHEMA, record.get("schema"))
+        )
+    etype = record.get("type")
+    if etype not in EVENT_TYPES:
+        raise ValueError("undeclared journal event type %r" % (etype,))
+    if not isinstance(record.get("seq"), int) or record["seq"] < 0:
+        raise ValueError("journal event wants an int seq >= 0: %r" % (record,))
+    step = record.get("step")
+    if step is not None and not isinstance(step, int):
+        raise ValueError("journal event step must be int or null: %r" % (step,))
+    for key in ("t_wall", "t_mono"):
+        if not isinstance(record.get(key), (int, float)):
+            raise ValueError(
+                "journal event wants numeric %r: %r" % (key, record)
+            )
+    run_id = record.get("run_id")
+    if run_id is not None and not isinstance(run_id, str):
+        raise ValueError("journal event run_id must be str or null: %r" % (run_id,))
+    return record
+
+
+def load_journal(path):
+    """Load + validate one journal file.  Returns the event records in file
+    order (encoded form — see :func:`decode_event`); raises ``ValueError``
+    on schema violations or a broken ``seq`` chain: within a segment each
+    seq must be exactly the previous + 1, and a new segment (an appended
+    resume — same or different run_id) must begin at 0.  Two processes
+    interleaving appends into one file break contiguity within a line or
+    two and fail here — point concurrent writers at DISTINCT paths (the
+    fleet collector merges them)."""
+    records = []
+    with open(path) as fd:
+        for nb, line in enumerate(fd, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError("journal line %d does not parse: %s" % (nb, exc))
+            try:
+                validate_event(record)
+            except ValueError as exc:
+                raise ValueError("journal line %d: %s" % (nb, exc))
+            if records:
+                previous = records[-1]["seq"]
+                if record["seq"] not in (previous + 1, 0):
+                    raise ValueError(
+                        "journal line %d: seq %d breaks the chain "
+                        "(previous %d wants %d, or 0 for a resumed "
+                        "segment)" % (nb, record["seq"], previous,
+                                      previous + 1)
+                    )
+            elif record["seq"] != 0:
+                raise ValueError(
+                    "journal line %d: first segment must start at seq 0, "
+                    "got %d" % (nb, record["seq"])
+                )
+            records.append(record)
+    return records
+
+
+def counts_by_type(records):
+    """{event_type: count} over loaded records (load_journal output)."""
+    counts = {}
+    for record in records:
+        counts[record["type"]] = counts.get(record["type"], 0) + 1
+    return counts

@@ -150,6 +150,9 @@ class ForensicsLedger:
         #: rollback/crash: {at_step, reason, path, window} references the
         #: exact per-step evidence for the window that killed the run
         self._flight = []
+        #: the run's causal journal (obs/events.py) cross-ref: path + event
+        #: counts by type, so a post-mortem starts from ONE file
+        self._journal = None
         self._steps_observed = 0
 
     # ------------------------------------------------------------------ #
@@ -267,6 +270,18 @@ class ForensicsLedger:
             "path": path,
             "window": dict(window_summary or {}),
         })
+
+    def note_journal(self, path, counts_by_type):
+        """Cross-reference the run's causal journal (obs/events.py) in the
+        report: the path plus per-type event counts — the report says WHO
+        misbehaved, the journal says WHAT the run decided about it, and
+        each points at the other."""
+        counts = {str(k): int(v) for k, v in dict(counts_by_type).items()}
+        self._journal = {
+            "path": path,
+            "nb_events": int(sum(counts.values())),
+            "events_by_type": counts,
+        }
 
     def truncate_after(self, step):
         """Drop observations and guardian events beyond ``step`` — the
@@ -391,6 +406,7 @@ class ForensicsLedger:
                 for step, kind, payload in self._guardian
             ],
             "flight_postmortems": list(self._flight),
+            "journal": None if self._journal is None else dict(self._journal),
         }
 
     @staticmethod
@@ -507,4 +523,14 @@ def render_markdown(report):
                 event["step"], event["kind"],
                 json.dumps(event["payload"], sort_keys=True),
             ))
+    journal = report.get("journal")
+    if journal:
+        lines += ["", "## Run journal", ""]
+        lines.append("`%s` — %d event(s): %s" % (
+            journal.get("path"), journal.get("nb_events", 0),
+            ", ".join(
+                "%s x%d" % kv
+                for kv in sorted(journal.get("events_by_type", {}).items())
+            ) or "—",
+        ))
     return "\n".join(lines) + "\n"

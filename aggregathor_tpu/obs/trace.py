@@ -36,6 +36,20 @@ carries its stack depth and parent name in ``args``, and Perfetto nests
 same-thread "X" events by time containment.  All public entry points are
 thread-safe — the serving stack records from handler threads while the
 batcher thread records batches.
+
+Beyond spans: ``Tracer.track`` allocates NAMED synthetic tracks (one
+Perfetto lane per logical worker — the bounded-wait submission timelines,
+docs/observability.md "Reading a round timeline"), ``complete_at`` lays
+events onto them with explicit timestamps, and ``counter`` emits "C"
+events Perfetto renders as numeric tracks (deadline window, arrivals,
+bytes on wire per round).
+
+Two tracers pointed at ONE path no longer clobber each other: a tiny
+``<path>.claim`` sidecar carries the live writer's (writer_pid, run_id)
+from install time, and a tracer installing onto a path owned by a LIVE
+sibling writes to a pid-suffixed variant instead — while the trace file
+itself is never touched before the first real save, so a dead writer's
+completed output survives until this run actually has something to say.
 """
 
 import functools
@@ -54,6 +68,88 @@ _local = threading.local()
 #: not an OOM (at ~150 B/event this caps the buffer around 150 MB)
 MAX_EVENTS = 1_000_000
 
+#: synthetic-track tids start here, far above any OS thread id width that
+#: matters for display — named tracks (per-worker submission timelines,
+#: counter tracks) must never collide with a real thread's tid
+TRACK_TID_BASE = 1 << 48
+
+
+def _claim_path(path):
+    """The tiny sidecar holding a live tracer's (writer_pid, run_id)
+    claim on ``path``.  A SIDECAR, not the trace file itself: the claim
+    must exist from install time (or a second live tracer adopting the
+    same path goes unnoticed for the whole run) without ever touching the
+    trace file before its first real save (a metadata stub would destroy
+    a dead writer's completed trace even if this run crashes unsaved)."""
+    return path + ".claim"
+
+
+def _write_claim(path, run_id):
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = _claim_path(path) + ".tmp"
+    with open(tmp, "w") as fd:
+        json.dump({"writer_pid": os.getpid(), "run_id": run_id}, fd)
+    os.replace(tmp, _claim_path(path))
+
+
+def _claimed_by_other(path, run_id):
+    """Is ``path`` under a LIVE claim by another tracer?  True when its
+    claim sidecar names a different (writer_pid, run_id) whose process is
+    still alive (or is this very process — a sibling tracer).  A dead
+    writer's claim is stale: overwriting its output at save time is the
+    historical, expected behavior.  No sidecar = no claim."""
+    try:
+        with open(_claim_path(path)) as fd:
+            other = json.load(fd)
+    except Exception:
+        return False
+    pid, rid = other.get("writer_pid"), other.get("run_id")
+    if pid is None:
+        return False  # pre-claim-era trace: legacy file, no live writer
+    try:
+        pid = int(pid)
+    except (TypeError, ValueError):
+        return False
+    if pid == os.getpid():
+        # same process: ours only when the run_ids match AND identify a
+        # writer (two default-None tracers are indistinguishable, so they
+        # must not clobber each other — a second install in one process
+        # never overwrites the first's output)
+        return not (rid == run_id and rid is not None)
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False  # writer is gone: stale file
+    except PermissionError:
+        return True   # alive under another uid: very much a live claim
+    except OSError:
+        return False
+    return True
+
+
+def _unclaimed_path(path, run_id):
+    """``path``, or a pid-suffixed variant when another LIVE tracer owns
+    it — the fix for last-writer-wins clobbering when a train+serve pair
+    (or two runner invocations) point at the same --trace-file."""
+    if path is None or not _claimed_by_other(path, run_id):
+        return path
+    root, ext = os.path.splitext(path)
+    candidate = "%s.%d%s" % (root, os.getpid(), ext)
+    nb = 1
+    while os.path.exists(candidate) and _claimed_by_other(candidate, run_id):
+        candidate = "%s.%d-%d%s" % (root, os.getpid(), nb, ext)
+        nb += 1
+    from ..utils import warning
+
+    warning(
+        "Trace path %r is owned by another live tracer; writing to %r "
+        "instead (pass distinct --trace-file paths to silence this)"
+        % (path, candidate)
+    )
+    return candidate
+
 
 def _stack():
     stack = getattr(_local, "spans", None)
@@ -68,19 +164,31 @@ class Tracer:
     construct directly only in tests."""
 
     def __init__(self, path, run_id=None, clock=None):
-        self.path = path
+        # refuse to clobber a LIVE sibling's file: two tracers pointed at
+        # one path (train+serve pair, two runner invocations) used to
+        # silently overwrite each other through last-writer-wins os.replace
+        self.path = _unclaimed_path(path, run_id)
         self.run_id = run_id
         self._clock = clock if clock is not None else time.perf_counter
         self._epoch = self._clock()
         self._lock = threading.Lock()
         self._events = []
         self._named_threads = set()
+        self._tracks = {}
         self.dropped = 0
         self._pid = os.getpid()
         self._events.append({
             "ph": "M", "name": "process_name", "pid": self._pid, "tid": 0,
             "args": {"name": "aggregathor_tpu"},
         })
+        if self.path is not None:
+            # the claim sidecar marks this path owned by (writer_pid,
+            # run_id) from THIS instant — what _claimed_by_other of a
+            # later tracer reads before picking its own path; the trace
+            # file itself is untouched until the first real save, so a
+            # dead writer's completed trace survives a run that crashes
+            # before saving anything
+            _write_claim(self.path, run_id)
 
     # ------------------------------------------------------------------ #
 
@@ -109,6 +217,44 @@ class Tracer:
             "dur": max(dur_us, 0.0), "args": args or {},
         }, threading.get_ident())
 
+    def track(self, name):
+        """A stable synthetic track (tid + thread_name metadata) for
+        events that belong to a LOGICAL lane rather than a host thread —
+        the per-worker submission timelines (parallel/bounded.py) render
+        as one Perfetto track per worker regardless of which pool thread
+        ran the submission.  Idempotent per name."""
+        with self._lock:
+            tid = self._tracks.get(name)
+            if tid is None:
+                tid = TRACK_TID_BASE + len(self._tracks)
+                self._tracks[name] = tid
+                self._named_threads.add(tid)
+                self._events.append({
+                    "ph": "M", "name": "thread_name", "pid": self._pid,
+                    "tid": tid, "args": {"name": name},
+                })
+        return tid
+
+    def complete_at(self, name, start_us, dur_us, tid, cat="host", args=None):
+        """An "X" event on an EXPLICIT track with explicit timestamps —
+        the retrospective form ``bounded-wait`` uses to lay a round's
+        per-worker arrivals onto their tracks after the barrier closed."""
+        self._append({
+            "ph": "X", "name": name, "cat": cat, "pid": self._pid,
+            "tid": int(tid), "ts": float(start_us),
+            "dur": max(float(dur_us), 0.0), "args": args or {},
+        }, int(tid))
+
+    def counter(self, name, value, ts=None, cat="host", series="value"):
+        """A "C" (counter) event — Perfetto renders each counter name as
+        its own numeric track (the per-round deadline window, arrivals,
+        stale rows, bytes on wire).  ``ts`` defaults to now."""
+        self._append({
+            "ph": "C", "name": name, "cat": cat, "pid": self._pid,
+            "tid": 0, "ts": self.now_us() if ts is None else float(ts),
+            "args": {series: float(value)},
+        }, 0)
+
     def instant(self, name, cat="host", args=None):
         """One "i" (instant) event — discrete occurrences like a guardian
         rollback decision."""
@@ -132,6 +278,7 @@ class Tracer:
             "otherData": {
                 "producer": "aggregathor_tpu.obs.trace",
                 "run_id": self.run_id,
+                "writer_pid": self._pid,
                 "dropped_events": dropped,
             },
         }
@@ -315,4 +462,14 @@ def validate_chrome_trace(payload):
         elif event["ph"] == "i":
             if not isinstance(event.get("ts"), (int, float)):
                 raise ValueError("i event wants numeric ts: %r" % (event,))
+        elif event["ph"] == "C":
+            if not isinstance(event.get("ts"), (int, float)):
+                raise ValueError("C event wants numeric ts: %r" % (event,))
+            args = event.get("args")
+            if not isinstance(args, dict) or not args or not all(
+                isinstance(v, (int, float)) for v in args.values()
+            ):
+                raise ValueError(
+                    "C event wants a non-empty numeric args dict: %r" % (event,)
+                )
     return payload["traceEvents"]

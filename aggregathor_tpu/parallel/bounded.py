@@ -64,7 +64,7 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 import jax
 import numpy as np
 
-from ..obs import trace
+from ..obs import events, trace
 from ..utils import UserException
 
 
@@ -270,6 +270,13 @@ class BoundedWaitStep:
             for leaf in jax.tree_util.tree_leaves(params_template)
         )
         self.d = d
+        # per-submission wire bytes for the round-timeline counter track
+        # (the runner's bytes_on_wire_total twin, resolved per ROUND here)
+        from .compress import bytes_per_row
+
+        self._row_wire_bytes = bytes_per_row(
+            d, dtype=engine.exchange_dtype, codec=self.codec
+        )
         row_dtype = np.dtype(engine.exchange_dtype or np.float32)
         if self.codec is not None:
             miss_row = self.codec.payload_zeros(d)
@@ -366,6 +373,12 @@ class BoundedWaitStep:
         k = self.group_size
         return range(unit * k, (unit + 1) * k)
 
+    def _track_name(self, unit):
+        """Perfetto track name of one submission unit (zero-padded so the
+        tracks sort numerically)."""
+        label = "submesh" if self.grouped else "worker"
+        return "%s %0*d" % (label, len(str(max(self.nb_units - 1, 1))), unit)
+
     def _submit_one(self, round_id, step_idx, unit, round_begin, args):
         """Submission-thread body: injected stall, then dispatch + drain.
         Returns ``(arrival_seconds, outputs)`` or None when the round
@@ -385,6 +398,8 @@ class BoundedWaitStep:
                 self.model.delay(step_idx, w) for w in self._unit_workers(unit)
             )
             if stall:
+                tracer = trace.installed()
+                stall_t0 = tracer.now_us() if tracer is not None else 0.0
                 wake_at = time.monotonic() + stall
                 while True:
                     remaining = wake_at - time.monotonic()
@@ -393,6 +408,15 @@ class BoundedWaitStep:
                     time.sleep(min(0.05, remaining))
                     if self._closed:
                         return None
+                if tracer is not None:
+                    # the injected stall on the unit's own track, UNDER the
+                    # round's "submit" span: a straggling round's timeline
+                    # shows where the wait actually went
+                    tracer.complete_at(
+                        "stall", stall_t0, tracer.now_us() - stall_t0,
+                        tracer.track(self._track_name(unit)),
+                        cat="bounded", args={"step": step_idx},
+                    )
         with self._round_lock:
             if round_id != self._round:
                 return None  # round closed while we stalled: don't dispatch
@@ -435,6 +459,12 @@ class BoundedWaitStep:
         params, rng = state.params, state.rng
         futures, skipped = {}, []
         round_begin = time.monotonic()
+        # per-round submission timeline (docs/observability.md "Reading a
+        # round timeline"): the round's open instant on the tracer clock —
+        # arrival DELTAS (monotonic) lay each unit's submit span onto its
+        # own named track after the barrier closes
+        tracer = trace.installed()
+        round_t0_us = tracer.now_us() if tracer is not None else 0.0
         for unit in range(self.nb_units):
             prev = self._in_flight[unit]
             if prev is not None and not prev.done():
@@ -507,6 +537,15 @@ class BoundedWaitStep:
                 folded.add(fut_unit[fut])
                 nb_folds += 1
                 nb_overlapped += bool(pending)
+                if tracer is not None:
+                    # the as-rows-land fold instant on the unit's track —
+                    # what makes PR 14's overlap VISIBLE per round
+                    tracer.complete_at(
+                        "fold", tracer.now_us(), 0.0,
+                        tracer.track(self._track_name(fut_unit[fut])),
+                        cat="bounded",
+                        args={"step": step_idx, "overlapped": bool(pending)},
+                    )
 
         with trace.span("bounded_wait.collect", cat="train"):
             pending = set(futures.values())
@@ -624,10 +663,81 @@ class BoundedWaitStep:
             )
         self.timeouts_total += ~arrived
         self.stale_total += stale
+        skipped_units = set(skipped)
+        if tracer is not None:
+            # retrospective per-unit tracks: each unit's round outcome as
+            # one span from the round's open — dispatch+encode+compute
+            # bounded by the arrival (an injected stall shows as its own
+            # "stall" span inside), a miss as the full window it was given
+            close_us = tracer.now_us()
+            k = self.group_size
+            window_us = (
+                close_us - round_t0_us if deadline is None
+                else float(deadline) * 1e6
+            )
+            for unit in range(self.nb_units):
+                w0 = unit * k
+                track = tracer.track(self._track_name(unit))
+                if arrived[w0]:
+                    tracer.complete_at(
+                        "submit", round_t0_us, arrival_seconds[w0] * 1e6,
+                        track, cat="bounded", args={"step": step_idx},
+                    )
+                elif unit in skipped_units:
+                    tracer.complete_at(
+                        "skipped_round", round_t0_us, 0.0, track,
+                        cat="bounded", args={"step": step_idx},
+                    )
+                elif stale[w0]:
+                    tracer.complete_at(
+                        "stale_infill", round_t0_us, window_us, track,
+                        cat="bounded", args={
+                            "step": step_idx,
+                            "age": int(self._carry_age[w0]),
+                        },
+                    )
+                else:
+                    tracer.complete_at(
+                        "timeout", round_t0_us, window_us, track,
+                        cat="bounded", args={"step": step_idx},
+                    )
+            # per-round counter tracks: where a straggling round's wall
+            # time went, as numbers Perfetto graphs next to the tracks
+            if deadline is not None:
+                tracer.counter("bounded.deadline_window_s", float(deadline),
+                               ts=close_us, cat="bounded")
+            tracer.counter("bounded.arrivals", int(arrived.sum()),
+                           ts=close_us, cat="bounded")
+            tracer.counter("bounded.timeouts", int((~arrived).sum()),
+                           ts=close_us, cat="bounded")
+            tracer.counter("bounded.stale_rows", int(stale.sum()),
+                           ts=close_us, cat="bounded")
+            tracer.counter(
+                "bounded.bytes_on_wire",
+                int(arrived.sum()) * self._row_wire_bytes,
+                ts=close_us, cat="bounded",
+            )
+            if self.incremental:
+                tracer.counter("bounded.overlap_fraction",
+                               self.last_overlap_fraction,
+                               ts=close_us, cat="bounded")
+        if ((~arrived).any() or stale.any() or skipped_units) and was_warm:
+            # journal (obs/events.py): a round that timed someone out,
+            # infilled a stale carry or skipped an in-flight unit is a
+            # DECISION (it spent f budget); calm rounds stay off the
+            # timeline, and the compile round's arrivals measure XLA
+            events.emit(
+                "bounded_round", step=step_idx,
+                deadline_s=None if deadline is None else float(deadline),
+                nb_arrived=int(arrived.sum()),
+                timed_out=[int(w) for w in np.nonzero(~arrived)[0]],
+                stale_infill=[int(w) for w in np.nonzero(stale)[0]],
+                skipped_units=sorted(int(u) for u in skipped_units),
+            )
         if self.controller is not None and was_warm:
             # feed the controller only rounds the deadline governed (the
             # compile round's arrivals measure XLA, not the fleet)
-            self.controller.observe_round(arrival_seconds)
+            self.controller.observe_round(arrival_seconds, step=step_idx)
         if self._c_folds is not None:
             self._c_folds.inc(nb_folds)
             self._c_overlapped.inc(nb_overlapped)
@@ -660,10 +770,11 @@ class BoundedWaitStep:
             rows_in = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *rows
             )
-        return self.agg_fn(
-            state, rows_in, jnp.stack(losses),
-            jnp.asarray(arrived), jnp.asarray(stale), extras,
-        )
+        with trace.span("bounded_wait.aggregate", cat="train", step=step_idx):
+            return self.agg_fn(
+                state, rows_in, jnp.stack(losses),
+                jnp.asarray(arrived), jnp.asarray(stale), extras,
+            )
 
     def _cache_size(self):
         """Compile-count surface for the zero-recompile assertions AND the

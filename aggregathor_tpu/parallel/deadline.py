@@ -47,6 +47,7 @@ tests/test_deadline.py, no wall clock involved.
 
 import numpy as np
 
+from ..obs import events
 from ..utils import UserException
 
 #: arrival-seconds histogram buckets (sub-ms to tens of seconds — the
@@ -155,12 +156,16 @@ class DeadlineController:
         ceiling-patience streak far past its documented length."""
         return self._demand_at_ceiling
 
-    def observe_round(self, arrival_seconds):
+    def observe_round(self, arrival_seconds, step=None):
         """Feed one completed round; returns the updated window.
 
         ``arrival_seconds`` is the (n,) per-worker arrival vector: seconds
         from round open to row materialization, with non-finite entries
         (NaN/inf) for workers that missed the round's window (censored).
+        ``step`` (optional) stamps the journal's ``deadline_window`` events
+        — emitted only when the window MOVES materially, censors, or flips
+        its at-ceiling verdict, so the journal stays a decision timeline,
+        not a per-round metrics mirror.
         """
         arrivals = np.asarray(arrival_seconds, np.float64).reshape(-1)
         finite = np.isfinite(arrivals)
@@ -180,7 +185,8 @@ class DeadlineController:
             target = float((1.0 - frac) * censored[lo] + frac * censored[hi])
         else:
             target = np.inf
-        if not np.isfinite(target):
+        censored_round = not np.isfinite(target)
+        if censored_round:
             # the percentile rank touched a censored arrival: the tail the
             # controller is asked to cover is beyond what it observed, so
             # the round votes for the widest window it is allowed
@@ -190,6 +196,8 @@ class DeadlineController:
                 self._c_censored.inc()
         # demand, judged on the UNCLAMPED pre-EMA target: the escalation
         # streak must begin the round the tail outgrows the budget
+        was_at_ceiling = self._demand_at_ceiling
+        previous_window = self._window
         self._demand_at_ceiling = target >= self.ceiling * (1.0 - 1e-9)
         self._window = float(np.clip(
             (1.0 - self.ema) * self._window + self.ema * target,
@@ -199,4 +207,18 @@ class DeadlineController:
         if self._g_window is not None:
             self._g_window.set(self._window)
             self._g_ceiling.set(float(self.at_ceiling))
+        # journal (obs/events.py): window MOVES are causal decisions — a
+        # material move (>1% relative or >1 ms), a censored target or an
+        # at-ceiling flip lands on the timeline; the per-round jitter of
+        # the EMA does not
+        moved = abs(self._window - previous_window) > max(
+            0.01 * previous_window, 1e-3
+        )
+        if moved or censored_round or was_at_ceiling != self._demand_at_ceiling:
+            events.emit(
+                "deadline_window", step=step,
+                window_s=self._window, previous_s=previous_window,
+                target_s=float(target), at_ceiling=bool(self._demand_at_ceiling),
+                censored=bool(censored_round), round=int(self.rounds_observed),
+            )
         return self._window

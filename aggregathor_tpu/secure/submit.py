@@ -37,6 +37,7 @@ import time
 
 import numpy as np
 
+from ..obs import events
 from ..parallel.auth import GradientAuthenticator
 
 #: uint32 checksum lanes per row digest (16 bytes of tag material)
@@ -201,10 +202,17 @@ class SubmissionAuthenticator:
         begin = time.perf_counter()
         ok = self.auth.verify_many(step, recv, tags)
         elapsed = time.perf_counter() - begin
+        rejected = np.nonzero(~ok)[0]
         if self._c_verify is not None:
             self._c_verify.inc(elapsed)
-            for worker in np.nonzero(~ok)[0]:
+            for worker in rejected:
                 self._c_forgeries.labels(worker=str(int(worker))).inc()
+        if rejected.size:
+            # journal (obs/events.py): a failed tag is a DECISION — the row
+            # was rejected inside the f budget and the worker named
+            events.emit("forgery_verdict", step=step,
+                        workers=[int(w) for w in rejected],
+                        nb_rejected=int(rejected.size))
         self._chain = hashlib.sha256(
             self._chain + struct.pack("<q", int(step))
             + np.ascontiguousarray(tags).tobytes() + ok.tobytes()

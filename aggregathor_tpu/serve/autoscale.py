@@ -43,6 +43,7 @@ apply, and account (``serve_autoscale_*`` instruments, a tagged
 import threading
 import time
 
+from ..obs import events
 from ..obs import metrics as obs_metrics
 from ..obs import trace
 from ..utils import UserException, info, parse_keyval
@@ -365,6 +366,11 @@ class PoolAutoscaler:
         trace.instant("serve.autoscale", cat="serve", direction=direction,
                       rung=int(target), lanes=int(lanes),
                       retired=int(nb_retired))
+        events.emit("serve_autoscale",
+                    step=self.server.scheduler.batch_count,
+                    direction=direction, rung=int(target), lanes=int(lanes),
+                    retired=int(nb_retired), active_replicas=keep,
+                    reason=self.policy.last_reason)
         info("autoscale %s -> rung %d (lanes=%d, active replicas=%r): %s"
              % (direction, target, lanes, keep, self.policy.last_reason))
         if self.server.summaries is not None:

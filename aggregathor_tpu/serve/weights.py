@@ -31,6 +31,7 @@ synthetic steps without a filesystem or a sleep.
 import threading
 import time
 
+from ..obs import events
 from ..obs import metrics as obs_metrics
 from ..obs import trace
 from ..utils import UserException, info
@@ -109,6 +110,9 @@ class CheckpointWatcher:
                 info("checkpoint watcher poll failed (still serving step "
                      "%r): %s: %s"
                      % (self._served_step, type(exc).__name__, exc))
+                events.emit("serve_weight_swap_failed",
+                            step=self._served_step, phase="poll",
+                            error="%s: %s" % (type(exc).__name__, exc))
                 return None
             if not steps:
                 return None
@@ -126,11 +130,16 @@ class CheckpointWatcher:
                 self._c_failures.inc()
                 info("hot swap to step %d REFUSED (still serving step %r): "
                      "%s: %s" % (latest, previous, type(exc).__name__, exc))
+                events.emit("serve_weight_swap_failed", step=latest,
+                            phase="reload", previous=previous,
+                            error="%s: %s" % (type(exc).__name__, exc))
                 return None
             self._served_step = latest
             self._c_swaps.inc()
         trace.instant("serve.weight_swap", cat="serve", step=int(latest),
                       previous=previous if previous is None else int(previous))
+        events.emit("serve_weight_swap", step=latest, previous=previous,
+                    forced=bool(force))
         info("hot swap: serving weights of step %d (was %r)"
              % (latest, previous))
         if self.summaries is not None:

@@ -30,7 +30,7 @@ else
          "(pip install -e '.[lint]' to enable)"
 fi
 
-echo "== graftcheck: retrace + prng + concurrency + gar-contract =="
+echo "== graftcheck: retrace + prng + concurrency + gar-contract + events =="
 python -m aggregathor_tpu.analysis --check --json "$REPORT"
 
 echo "== report schema round-trip (aggregathor.analysis.report.v1) =="

@@ -9,7 +9,12 @@
 #      endpoint negotiates JSON and Prometheus),
 #   3. the forensics report NAMES the injected attacker (worker 0) over a
 #      step range overlapping the attack window,
-#   4. every summary JSONL line is stamped with the shared run_id.
+#   4. every summary JSONL line is stamped with the shared run_id,
+# then the FLEET leg (docs/observability.md "The control room"): a live
+# training run + a live serving process federated through ONE
+# FleetCollector scrape, the serve process killed mid-run and asserted
+# `down` with its last sample HELD (fleet counter sums continuous), and
+# the training run's causal journal round-tripped through load_journal.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -134,5 +139,181 @@ try:
 finally:
     server.shutdown_all()
 EOF
+
+# ---- fleet leg: two live processes on ONE scrape ---------------------- #
+# a quick checkpoint for the serving process
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 4 --nb-devices 1 \
+  --max-step 20 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/ckpt" --checkpoint-delta 20 --checkpoint-period -1 \
+  --summary-delta -1 --summary-period -1 >"$out/ckpt.log" 2>&1
+
+# a LIVE training run: exporter + causal journal + bounded-wait rounds.
+# The FIXED 0.4 s deadline (no controller: the adaptive window would
+# correctly converge past the persistent straggler and finish the run
+# before the fleet polls) keeps it alive at ~2.4 steps/s until the
+# SIGTERM below — whose flush path writes run_end into the journal.
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:8 \
+  --aggregator krum --nb-workers 4 --nb-decl-byz-workers 1 \
+  --max-step 2000 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --step-deadline 0.4 \
+  --straggler-stall 0.6 --chaos "0:straggle=1.0" --chaos-args straggle-workers:1 \
+  --run-id "${run_id}-train" --journal "$out/train.journal.jsonl" \
+  --live-port 0 --live-ready-file "$out/train.ready" \
+  >"$out/train.log" 2>&1 &
+train_pid=$!
+
+# a LIVE serving process with its own journal
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.serve \
+  --experiment digits --experiment-args batch-size:16 \
+  --ckpt-dir "$out/ckpt" --replicas 1 --gar none \
+  --max-batch 8 --lanes 1 \
+  --port 0 --ready-file "$out/serve.ready" \
+  --run-id "${run_id}-serve" --journal "$out/serve.journal.jsonl" \
+  >"$out/serve.log" 2>&1 &
+serve_pid=$!
+
+for f in train.ready serve.ready; do
+  for _ in $(seq 1 120); do [ -f "$out/$f" ] && break; sleep 0.5; done
+  [ -f "$out/$f" ] || { echo "$f never appeared"; tail "$out"/*.log; exit 1; }
+done
+train_addr=$(cat "$out/train.ready")
+read -r serve_host serve_port _serve_cli_pid < "$out/serve.ready"
+
+# the one-scrape federation point over both processes
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.obs.fleet \
+  --port 0 --ready-file "$out/fleet.ready" \
+  --poll-interval 0.4 --down-after 2 \
+  --instance "train=${train_addr// /:}" \
+  --instance "serve=$serve_host:$serve_port" \
+  --journal "train=$out/train.journal.jsonl" \
+  --journal "serve=$out/serve.journal.jsonl" \
+  >"$out/fleet.log" 2>&1 &
+fleet_pid=$!
+for _ in $(seq 1 60); do [ -f "$out/fleet.ready" ] && break; sleep 0.5; done
+[ -f "$out/fleet.ready" ] || {
+  echo "fleet collector never became ready"; tail "$out/fleet.log"
+  kill -TERM "$train_pid" "$serve_pid" 2>/dev/null || true; exit 1
+}
+read -r fleet_host fleet_port _fleet_pid < "$out/fleet.ready"
+
+python - "$out" "$fleet_host" "$fleet_port" "$serve_pid" <<'EOF'
+import json, os, signal, sys, time, urllib.request
+
+from aggregathor_tpu.obs.metrics import parse_prometheus
+
+out, host, port, serve_pid = sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+base = "http://%s:%s" % (host, port)
+
+def scrape():
+    text = urllib.request.urlopen(base + "/fleet/metrics", timeout=10).read().decode()
+    return parse_prometheus(text)
+
+def series(parsed, family):
+    return {l.get("instance"): v for _n, l, v in parsed[family]["samples"]}
+
+# both instances up on one scrape, per-instance labels + fleet sums
+parsed = None
+for _ in range(100):
+    candidate = scrape()
+    up = series(candidate, "fleet_instance_up")
+    if up.get("train") == 1.0 and up.get("serve") == 1.0:
+        parsed = candidate
+        break
+    time.sleep(0.3)
+assert parsed is not None, "both instances never read up on the fleet scrape"
+assert "serve_queue_rows" in parsed, sorted(parsed)      # serve's family
+assert "train_steps_total" in parsed, sorted(parsed)     # train's family
+steps_before = series(parsed, "train_steps_total")
+assert steps_before["_fleet"] >= 1.0, steps_before
+served_family = "serve_served_rows_total"
+served_before = series(parsed, served_family)
+assert served_before.get("serve") is not None, sorted(parsed)
+status = json.loads(urllib.request.urlopen(base + "/fleet/status", timeout=10).read())
+assert status["instances"]["train"]["up"] and status["instances"]["serve"]["up"]
+assert status["instances"]["train"]["status"]["step"] >= 1
+print("fleet scrape OK: train step %s, both instances up"
+      % status["instances"]["train"]["status"]["step"])
+
+# real traffic, so the continuity assertion below guards a NONZERO sum
+serve_url = status["instances"]["serve"]["url"]
+rows = [[[[0.0]] * 8] * 8] * 3  # 3 x (8, 8, 1) digits inputs
+req = urllib.request.Request(
+    serve_url + "/predict", json.dumps({"inputs": rows}).encode(),
+    {"Content-Type": "application/json"})
+assert json.loads(urllib.request.urlopen(req, timeout=30).read())["predictions"]
+for _ in range(100):
+    parsed = scrape()
+    served_before = series(parsed, served_family)
+    if served_before.get("serve", 0.0) >= 3.0:
+        break
+    time.sleep(0.3)
+assert served_before.get("serve", 0.0) >= 3.0, served_before
+
+# kill the serve process mid-run: it must read DOWN with its last sample
+# HELD — the fleet counter sums stay continuous, never jump backwards
+os.kill(serve_pid, signal.SIGTERM)
+down = None
+for _ in range(100):
+    candidate = scrape()
+    up = series(candidate, "fleet_instance_up")
+    if up.get("serve") == 0.0:
+        down = candidate
+        break
+    time.sleep(0.3)
+assert down is not None, "killed serve instance never read down"
+stale = series(down, "fleet_instance_stale")
+assert stale["serve"] == 1.0 and stale["train"] == 0.0, stale
+served_after = series(down, served_family)
+assert served_after["serve"] >= served_before["serve"] >= 3.0, (
+    served_before, served_after)
+assert served_after["_fleet"] >= served_before["_fleet"] >= 3.0, (
+    served_before, served_after)
+steps_after = series(down, "train_steps_total")
+assert steps_after["_fleet"] >= steps_before["_fleet"], (steps_before, steps_after)
+errors = series(down, "fleet_scrape_errors_total")
+assert errors["serve"] >= 2.0, errors
+print("down leg OK: serve down+stale, fleet sums continuous (%s -> %s)"
+      % (served_before["_fleet"], served_after["_fleet"]))
+EOF
+
+# graceful stop: the runner's flush path writes run_end into the journal
+kill -TERM "$train_pid"
+wait "$train_pid" || { echo "training run failed"; tail "$out/train.log"; exit 1; }
+wait "$serve_pid" 2>/dev/null || true
+
+python - "$out" "$fleet_host" "$fleet_port" "$run_id" <<'EOF'
+import json, os, sys, urllib.request
+
+from aggregathor_tpu.obs import events
+
+out, host, port, run_id = sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4]
+
+# the run's journal round-trips through the validator
+records = events.load_journal(os.path.join(out, "train.journal.jsonl"))
+kinds = events.counts_by_type(records)
+assert records[0]["type"] == "run_start" and records[-1]["type"] == "run_end"
+assert kinds.get("bounded_round", 0) >= 1, kinds   # the stragglers journal
+assert all(r["run_id"] == run_id + "-train" for r in records)
+serve_records = events.load_journal(os.path.join(out, "serve.journal.jsonl"))
+assert [r["type"] for r in serve_records][0] == "run_start"
+
+# and the collector merges both timelines on one endpoint
+merged = json.loads(urllib.request.urlopen(
+    "http://%s:%s/fleet/journal" % (host, port), timeout=10).read())
+assert merged["schema"] == events.SCHEMA
+assert merged["instances"]["train"]["events"] == len(records)
+instances = {r["instance"] for r in merged["events"]}
+assert instances == {"train", "serve"}, instances
+print("journal OK: %d train event(s) %s, %d serve event(s), one merged timeline"
+      % (len(records), dict(kinds), len(serve_records)))
+EOF
+
+kill -TERM "$fleet_pid" 2>/dev/null || true
+wait "$fleet_pid" 2>/dev/null || true
 
 echo "obs smoke OK: $out"

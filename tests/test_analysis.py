@@ -152,6 +152,83 @@ def test_concurrency_fixture_trips_only_concurrency(tmp_path):
     assert results["prng"] == [], results["prng"]
 
 
+EVENTS_SNIPPET = """
+    from aggregathor_tpu.obs import events
+
+
+    def good(step):
+        events.emit("run_start", step=step)            # declared: clean
+
+
+    def bad(step, kind):
+        events.emit("totally_new_event", step=step)    # EV001: undeclared
+        events.emit(kind, step=step)                   # EV001: dynamic
+        events.emit()                                  # EV001: missing
+"""
+
+
+def test_events_fixture_trips_only_events(tmp_path):
+    module = snippet_module(tmp_path, "seeded_events.py", EVENTS_SNIPPET)
+    results = run_ast_checkers(module)
+    findings = results["events"]
+    assert sorted({f.code for f in findings}) == ["EV001"], findings
+    assert {f.symbol for f in findings} == {
+        "totally_new_event", "<dynamic>", "<missing>"}, findings
+    assert all(f.scope == "bad" for f in findings)
+    assert results["retrace"] == [], results["retrace"]
+    assert results["prng"] == [], results["prng"]
+    assert results["concurrency"] == [], results["concurrency"]
+
+
+def test_events_checker_ignores_unrelated_emit(tmp_path):
+    """Other ``.emit`` attributes (signal buses, asyncio transports) are
+    never convicted: resolution is import-driven."""
+    module = snippet_module(tmp_path, "unrelated_emit.py", """
+        class Bus:
+            def emit(self, kind):
+                pass
+
+
+        def fire(bus, emit):
+            bus.emit("whatever")
+            emit("also fine")
+    """)
+    assert CHECKERS["events"].check([module]) == []
+
+
+def test_events_checker_resolves_aliased_imports(tmp_path):
+    """The runner's ``events as obs_events`` alias and the bare-function
+    import both resolve; the implementation module itself is excluded."""
+    module = snippet_module(tmp_path, "aliased.py", """
+        from aggregathor_tpu.obs import events as obs_events
+        from aggregathor_tpu.obs.events import emit
+
+
+        def f(step):
+            obs_events.emit("nope_a", step=step)
+            emit("nope_b", step=step)
+    """)
+    findings = CHECKERS["events"].check([module])
+    assert {f.symbol for f in findings} == {"nope_a", "nope_b"}
+    excluded = core.Module(str(tmp_path), "obs/events.py", textwrap.dedent("""
+        from aggregathor_tpu.obs.events import emit
+
+
+        def relay(journal, etype):
+            emit(etype)
+    """))
+    assert CHECKERS["events"].check([excluded]) == []
+
+
+def test_events_checker_whole_package_clean():
+    """Every live emit in the package names a declared type — the dynamic
+    twin of runtime validation, proven everywhere."""
+    modules, errors = core.scan_modules()
+    assert errors == []
+    findings = CHECKERS["events"].check(modules)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 class _LyingGAR(gars.GAR):
     """Seeded gar-contract violation: every declaration is false.
 

@@ -1,0 +1,664 @@
+"""Tests for the control room (docs/observability.md): the causal run
+journal (obs/events.py — schema round-trip incl. non-finite encoding,
+typed fail-loud emits, subsystem wiring), one-scrape fleet federation
+(obs/fleet.py — counter sums, per-instance labels, down-instance
+staleness, scrape-error degradation, journal merge), the per-round
+bounded-wait submission timelines (obs/trace.py tracks + counters), the
+trace-path clobber fix, and the forensics journal cross-link."""
+
+import json
+import os
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars, models
+from aggregathor_tpu.core import build_optimizer, build_schedule
+from aggregathor_tpu.obs import events, trace
+from aggregathor_tpu.obs.fleet import FleetCollector, FleetServer
+from aggregathor_tpu.obs.forensics import ForensicsLedger, render_markdown
+from aggregathor_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+from aggregathor_tpu.parallel import RobustEngine, make_mesh
+from aggregathor_tpu.parallel.bounded import BoundedWaitStep, HostStragglerModel
+from aggregathor_tpu.parallel.deadline import DeadlineController
+
+
+@pytest.fixture
+def journal(tmp_path):
+    """A process-installed journal torn down afterwards (the module global
+    must never leak into other tests)."""
+    j = events.install(str(tmp_path / "run.journal.jsonl"), run_id="jtest")
+    yield j
+    events.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _no_journal_leak():
+    yield
+    events.uninstall()
+
+
+# --------------------------------------------------------------------- #
+# journal schema round-trip
+
+
+def test_journal_roundtrip_including_nonfinite(journal):
+    events.emit("run_start", role="train", experiment="digits")
+    events.emit("deadline_window", step=4, window_s=0.25,
+                target_s=float("inf"), previous_s=float("nan"),
+                at_ceiling=True, censored=True)
+    events.emit("bounded_round", step=5, deadline_s=0.25, nb_arrived=6,
+                timed_out=[0, 1], stale_infill=[2], skipped_units=[])
+    journal.close()
+    records = events.load_journal(journal.path)
+    assert [r["type"] for r in records] == [
+        "run_start", "deadline_window", "bounded_round"]
+    assert [r["seq"] for r in records] == [0, 1, 2]
+    assert all(r["run_id"] == "jtest" for r in records)
+    assert all(r["schema"] == events.SCHEMA for r in records)
+    # non-finite floats survive the wire as tagged strings...
+    assert records[1]["target_s"] == "inf"
+    assert records[1]["previous_s"] == "nan"
+    # ...and decode back to the exact floats
+    decoded = events.decode_event(records[1])
+    assert decoded["target_s"] == float("inf")
+    assert np.isnan(decoded["previous_s"])
+    assert events.counts_by_type(records) == {
+        "run_start": 1, "deadline_window": 1, "bounded_round": 1}
+    assert journal.counts_by_type() == events.counts_by_type(records)
+
+
+def test_emit_undeclared_type_raises_installed_and_not(journal):
+    with pytest.raises(ValueError, match="undeclared"):
+        events.emit("no_such_event")
+    events.uninstall()
+    with pytest.raises(ValueError, match="undeclared"):
+        events.emit("no_such_event")  # fail-loud even when disabled
+    assert events.emit("run_start") is None  # declared + disabled: no-op
+
+
+def test_emit_rejects_base_field_shadowing(journal):
+    with pytest.raises(ValueError, match="shadow"):
+        events.emit("run_start", seq=7)
+
+
+def test_load_journal_rejects_violations(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+
+    def write(lines):
+        with open(path, "w") as fd:
+            fd.write("\n".join(json.dumps(line) for line in lines) + "\n")
+
+    base = {"schema": events.SCHEMA, "type": "run_start", "run_id": None,
+            "seq": 0, "step": None, "t_wall": 1.0, "t_mono": 1.0}
+    write([dict(base, schema="wrong.v0")])
+    with pytest.raises(ValueError, match="schema"):
+        events.load_journal(path)
+    write([dict(base, type="unknown_event")])
+    with pytest.raises(ValueError, match="undeclared"):
+        events.load_journal(path)
+    write([base, dict(base, seq=5), dict(base, seq=5)])
+    with pytest.raises(ValueError, match="seq"):
+        events.load_journal(path)
+    write([dict(base, t_wall="late")])
+    with pytest.raises(ValueError, match="t_wall"):
+        events.load_journal(path)
+    with open(path, "w") as fd:
+        fd.write("{not json\n")
+    with pytest.raises(ValueError, match="parse"):
+        events.load_journal(path)
+
+
+def test_journal_append_survives_reinstall(tmp_path):
+    """A resumed run appends to the same causal file; load accepts the
+    seq restart at the segment boundary."""
+    path = str(tmp_path / "resume.jsonl")
+    events.install(path, run_id="a")
+    events.emit("run_start")
+    events.emit("run_end")
+    events.uninstall()
+    events.install(path, run_id="b")
+    events.emit("run_start")
+    events.uninstall()
+    records = events.load_journal(path)
+    assert [r["run_id"] for r in records] == ["a", "a", "b"]
+    assert [r["seq"] for r in records] == [0, 1, 0]
+
+
+# --------------------------------------------------------------------- #
+# subsystem wiring: the decisions land on the timeline
+
+
+def test_watchdog_decisions_journal(journal):
+    from aggregathor_tpu.guardian import GuardianConfig, Watchdog
+
+    dog = Watchdog(GuardianConfig(["recover:2"]))
+    assert dog.observe(5, float("nan"), False, 0.0) == "rollback"
+    dog.note_rollback(3)
+    assert dog.observe(4, 1.0, True, 1.0) is None
+    assert dog.observe(5, 1.0, True, 1.0) == "recovered"
+    journal.close()
+    kinds = [r["type"] for r in events.load_journal(journal.path)]
+    assert kinds == ["guardian_rollback_decision", "guardian_rollback",
+                     "guardian_recovered"]
+
+
+def test_escalation_journal(journal):
+    from aggregathor_tpu.guardian import EscalationLadder, Overrides, note_escalation
+
+    ladder = EscalationLadder("f+1,gar=median")
+    overrides = ladder.rung(0).apply(Overrides(2, "krum"))
+    note_escalation(40, ladder.rung(0), overrides)
+    journal.close()
+    (record,) = events.load_journal(journal.path)
+    assert record["type"] == "guardian_escalation"
+    assert record["step"] == 40 and record["rung"] == "f+1"
+    assert "f=3" in record["overrides"]
+
+
+def test_deadline_window_moves_journal(journal):
+    """Material window moves / censoring / at-ceiling flips journal; the
+    EMA's per-round jitter does not."""
+    ctl = DeadlineController(1.0, percentile=50.0, floor=0.01, ema=1.0)
+    ctl.observe_round([0.1, 0.1, 0.1, 0.1], step=1)   # 1.0 -> 0.1: move
+    ctl.observe_round([0.1, 0.1, 0.1, 0.1], step=2)   # no move: silent
+    ctl.observe_round([np.inf] * 4, step=3)           # censored -> ceiling
+    journal.close()
+    records = events.load_journal(journal.path)
+    assert [r["step"] for r in records] == [1, 3]
+    assert records[0]["window_s"] == pytest.approx(0.1)
+    assert records[0]["at_ceiling"] is False
+    assert records[1]["censored"] is True and records[1]["at_ceiling"] is True
+
+
+def test_forgery_verdict_journal(journal):
+    from aggregathor_tpu.secure.submit import SubmissionAuthenticator
+
+    auth = SubmissionAuthenticator(b"secret", 4)
+    digests = np.arange(16, dtype="<u4").reshape(4, 4)
+    forged = np.array([False, True, False, True])
+    ok = auth.process_step(3, digests, digests, forged=forged)
+    np.testing.assert_array_equal(~ok, forged)
+    journal.close()
+    (record,) = events.load_journal(journal.path)
+    assert record["type"] == "forgery_verdict" and record["step"] == 3
+    assert record["workers"] == [1, 3] and record["nb_rejected"] == 2
+
+
+def test_weight_swap_events_journal(journal):
+    from aggregathor_tpu.serve.weights import CheckpointWatcher
+
+    registry = MetricsRegistry()
+    calls = []
+    watcher = CheckpointWatcher(lambda: [1, 2], calls.append,
+                                served_step=0, registry=registry)
+    assert watcher.check_once() == 2
+    watcher.reload = lambda step: (_ for _ in ()).throw(RuntimeError("torn"))
+    watcher.poll_steps = lambda: [3]
+    assert watcher.check_once() is None
+    watcher.close()
+    journal.close()
+    records = events.load_journal(journal.path)
+    assert [r["type"] for r in records] == [
+        "serve_weight_swap", "serve_weight_swap_failed"]
+    assert records[0]["step"] == 2 and records[0]["previous"] == 0
+    assert records[1]["phase"] == "reload" and "torn" in records[1]["error"]
+
+
+# --------------------------------------------------------------------- #
+# bounded-wait: per-round timelines + journal + zero recompiles
+
+
+def _bounded_stack(n=8, f=2, stall=0.0, rate=0.0, nb_eligible=0,
+                   deadline=0.25, exchange=None, **step_kw):
+    engine_kw = {
+        key: step_kw.pop(key)
+        for key in ("worker_momentum", "secure") if key in step_kw
+    }
+    exp = models.instantiate("digits", ["batch-size:8"])
+    gar = gars.instantiate("krum", n, f)
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=1), gar, n,
+                          exchange=exchange, **engine_kw)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    model = None
+    if stall > 0:
+        model = HostStragglerModel(n, stall, rate=rate,
+                                   nb_eligible=nb_eligible)
+    step = BoundedWaitStep(engine, exp.loss, tx,
+                           jax.device_get(state.params),
+                           deadline=deadline, straggler_model=model,
+                           **step_kw)
+    return exp, step, state
+
+
+def test_round_timeline_tracks_and_counters(tmp_path, journal):
+    """A straggling bounded-wait round lays per-worker tracks (submit /
+    stall / timeout spans) and per-round counter tracks into the trace,
+    and the round lands on the journal."""
+    trace_path = str(tmp_path / "round.trace.json")
+    trace.install(trace_path, run_id="rt")
+    try:
+        exp, step, state = _bounded_stack(
+            stall=1.0, rate=1.0, nb_eligible=2, deadline=0.2)
+        it = exp.make_train_iterator(8, seed=3)
+        try:
+            for _ in range(3):
+                state, metrics = step(state, next(it))
+            assert step.timeouts_total[:2].sum() > 0
+        finally:
+            step.close()
+    finally:
+        trace.uninstall(save=True)
+    payload = json.load(open(trace_path))
+    evs = trace.validate_chrome_trace(payload)
+    tracks = {e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "thread_name"
+              and e["args"]["name"].startswith("worker ")}
+    assert len(tracks) == 8, tracks
+    names = {e["name"] for e in evs}
+    assert {"submit", "stall", "timeout", "bounded_wait.collect",
+            "bounded_wait.aggregate"} <= names, names
+    counters = {e["name"] for e in evs if e["ph"] == "C"}
+    assert {"bounded.deadline_window_s", "bounded.arrivals",
+            "bounded.timeouts", "bounded.stale_rows",
+            "bounded.bytes_on_wire"} <= counters, counters
+    # submit spans live on the synthetic tracks, not on pool threads
+    submit_tids = {e["tid"] for e in evs if e["name"] == "submit"}
+    assert all(tid >= trace.TRACK_TID_BASE for tid in submit_tids)
+    journal.close()
+    rounds = [r for r in events.load_journal(journal.path)
+              if r["type"] == "bounded_round"]
+    assert rounds, "timed-out rounds must journal"
+    assert all(set(r["timed_out"]) <= {0, 1} for r in rounds)
+
+
+def test_all_obs_zero_recompiles(tmp_path):
+    """ACCEPTANCE: journal + timeline + int8:ef compression + secure +
+    momentum + stale infill + incremental folding — the whole control room
+    on — still compiles once per bounded executable (the instrumentation
+    is host-side by construction, asserted equal on and off)."""
+    from conftest import assert_zero_recompiles
+
+    registry = MetricsRegistry()
+    baseline_counts = {}
+    for instrumented in (False, True):
+        if instrumented:
+            events.install(str(tmp_path / "zrc.jsonl"), run_id="zrc")
+            trace.install(str(tmp_path / "zrc.trace.json"), run_id="zrc")
+        try:
+            exp, step, state = _bounded_stack(
+                exchange="int8:ef", worker_momentum=0.9, secure=True,
+                stall=0.6, rate=1.0, nb_eligible=2, stale_infill=True,
+                stale_max_age=3, incremental=True,
+                registry=registry if instrumented else None)
+            it = exp.make_train_iterator(8, seed=3)
+            try:
+                for _ in range(4):
+                    state, metrics = step(state, next(it))
+                assert_zero_recompiles(step)
+                baseline_counts[instrumented] = step._cache_size()
+                assert np.isfinite(
+                    float(jax.device_get(metrics["total_loss"])))
+            finally:
+                step.close()
+        finally:
+            if instrumented:
+                trace.uninstall(save=False)
+                events.uninstall()
+    # identical compile counts with the control room on and off
+    assert baseline_counts[False] == baseline_counts[True] == 1
+
+
+# --------------------------------------------------------------------- #
+# trace-path clobbering (satellite)
+
+
+def test_two_tracer_installs_do_not_clobber(tmp_path):
+    """Two installs on ONE path (the train+serve pair): the second lands
+    on a pid-suffixed variant; both files survive with their own run_ids.
+    The claim lives in a sidecar from INSTALL time, so the protection
+    holds even on a reused path with a pre-existing trace file."""
+    path = str(tmp_path / "shared.trace.json")
+    trace.install(path, run_id="train-run")
+    assert json.load(open(path + ".claim"))["run_id"] == "train-run"
+    with trace.span("train-span"):
+        pass
+    trace.save()
+    second = trace.install(path, run_id="serve-run")
+    with trace.span("serve-span"):
+        pass
+    suffixed = trace.uninstall(save=True)
+    assert suffixed != path and str(os.getpid()) in os.path.basename(suffixed)
+    first = json.load(open(path))
+    other = json.load(open(suffixed))
+    assert first["otherData"]["run_id"] == "train-run"
+    assert other["otherData"]["run_id"] == "serve-run"
+    assert second.path == suffixed
+    names = {e["name"] for e in first["traceEvents"]}
+    assert "train-span" in names and "serve-span" not in names
+
+
+def test_tracer_reinstall_same_identity_overwrites(tmp_path):
+    """Same (pid, run_id) re-claims its own path — the historical resume
+    behavior; a DEAD previous writer's file is overwritten too."""
+    path = str(tmp_path / "own.trace.json")
+    trace.install(path, run_id="same")
+    trace.uninstall(save=True)
+    tracer = trace.install(path, run_id="same")
+    assert tracer.path == path
+    trace.uninstall(save=True)
+    # forge a dead-writer claim sidecar: pid that cannot exist
+    json.dump({"writer_pid": 2 ** 22 + 12345, "run_id": "someone-else"},
+              open(path + ".claim", "w"))
+    tracer = trace.install(path, run_id="third")
+    assert tracer.path == path  # stale claim: overwritten, not suffixed
+    trace.uninstall(save=False)
+
+
+def test_two_default_runid_tracers_do_not_clobber(tmp_path):
+    """Two tracers with the DEFAULT run_id (None) are indistinguishable,
+    so the second must suffix rather than silently overwrite the first."""
+    path = str(tmp_path / "anon.trace.json")
+    trace.install(path)
+    trace.uninstall(save=True)
+    tracer = trace.install(path)
+    assert tracer.path != path
+    trace.uninstall(save=False)
+
+
+def test_install_preserves_dead_writers_trace_until_first_save(tmp_path):
+    """Adopting a dead writer's path must NOT stub over its completed
+    trace at install time — the old data survives until this tracer's
+    first real save (a crash before saving loses nothing)."""
+    path = str(tmp_path / "old.trace.json")
+    old = {"traceEvents": [{"ph": "i", "s": "t", "name": "old-evidence",
+                            "pid": 1, "tid": 0, "ts": 1.0, "args": {}}],
+           "otherData": {"run_id": "prior", "writer_pid": 2 ** 22 + 4321}}
+    json.dump(old, open(path, "w"))
+    json.dump({"writer_pid": 2 ** 22 + 4321, "run_id": "prior"},
+              open(path + ".claim", "w"))
+    tracer = trace.install(path, run_id="fresh")
+    assert tracer.path == path  # dead claim: adopted, not suffixed
+    names = {e["name"] for e in json.load(open(path))["traceEvents"]}
+    assert "old-evidence" in names  # still intact after install
+    trace.uninstall(save=True)
+    payload = json.load(open(path))
+    assert payload["otherData"]["run_id"] == "fresh"  # real save replaces
+
+
+def test_reused_path_with_existing_trace_still_protected(tmp_path):
+    """The claim protocol must not go inert on a REUSED path: yesterday's
+    completed (unclaimed) trace sits at the target, the first tracer
+    adopts it, and a sibling arriving mid-run must still get suffixed —
+    the sidecar claim exists even though the trace file is old."""
+    path = str(tmp_path / "reused.trace.json")
+    json.dump({"traceEvents": []}, open(path, "w"))
+    first = trace.install(path, run_id="a")
+    assert first.path == path
+    sibling = trace.Tracer(path, run_id="b")
+    assert sibling.path != path
+    trace.uninstall(save=False)
+
+
+def test_validate_chrome_trace_counter_events():
+    good = {"traceEvents": [
+        {"ph": "C", "name": "c", "pid": 1, "tid": 0, "ts": 1.0,
+         "args": {"value": 2.0}},
+    ]}
+    trace.validate_chrome_trace(good)
+    for bad_args in ({}, {"value": "x"}, None):
+        bad = {"traceEvents": [
+            {"ph": "C", "name": "c", "pid": 1, "tid": 0, "ts": 1.0,
+             "args": bad_args},
+        ]}
+        with pytest.raises(ValueError):
+            trace.validate_chrome_trace(bad)
+
+
+# --------------------------------------------------------------------- #
+# fleet federation merge math
+
+
+def _exposition(**counters):
+    lines = []
+    for name, value in counters.items():
+        kind = "counter" if name.endswith("_total") else "gauge"
+        lines += ["# HELP %s h" % name, "# TYPE %s %s" % (name, kind),
+                  "%s %s" % (name, value)]
+    return "\n".join(lines) + "\n"
+
+
+class _FakeFleet:
+    """Injectable fetch: per-instance expositions + status, kill switch."""
+
+    def __init__(self, children):
+        self.children = dict(children)
+        self.dead = set()
+
+    def fetch(self, url, timeout):
+        base = url.rsplit("/", 1)[0]
+        kind = url.rsplit("/", 1)[1].split("?")[0]
+        name = base.split("//")[1]
+        if name in self.dead:
+            raise OSError("connection refused")
+        counters, status = self.children[name]
+        return (_exposition(**counters) if kind == "metrics"
+                else json.dumps(status))
+
+
+def test_fleet_counter_sums_and_instance_labels():
+    fake = _FakeFleet({
+        "train": ({"serve_shed_requests_total": 3.0, "train_loss": 0.5},
+                  {"step": 12}),
+        "serve": ({"serve_shed_requests_total": 4.0}, {"weights_step": 9}),
+    })
+    fc = FleetCollector({"train": "train", "serve": "serve"},
+                        fetch=fake.fetch)
+    fc.poll_once()
+    parsed = parse_prometheus(fc.render_metrics())
+    shed = {l["instance"]: v
+            for _n, l, v in parsed["serve_shed_requests_total"]["samples"]}
+    assert shed == {"train": 3.0, "serve": 4.0, "_fleet": 7.0}
+    # gauges: per-instance labels, NO fleet sum (a summed gauge is a lie)
+    loss = {l["instance"]: v for _n, l, v in parsed["train_loss"]["samples"]}
+    assert loss == {"train": 0.5}
+    status = fc.status_payload()
+    assert status["instances"]["train"]["status"] == {"step": 12}
+    assert status["instances"]["serve"]["up"] is True
+
+
+def test_fleet_down_instance_holds_sample_with_staleness_marker():
+    fake = _FakeFleet({
+        "train": ({"x_total": 10.0}, {}),
+        "serve": ({"x_total": 5.0}, {}),
+    })
+    clock = {"now": 0.0}
+    fc = FleetCollector({"train": "train", "serve": "serve"},
+                        fetch=fake.fetch, down_after=2,
+                        clock=lambda: clock["now"])
+    fc.poll_once()
+    assert fc.instance_up("serve")
+    fake.dead.add("serve")
+    fc.poll_once()
+    assert fc.instance_up("serve")  # one miss < down_after
+    clock["now"] = 5.0
+    fc.poll_once()
+    assert not fc.instance_up("serve") and fc.instance_up("train")
+    parsed = parse_prometheus(fc.render_metrics())
+    up = {l["instance"]: v
+          for _n, l, v in parsed["fleet_instance_up"]["samples"]}
+    stale = {l["instance"]: v
+             for _n, l, v in parsed["fleet_instance_stale"]["samples"]}
+    assert up == {"train": 1.0, "serve": 0.0}
+    assert stale == {"train": 0.0, "serve": 1.0}
+    # the dead instance's last sample is HELD: fleet sums stay continuous
+    sums = {l["instance"]: v for _n, l, v in parsed["x_total"]["samples"]}
+    assert sums["serve"] == 5.0 and sums["_fleet"] == 15.0
+    ages = {l["instance"]: v
+            for _n, l, v in parsed["fleet_last_scrape_age_seconds"]["samples"]}
+    assert ages["serve"] == pytest.approx(5.0) and ages["train"] == 0.0
+    status = fc.status_payload()
+    assert status["instances"]["serve"]["stale"] is True
+    assert status["instances"]["serve"]["misses"] == 2
+    assert "refused" in status["instances"]["serve"]["last_error"]
+
+
+def test_fleet_scrape_error_degrades_not_raises():
+    """A garbled exposition is a per-instance miss (error counted), never
+    a poll failure — and an instance that NEVER answered is down without
+    a held sample."""
+    calls = {"n": 0}
+
+    def fetch(url, timeout):
+        if "bad" in url:
+            return "} this is not an exposition {"
+        calls["n"] += 1
+        return (_exposition(ok_total=1.0) if "/metrics" in url else "{}")
+
+    fc = FleetCollector({"good": "good", "bad": "bad"}, fetch=fetch,
+                        down_after=1)
+    fc.poll_once()
+    fc.poll_once()
+    assert fc.instance_up("good") and not fc.instance_up("bad")
+    assert fc.errors_total["bad"] == 2 and fc.errors_total["good"] == 0
+    parsed = parse_prometheus(fc.render_metrics())
+    errors = {l["instance"]: v
+              for _n, l, v in parsed["fleet_scrape_errors_total"]["samples"]}
+    assert errors == {"bad": 2.0, "good": 0.0}
+    stale = {l["instance"]: v
+             for _n, l, v in parsed["fleet_instance_stale"]["samples"]}
+    assert stale["bad"] == 0.0  # never seen: down, but nothing held
+    assert "ok_total" in parsed
+    assert parsed["fleet_polls_total"]["samples"][0][2] == 2.0
+
+
+def test_fleet_journal_merge_orders_across_instances(tmp_path):
+    clock = {"now": 100.0}
+    paths = {}
+    for name, offset in (("train", 0.0), ("serve", 0.5)):
+        path = str(tmp_path / ("%s.jsonl" % name))
+        paths[name] = path
+        journal = events.Journal(path, run_id=name,
+                                 wall_clock=lambda: clock["now"])
+        clock["now"] = 100.0 + offset
+        journal.emit("run_start", role=name)
+        clock["now"] = 102.0 + offset
+        journal.emit("run_end", role=name)
+        journal.close()
+    fc = FleetCollector({"train": "t"}, journal_paths=dict(
+        paths, ghost=str(tmp_path / "missing.jsonl")),
+        fetch=lambda url, timeout: (_ for _ in ()).throw(OSError()))
+    payload = fc.journal_payload()
+    assert payload["schema"] == events.SCHEMA
+    order = [(r["instance"], r["type"]) for r in payload["events"]]
+    assert order == [("train", "run_start"), ("serve", "run_start"),
+                     ("train", "run_end"), ("serve", "run_end")]
+    assert payload["instances"]["train"]["events"] == 2
+    assert "not written yet" in payload["instances"]["ghost"]["error"]
+
+
+def test_fleet_http_endpoints_over_live_exporter(tmp_path):
+    """Integration over real sockets: a LiveExporter child scraped through
+    a FleetServer — /fleet/metrics parses, /fleet/status reads up,
+    /fleet/journal round-trips a real journal file."""
+    from aggregathor_tpu.obs.live import LiveExporter
+
+    registry = MetricsRegistry()
+    registry.counter("demo_total", "d").inc(4)
+    child = LiveExporter(registry=registry,
+                         status_provider=lambda: {"step": 7},
+                         run_id="child")
+    host, port = child.serve_background()
+    journal_path = str(tmp_path / "fleet.jsonl")
+    events.install(journal_path, run_id="fleet-child")
+    events.emit("run_start", role="train")
+    events.uninstall()
+    fc = FleetCollector({"train": "%s:%d" % (host, port)},
+                        journal_paths={"train": journal_path})
+    server = FleetServer(fc)
+    try:
+        fc.poll_once()
+        fhost, fport = server.serve_background()
+        base = "http://%s:%d" % (fhost, fport)
+        text = urllib.request.urlopen(base + "/fleet/metrics",
+                                      timeout=10).read().decode()
+        parsed = parse_prometheus(text)
+        demo = {l["instance"]: v
+                for _n, l, v in parsed["demo_total"]["samples"]}
+        assert demo == {"train": 4.0, "_fleet": 4.0}
+        status = json.loads(urllib.request.urlopen(
+            base + "/fleet/status", timeout=10).read())
+        assert status["instances"]["train"]["up"] is True
+        assert status["instances"]["train"]["status"]["step"] == 7
+        merged = json.loads(urllib.request.urlopen(
+            base + "/fleet/journal", timeout=10).read())
+        assert merged["instances"]["train"]["events"] == 1
+        assert merged["events"][0]["type"] == "run_start"
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+    finally:
+        server.shutdown_all()
+        child.shutdown_all()
+
+
+def test_fleet_collector_validation():
+    from aggregathor_tpu.utils import UserException
+
+    with pytest.raises(UserException, match="at least one"):
+        FleetCollector({})
+    with pytest.raises(UserException, match="down_after"):
+        FleetCollector({"a": "a"}, down_after=0)
+
+
+# --------------------------------------------------------------------- #
+# forensics journal cross-link
+
+
+def test_forensics_report_journal_section():
+    ledger = ForensicsLedger(2, run_id="x")
+    ledger.observe(1, worker_sq_dist=[1.0, 1.0])
+    ledger.note_journal("/tmp/run.jsonl",
+                        {"run_start": 1, "bounded_round": np.int64(3)})
+    report = ledger.report()
+    assert report["journal"] == {
+        "path": "/tmp/run.jsonl", "nb_events": 4,
+        "events_by_type": {"run_start": 1, "bounded_round": 3}}
+    md = render_markdown(report)
+    assert "Run journal" in md and "bounded_round x3" in md
+    # no journal: the section is explicit None, not absent
+    assert ForensicsLedger(1).report()["journal"] is None
+
+
+def test_cli_journal_end_to_end(tmp_path):
+    """END-TO-END: a real runner invocation with --journal + --forensics —
+    run_start/run_end bracket the journal, the forensics report's journal
+    section counts every event, and load_journal round-trips the file."""
+    from aggregathor_tpu.cli import runner
+
+    journal_path = str(tmp_path / "run.journal.jsonl")
+    forensics_path = str(tmp_path / "forensics.json")
+    rc = runner.main([
+        "--experiment", "digits", "--experiment-args", "batch-size:8",
+        "--aggregator", "median", "--nb-workers", "4",
+        "--nb-decl-byz-workers", "1", "--max-step", "4",
+        "--learning-rate-args", "initial-rate:0.05", "--prefetch", "0",
+        "--evaluation-delta", "-1", "--evaluation-period", "-1",
+        "--run-id", "clitest", "--journal", journal_path,
+        "--forensics", forensics_path,
+    ])
+    assert rc == 0
+    records = events.load_journal(journal_path)
+    assert records[0]["type"] == "run_start"
+    assert records[0]["role"] == "train" and records[0]["nb_workers"] == 4
+    assert records[-1]["type"] == "run_end"
+    assert records[-1]["step"] == 4 and records[-1]["diverged"] is False
+    assert records[-1]["forensics"] == forensics_path
+    assert all(r["run_id"] == "clitest" for r in records)
+    report = json.load(open(forensics_path))
+    assert report["journal"]["path"] == journal_path
+    assert report["journal"]["nb_events"] == len(records)
+    assert report["journal"]["events_by_type"]["run_end"] == 1
