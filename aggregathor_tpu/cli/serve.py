@@ -37,6 +37,11 @@ unless ``--allow-unsigned`` — and ``/healthz`` reports
 zero recompiles and zero dropped requests; ``SIGHUP`` forces one reload
 now (requests keep flowing; a bad snapshot keeps the previous weights).
 
+Signals: ``SIGTERM`` drains — ``/status`` flips ``draining`` so the fleet
+router (``cli/router.py``) re-routes NEW traffic while in-flight requests
+finish; the process exits at quiescence or after ``--drain-timeout``
+(journaled as ``serve_drain``).  ``SIGINT`` stops immediately.
+
 The ``--ready-file`` handshake fires only after the bucket-ladder warmup
 compiles finish AND the front end is bound — a reader of the ready file
 never races a cold bucket with its first request.
@@ -54,6 +59,7 @@ import os
 import signal
 import sys
 import threading
+import time
 
 
 def build_parser():
@@ -159,6 +165,10 @@ def build_parser():
                              "(default: generated)")
     parser.add_argument("--request-timeout", type=float, default=60.0,
                         help="seconds a /predict handler waits on its batch")
+    parser.add_argument("--drain-timeout", type=float, default=30.0, metavar="S",
+                        help="SIGTERM drain bound: seconds to wait for in-flight "
+                             "requests to finish (the fleet router re-routes new "
+                             "traffic off a draining /status) before exiting anyway")
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed (template init)")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
     return parser
@@ -391,11 +401,42 @@ def main(argv=None):
     if args.autoscale:
         autoscaler = PoolAutoscaler(server, AutoscaleConfig(args.autoscale_args))
 
+    from ..obs import events as obs_events
+
     stop = threading.Event()
+    draining = threading.Event()
 
     def on_signal(signum, frame):
-        info("Signal %d: draining and shutting down" % signum)
+        info("Signal %d: immediate shutdown" % signum)
         stop.set()
+
+    def on_drain(signum, frame):
+        # SIGTERM = the fleet-clean exit: /status flips ``draining`` so the
+        # router stops sending NEW traffic here, in-flight requests (and any
+        # stragglers that race the scrape window) finish, and we leave at
+        # quiescence — bounded by --drain-timeout so a wedged queue cannot
+        # hold the process hostage.
+        if draining.is_set():
+            info("Signal %d: already draining; forcing shutdown" % signum)
+            stop.set()
+            return
+        draining.set()
+        info("Signal %d: draining (timeout %gs)" % (signum, args.drain_timeout))
+        server.begin_drain()
+
+        def wait_quiescent():
+            obs_events.emit("serve_drain", phase="begin",
+                            in_flight=server.scheduler.in_flight,
+                            queue_depth=server.scheduler.queue_depth)
+            deadline = time.monotonic() + args.drain_timeout
+            while time.monotonic() < deadline and not server.is_quiescent():
+                time.sleep(0.05)
+            obs_events.emit("serve_drain", phase="finished",
+                            quiescent=server.is_quiescent())
+            stop.set()
+
+        threading.Thread(target=wait_quiescent, daemon=True,
+                         name="serve-drain").start()
 
     def on_reload(signum, frame):
         # off the signal handler: a reload restores checkpoints (seconds of
@@ -407,7 +448,7 @@ def main(argv=None):
 
     previous = {
         signal.SIGINT: signal.signal(signal.SIGINT, on_signal),
-        signal.SIGTERM: signal.signal(signal.SIGTERM, on_signal),
+        signal.SIGTERM: signal.signal(signal.SIGTERM, on_drain),
         signal.SIGHUP: signal.signal(signal.SIGHUP, on_reload),
     }
     try:

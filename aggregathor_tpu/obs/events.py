@@ -87,6 +87,27 @@ EVENT_TYPES = {
     "flight_postmortem": "a flight-recorder window was dumped "
                          "(cross-ref: the dump path holds the per-step "
                          "evidence)",
+    "serve_drain": "a serving process entered (or finished) its SIGTERM "
+                   "drain: in-flight requests complete, new traffic "
+                   "re-routes through the fleet router",
+    "router_route": "the fleet router assigned (or re-assigned) a client "
+                    "to a backend FOR A CAUSE (reason: initial / "
+                    "backend_down / drain / step_pin); steady-state "
+                    "least-in-flight rebalances stay off the timeline",
+    "router_shed": "the fleet router refused admission (429): every "
+                   "healthy backend is saturated — a FLEET decision, "
+                   "never one process's registry",
+    "router_retry": "a request whose backend died mid-flight was "
+                    "re-dispatched onto a live backend (exactly once)",
+    "router_backend_down": "a backend transitioned to down (scrape "
+                           "misses or a failed forward)",
+    "router_backend_up": "a down backend recovered on a successful "
+                         "scrape and re-entered the routable pool",
+    "router_drain": "the router observed a backend draining and stopped "
+                    "routing new traffic to it",
+    "router_step_pin": "a client's weights_step pin advanced — routing "
+                       "is now constrained to backends at >= this step "
+                       "(the fleet-wide monotone-sequence guarantee)",
 }
 
 #: fields every event carries; ``emit`` keyword fields may not shadow them

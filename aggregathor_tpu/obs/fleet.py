@@ -138,9 +138,9 @@ class FleetCollector:
             targets = [i for i in self._instances.values() if i.url is not None]
         for inst in targets:
             try:
-                # explicit ?format=prometheus: the serving /metrics defaults
-                # to its historical JSON payload (the training exporter
-                # defaults to text) — the explicit form reads text from both
+                # explicit ?format=prometheus: bare /metrics serves text on
+                # every exporter since PR 16, but the explicit form also
+                # reads text from pre-16 serve processes mid-rollout
                 text = self.fetch(
                     inst.url + "/metrics?format=prometheus", self.timeout
                 )

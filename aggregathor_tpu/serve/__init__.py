@@ -32,6 +32,13 @@ blocking handler):
 - ``campaign``:   the replica-fault resilience harness (fault modes from
   ``chaos/replica_faults.py``) proving median-of-replicas serves at the
   clean bar while plain averaging degrades — now through the scheduler.
+- ``router``:     the traffic plane — :class:`FleetRouter` puts N of these
+  processes behind ONE admission port: a pure :class:`RoutingPolicy`
+  (least-in-flight, step-pin eligibility), fleet-decision shed, drain
+  re-routing, retry-once on a mid-flight backend death, and a
+  fleet-consistent ``weights_step`` guarantee (no client ever observes
+  the step go backwards across replicas).  CLI:
+  ``python -m aggregathor_tpu.cli.router``.
 
 CLI: ``python -m aggregathor_tpu.cli.serve --ckpt-dir ... --experiment ...
 --replicas R --gar median`` (see ``cli/serve.py``; docs/serving.md).
@@ -56,4 +63,10 @@ from .engine import (  # noqa: F401
     restore_params,
 )
 from .frontend import InferenceServer  # noqa: F401
+from .router import (  # noqa: F401
+    BackendView,
+    FleetRouter,
+    RouterServer,
+    RoutingPolicy,
+)
 from .weights import CheckpointWatcher  # noqa: F401

@@ -20,12 +20,19 @@ The PR-3 response contract is kept verbatim:
   CANCELLED — lanes never run dead work); **500** on an engine failure
   (the server survives).
 - ``GET /healthz``   liveness + replica/custody summary.
-- ``GET /metrics``   JSON gauge snapshot, or Prometheus text exposition via
-  ``?format=prometheus`` / an ``Accept: text/plain`` header — both reading
-  the ONE process-wide registry (``obs/metrics.py``).
+- ``GET /metrics``   Prometheus text exposition of the ONE process-wide
+  registry (``obs/metrics.py``), like the training exporter's — one scrape
+  config covers both.  The historical JSON gauge snapshot stays reachable
+  via the EXPLICIT ``?format=json``.  (Deprecation note: before PR 16 the
+  bare path defaulted to the JSON payload while training served text —
+  the format split the fleet collector had to special-case; scripts that
+  want JSON must now say so.)
 - ``GET /status``    the serving twin of the live trainer exporter's
   ``/status`` (``obs/live.py``): weights step, active replicas, lanes,
-  queue/in-flight — what the smoke's swap/autoscale legs poll.
+  queue/in-flight plus the LIVE pressure fields the fleet router
+  (``serve/router.py``) routes on — queue bound, per-scrape shed delta,
+  at-ceiling, draining — so routing never parses Prometheus text on the
+  hot path.
 
 :class:`InferenceServer` is the composite the CLI and tests drive: engine +
 continuous scheduler + this front end + the registry instruments, with the
@@ -106,6 +113,8 @@ class InferenceServer:
         self._addr = None
         self._open_connections = 0
         self.shed_rows = 0
+        self.draining = False
+        self._status_shed_seen = 0
         self._last_disagreement = [0.0] * engine.nb_replicas
         self._metric_names = [
             "serve_request_latency_seconds", "serve_shed_requests_total",
@@ -290,6 +299,18 @@ class InferenceServer:
         """Update the provenance verdict after a hot swap."""
         self.custody_verified = verdict
 
+    def begin_drain(self):
+        """Mark this process draining: ``/status`` reports it so the fleet
+        router re-routes NEW traffic while in-flight (and any stragglers
+        that race the scrape window) keep being served.  The caller
+        (cli/serve.py's SIGTERM path) waits for quiescence and exits."""
+        with self._lock:
+            self.draining = True
+
+    def is_quiescent(self):
+        """True when nothing is queued or in flight — the drain exit gate."""
+        return self.scheduler.queue_depth == 0 and self.scheduler.in_flight == 0
+
     def health_payload(self):
         return {
             "status": "ok",
@@ -304,14 +325,32 @@ class InferenceServer:
 
     def status_payload(self):
         """The serving ``/status`` body — the live handles the smoke's
-        swap/autoscale legs poll between requests."""
+        swap/autoscale legs poll between requests, and the pressure
+        surface the fleet router (``serve/router.py``) routes on.
+
+        ``shed_delta`` is the number of shed REQUESTS since the previous
+        ``/status`` read — per-scrape semantics for the one routing
+        scraper (a second concurrent scraper would split the deltas; it
+        should diff the cumulative ``shed_count`` instead).
+        ``at_ceiling`` reads the capacity truth without requiring the
+        autoscaler: the lane pool cannot grow further."""
+        sheds = self.scheduler.shed_count
+        with self._lock:
+            shed_delta = sheds - self._status_shed_seen
+            self._status_shed_seen = sheds
+            draining = self.draining
         return {
             "weights_step": self.engine.weights_step,
             "active_replicas": self.engine.active_replicas,
             "lanes": self.scheduler.nb_lanes,
             "max_lanes": self.scheduler.max_lanes,
+            "at_ceiling": self.scheduler.nb_lanes >= self.scheduler.max_lanes,
             "in_flight": self.scheduler.in_flight,
             "queue_depth": self.scheduler.queue_depth,
+            "queue_bound": self.scheduler.policy.queue_bound,
+            "shed_count": sheds,
+            "shed_delta": shed_delta,
+            "draining": draining,
             "batch_count": self.scheduler.batch_count,
             "compile_count": self.engine.compile_count,
             "custody_verified": self.custody_verified,
@@ -414,9 +453,12 @@ class InferenceServer:
         }
 
     def _wants_prometheus(self, query, headers):
-        """Format negotiation: explicit ``?format=`` wins; otherwise an
-        ``Accept`` header that asks for text/plain (and not JSON) —
-        Prometheus scrapers send ``text/plain;version=0.0.4``."""
+        """Format negotiation: explicit ``?format=`` wins; otherwise the
+        bare path serves Prometheus text — the SAME default as the
+        training exporter (obs/live.py), so one scrape config covers both.
+        An ``Accept`` header asking for JSON (and not text/plain) still
+        negotiates the JSON snapshot.  (The historical bare-path JSON
+        default is retired; say ``?format=json`` explicitly.)"""
         fmt = urllib.parse.parse_qs(query).get("format", [None])[0]
         if fmt is not None:
             if fmt not in ("json", "prometheus"):
@@ -425,7 +467,7 @@ class InferenceServer:
                 )
             return fmt == "prometheus"
         accept = headers.get("accept", "")
-        return "text/plain" in accept and "application/json" not in accept
+        return not ("application/json" in accept and "text/plain" not in accept)
 
     async def _route(self, method, target, headers, body):
         """-> (code, content_type, body_str)."""

@@ -1,0 +1,206 @@
+#!/usr/bin/env bash
+# Fleet traffic-plane smoke on CPU (<60 s): the PR-16 story end to end
+# through the real CLIs (docs/serving.md "The traffic plane").
+#
+#   1. train a tiny digits model -> checkpoint stream (steps 20, 40)
+#   2. fleet: TWO cli.serve backends following the same directory, ONE
+#      cli.router admission port in front (real processes, real HTTP)
+#   3. traffic leg: sticky closed-loop clients through the router; one
+#      backend SIGKILLed MID-RUN -> zero dropped requests, zero
+#      weights_step regressions per client
+#   4. swap leg: extend training -> the surviving backend hot-swaps; the
+#      router's step pin follows, responses serve the new step
+#   5. scrape leg: a FleetCollector scrapes the ROUTER like any other
+#      instance (bare /metrics is Prometheus since PR 16)
+#   6. journal leg: the router journal replays the causal kill chain
+#      (router_backend_down -> router_retry/route) and EV001-clean types
+#   7. drain leg: SIGTERM on the surviving backend exits cleanly through
+#      the drain path (serve_drain journaled)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_fleet_smoke}"
+rm -rf "$out"
+mkdir -p "$out"
+
+# ---- 1. train -> checkpoint stream (steps 20, 40)
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 4 --nb-devices 1 \
+  --max-step 40 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/ckpt" --checkpoint-delta 20 --checkpoint-period -1 \
+  --summary-delta -1 --summary-period -1
+
+# ---- 2. the fleet: two backends + the router, all real processes
+start_backend() {
+  JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.serve \
+    --experiment digits --experiment-args batch-size:16 \
+    --ckpt-dir "$out/ckpt" --replicas 1 --gar none \
+    --max-batch 8 --queue-bound 256 --lanes 2 \
+    --follow --follow-interval 0.3 --drain-timeout 10 \
+    --port 0 --ready-file "$out/ready_$1" \
+    --journal "$out/journal_$1.jsonl" --run-id "smoke-$1" \
+    > "$out/log_$1.txt" 2>&1 &
+  echo $!
+}
+pid_a=$(start_backend a)
+pid_b=$(start_backend b)
+trap 'kill -9 "$pid_a" "$pid_b" "$router_pid" 2>/dev/null || true' EXIT
+for _ in $(seq 1 90); do
+  [ -f "$out/ready_a" ] && [ -f "$out/ready_b" ] && break; sleep 1
+done
+[ -f "$out/ready_a" ] && [ -f "$out/ready_b" ] || {
+  echo "backends never became ready"; exit 1; }
+addr_a=$(awk '{print $1 ":" $2}' "$out/ready_a")
+addr_b=$(awk '{print $1 ":" $2}' "$out/ready_b")
+
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.router \
+  --backend "a=$addr_a" --backend "b=$addr_b" \
+  --port 0 --ready-file "$out/ready_router" --poll-interval 0.1 \
+  --down-after 2 --journal "$out/journal_router.jsonl" \
+  --run-id smoke-router &
+router_pid=$!
+for _ in $(seq 1 30); do [ -f "$out/ready_router" ] && break; sleep 0.5; done
+[ -f "$out/ready_router" ] || { echo "router never became ready"; exit 1; }
+
+# ---- 3+4+5. traffic with a mid-run kill, the swap, the router scrape
+JAX_PLATFORMS=cpu python - "$out" "$pid_b" <<'EOF'
+import json, os, signal, sys, threading, time, urllib.error, urllib.request
+
+out, victim_pid = sys.argv[1], int(sys.argv[2])
+host, port, _pid = open("%s/ready_router" % out).read().split()
+base = "http://%s:%s" % (host, port)
+body = json.dumps({"inputs": [[0.0] * 64] * 4}).encode()
+
+counts = {"ok": 0, "shed": 0, "dropped": 0}
+steps = {}
+lock = threading.Lock()
+stop_at = time.monotonic() + 3.0
+
+def client(name):
+    request = urllib.request.Request(
+        base + "/predict", data=body,
+        headers={"Content-Type": "application/json", "X-Client-Id": name})
+    seq = []
+    while time.monotonic() < stop_at:
+        try:
+            with urllib.request.urlopen(request, timeout=30) as response:
+                code, payload = response.status, json.loads(response.read())
+        except urllib.error.HTTPError as exc:
+            code, payload = exc.code, {}
+        except Exception:
+            code, payload = -1, {}
+        with lock:
+            if code == 200:
+                counts["ok"] += 1
+                seq.append(payload.get("weights_step"))
+            elif code == 429:
+                counts["shed"] += 1
+            else:
+                counts["dropped"] += 1
+    with lock:
+        steps[name] = seq
+
+threads = [threading.Thread(target=client, args=("c%d" % i,))
+           for i in range(4)]
+for thread in threads: thread.start()
+time.sleep(0.8)
+os.kill(victim_pid, signal.SIGKILL)   # one backend dies under live traffic
+for thread in threads: thread.join()
+
+assert counts["dropped"] == 0, (counts, "a mid-run kill dropped requests")
+assert counts["ok"] > 0, counts
+for name, seq in steps.items():
+    assert all(a <= b for a, b in zip(seq, seq[1:])), (
+        "client %s observed weights_step regress: %r" % (name, seq))
+print("traffic leg OK: %d ok / %d shed / 0 dropped across the kill"
+      % (counts["ok"], counts["shed"]))
+
+# the router /status knows the fleet: a up, b down
+with urllib.request.urlopen(base + "/status", timeout=10) as response:
+    status = json.loads(response.read())
+assert status["role"] == "router", status
+deadline = time.monotonic() + 5.0
+while status["backends"]["b"]["up"] and time.monotonic() < deadline:
+    time.sleep(0.2)
+    with urllib.request.urlopen(base + "/status", timeout=10) as response:
+        status = json.loads(response.read())
+assert status["backends"]["a"]["up"] and not status["backends"]["b"]["up"], status
+
+# swap leg: extend training -> the survivor hot-swaps, the pin follows
+os.system(
+    "JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner"
+    " --experiment digits --experiment-args batch-size:16"
+    " --aggregator average --nb-workers 4 --nb-devices 1"
+    " --max-step 60 --learning-rate-args initial-rate:0.05 --prefetch 0"
+    " --evaluation-delta -1 --evaluation-period -1"
+    " --checkpoint-dir %s/ckpt --checkpoint-delta 20 --checkpoint-period -1"
+    " --summary-delta -1 --summary-period -1 > /dev/null" % out)
+request = urllib.request.Request(
+    base + "/predict", data=body,
+    headers={"Content-Type": "application/json", "X-Client-Id": "c0"})
+deadline = time.monotonic() + 20.0
+served = None
+while time.monotonic() < deadline:
+    with urllib.request.urlopen(request, timeout=30) as response:
+        served = json.loads(response.read())["weights_step"]
+    if served == 60:
+        break
+    time.sleep(0.25)
+assert served == 60, "router never served the swapped step (still %r)" % served
+print("swap leg OK: weights_step 60 live through the router")
+
+# scrape leg: the router is itself a fleet instance (PR-16 bare-Prometheus)
+from aggregathor_tpu.obs.fleet import FleetCollector
+fc = FleetCollector({"router": "%s:%s" % (host, port)})
+fc.poll_once()
+assert fc.instance_up("router")
+scraped = fc.status_payload()["instances"]["router"]["status"]
+assert scraped["role"] == "router" and scraped["backends"]["a"]["known_step"] == 60
+print("scrape leg OK: FleetCollector reads the router like any instance")
+EOF
+
+# ---- 6. journal leg: the causal kill chain, typed and EV001-clean
+kill "$router_pid"
+for _ in $(seq 1 20); do kill -0 "$router_pid" 2>/dev/null || break; sleep 0.5; done
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import sys
+from aggregathor_tpu.obs import events
+
+out = sys.argv[1]
+records = events.load_journal("%s/journal_router.jsonl" % out)
+downs = [r for r in records
+         if r["type"] == "router_backend_down" and r["backend"] == "b"]
+moved = [r for r in records
+         if r["type"] == "router_retry"
+         or (r["type"] == "router_route" and r.get("reason") == "backend_down")]
+assert downs, "no router_backend_down for the killed backend"
+assert any(r["seq"] > downs[0]["seq"] for r in moved), (
+    "journal does not replay the kill -> reroute chain")
+assert records[0]["type"] == "run_start" and records[-1]["type"] == "run_end"
+print("journal leg OK: kill -> reroute chain replays (%d records)"
+      % len(records))
+EOF
+
+# ---- 7. drain leg: SIGTERM exits the survivor through the drain path
+kill "$pid_a"
+for _ in $(seq 1 30); do kill -0 "$pid_a" 2>/dev/null || break; sleep 0.5; done
+if kill -0 "$pid_a" 2>/dev/null; then
+  echo "backend ignored SIGTERM (drain wedged)"; exit 1
+fi
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import sys
+from aggregathor_tpu.obs import events
+
+out = sys.argv[1]
+records = events.load_journal("%s/journal_a.jsonl" % out)
+drains = [r for r in records if r["type"] == "serve_drain"]
+phases = [r["phase"] for r in drains]
+assert phases == ["begin", "finished"], phases
+assert drains[-1]["quiescent"] is True, drains[-1]
+print("drain leg OK: serve_drain begin -> finished (quiescent)")
+EOF
+trap - EXIT
+
+echo "fleet smoke PASSED"

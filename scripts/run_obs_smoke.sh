@@ -116,8 +116,14 @@ try:
         base + "/predict", json.dumps({"inputs": rows}).encode(),
         {"Content-Type": "application/json"})
     assert json.loads(urllib.request.urlopen(req, timeout=10).read())["predictions"]
-    # JSON payload: byte-compatible keys the serve smoke scripts parse
-    metrics = json.loads(urllib.request.urlopen(base + "/metrics", timeout=10).read())
+    # bare /metrics serves Prometheus text on BOTH exporters since PR 16
+    # (the training exporter always did; serve's historical JSON default
+    # is retired) — one scrape config covers train + serve + router
+    bare = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+    assert "serve_compile_count" in bare and parse_prometheus(bare)
+    # the JSON payload stays reachable through the EXPLICIT format
+    metrics = json.loads(urllib.request.urlopen(
+        base + "/metrics?format=json", timeout=10).read())
     for key in ("queue_depth", "latency_ms", "served_rows", "compile_count"):
         assert key in metrics, (key, sorted(metrics))
     assert metrics["served_rows"] >= 3 and metrics["latency_ms"]["p95"] is not None

@@ -122,7 +122,7 @@ status = get("/status")
 assert status["weights_step"] == step, status
 assert status["compile_count"] == 4, status  # ladder 1,2,4,8 compiled once
 
-metrics = get("/metrics")
+metrics = get("/metrics?format=json")
 assert metrics["shed_count"] > 0, metrics
 p95 = metrics["latency_ms"]["p95"]
 assert p95 is not None and np.isfinite(p95), metrics

@@ -560,6 +560,9 @@ def test_fleet_journal_merge_orders_across_instances(tmp_path):
     assert "not written yet" in payload["instances"]["ghost"]["error"]
 
 
+@pytest.mark.slow  # scrape-over-sockets re-proved in tier 1 by
+# tests/test_router.py::test_serve_metrics_format_unification (FleetCollector
+# against a live serve exporter) and the run_fleet_smoke.sh scrape leg
 def test_fleet_http_endpoints_over_live_exporter(tmp_path):
     """Integration over real sockets: a LiveExporter child scraped through
     a FleetServer — /fleet/metrics parses, /fleet/status reads up,

@@ -1,0 +1,613 @@
+"""Traffic-plane tests (serve/router.py): the pure RoutingPolicy, the
+synthetic-clock FleetRouter (staggered swaps with a pinned client proven
+never to observe weights_step go backwards, backend-death retry-once
+idempotence, fleet-decision shed, drain re-routing — no sockets, no
+sleeps), the serve /status pressure-field shape pin, the PR-16 /metrics
+format unification compat, and one real-socket RouterServer round trip."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from aggregathor_tpu.obs import events
+from aggregathor_tpu.obs.fleet import FleetCollector
+from aggregathor_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+from aggregathor_tpu.serve import (
+    BackendView,
+    FleetRouter,
+    RouterServer,
+    RoutingPolicy,
+)
+from aggregathor_tpu.utils import UserException
+
+
+@pytest.fixture
+def journal(tmp_path):
+    """A process-installed journal torn down afterwards."""
+    path = str(tmp_path / "router.journal.jsonl")
+    events.install(path, run_id="rtest")
+    yield path
+    events.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _no_journal_leak():
+    yield
+    events.uninstall()
+
+
+def _view(**kw):
+    base = dict(name="a", up=True, draining=False, in_flight=0,
+                queue_depth=0, queue_bound=8, at_ceiling=False,
+                known_step=None)
+    base.update(kw)
+    return BackendView(**base)
+
+
+# --------------------------------------------------------------------- #
+# the pure policy (clockless, socketless)
+
+
+def test_policy_least_in_flight_with_name_tiebreak():
+    policy = RoutingPolicy()
+    assert policy.route([_view(name="a", in_flight=3),
+                         _view(name="b", in_flight=1)]) == "b"
+    # deterministic tie-break: lexical name
+    assert policy.route([_view(name="b"), _view(name="a")]) == "a"
+    assert policy.route([]) is None
+
+
+def test_policy_admission_is_a_fleet_verdict():
+    policy = RoutingPolicy()
+    saturated = _view(name="a", queue_depth=8, queue_bound=8)
+    free = _view(name="b")
+    # one free backend admits the fleet
+    assert policy.admit([saturated, free])
+    # every path to refusal: saturated, down, draining
+    assert not policy.admit([saturated])
+    assert not policy.admit([_view(up=False)])
+    assert not policy.admit([_view(draining=True)])
+    # unknown bound reads as unbounded (a pre-16 backend mid-rollout)
+    assert policy.admit([_view(queue_depth=10**6, queue_bound=None)])
+
+
+def test_policy_step_pin_gates_eligibility():
+    policy = RoutingPolicy()
+    behind = _view(name="a", known_step=3)
+    ahead = _view(name="b", known_step=7, in_flight=5)
+    # unpinned: least in-flight wins regardless of step
+    assert policy.route([behind, ahead]) == "a"
+    # pinned: only backends KNOWN at >= pin are eligible, load second
+    assert policy.route([behind, ahead], pin=5) == "b"
+    # an unobserved step (None) can never satisfy a pin
+    assert policy.route([_view(known_step=None)], pin=1) is None
+    # pin starvation: capacity exists, nobody is at the pin -> None
+    assert policy.route([behind], pin=5) is None
+
+
+# --------------------------------------------------------------------- #
+# the synthetic fleet: scripted fetch/post, hand-cranked clock
+
+
+class _FakeBackend:
+    def __init__(self, step=0, queue_bound=8):
+        self.step = step
+        self.queue_bound = queue_bound
+        self.queue_depth = 0
+        self.draining = False
+        self.dead = False          # scrape AND forwards refuse
+        self.die_next_posts = 0    # forwards die mid-flight, scrape fine
+        self.shed_next_posts = 0   # forwards answer 429, scrape fine
+        self.posts = 0
+
+
+class _FakeNet:
+    """The wire, scripted: the router's fetch (scrape) and post (forward)
+    both resolve http://NAME/... against these backends."""
+
+    def __init__(self, backends):
+        self.backends = dict(backends)
+
+    def _named(self, url):
+        return self.backends[url.split("//")[1].split("/")[0]]
+
+    def fetch(self, url, timeout):
+        backend = self._named(url)
+        if backend.dead:
+            raise OSError("connection refused")
+        if "/metrics" in url:
+            return "serve_compile_count 3\n"
+        return json.dumps({
+            "weights_step": backend.step,
+            "queue_depth": backend.queue_depth,
+            "queue_bound": backend.queue_bound,
+            "in_flight": 0, "draining": backend.draining,
+            "at_ceiling": False,
+        })
+
+    def post(self, url, body, timeout):
+        backend = self._named(url)
+        backend.posts += 1
+        if backend.dead:
+            raise ConnectionError("connection refused")
+        if backend.die_next_posts > 0:
+            backend.die_next_posts -= 1
+            raise ConnectionError("died mid-flight")
+        if backend.shed_next_posts > 0:
+            backend.shed_next_posts -= 1
+            return 429, b'{"error": "shed"}'
+        return 200, json.dumps({
+            "predictions": [1], "weights_step": backend.step,
+        }).encode()
+
+
+def _make_router(net, names, clock=None, **kwargs):
+    clock = clock if clock is not None else {"now": 0.0}
+
+    def sleep(seconds):
+        clock["now"] += seconds
+
+    router = FleetRouter(
+        {name: name for name in names}, registry=MetricsRegistry(),
+        fetch=net.fetch, post=net.post, down_after=1,
+        clock=lambda: clock["now"], sleep=sleep, **kwargs,
+    )
+    return router, clock
+
+
+def _types(path):
+    return [r["type"] for r in events.load_journal(path)]
+
+
+def test_pinned_client_never_observes_step_regression(journal):
+    """THE traffic-plane guarantee, on staggered swaps: backend b swaps
+    ahead while a lags; a client pushed onto b (a died) is pinned there —
+    a's revival at the OLD step cannot pull the client backwards, and the
+    pin releases only once a catches up."""
+    net = _FakeNet({"a": _FakeBackend(step=10), "b": _FakeBackend(step=10)})
+    router, _clock = _make_router(net, ("a", "b"))
+    router.poll_once()
+    observed = []
+
+    def ask(client="c1"):
+        code, payload = router.handle_predict(b"{}", client_id=client)
+        assert code == 200, payload
+        observed.append(payload["weights_step"])
+        return payload["backend"]
+
+    assert ask() == "a"                      # tie-break: both @10
+    net.backends["b"].step = 11              # b swaps first (staggered)
+    net.backends["a"].dead = True            # a dies
+    router.poll_once()
+    assert ask() == "b"                      # pushed forward: pin -> 11
+    net.backends["a"].dead = False           # a revives STILL AT 10
+    router.poll_once()
+    assert ask() == "b"                      # pin excludes the stale a
+    assert ask() == "b"
+    net.backends["a"].step = 12              # a leapfrogs (its own swap)
+    router.poll_once()
+    assert ask() == "a"                      # eligible again, least name
+    assert observed == sorted(observed), observed  # never backwards
+    assert observed == [10, 11, 11, 11, 12]
+
+    types = _types(journal)
+    assert "router_backend_down" in types and "router_backend_up" in types
+    pins = [r for r in events.load_journal(journal)
+            if r["type"] == "router_step_pin"]
+    assert [p["pin"] for p in pins] == [10, 11, 12]
+    routes = [r for r in events.load_journal(journal)
+              if r["type"] == "router_route"]
+    # only CAUSED assignment changes journal; the final least-in-flight
+    # move back to the caught-up a is steady-state and stays off the
+    # timeline (the PR-15 calm-rounds discipline)
+    assert [r["reason"] for r in routes] == ["initial", "backend_down"]
+
+
+def test_swap_window_waits_then_serves_consistent(journal):
+    """A pinned request arriving mid-swap (nobody yet at the pin) waits
+    for the fleet to catch up instead of serving a step that could read
+    backwards."""
+    net = _FakeNet({"a": _FakeBackend(step=10), "b": _FakeBackend(step=10)})
+    router, clock = _make_router(net, ("a", "b"), step_wait_s=5.0)
+    router.poll_once()
+    code, payload = router.handle_predict(b"{}", client_id="c1")
+    assert code == 200 and payload["weights_step"] == 10
+    # force the pin ahead of the whole fleet (as if the client's previous
+    # backend served 11 then vanished): simulate by a quick b swap+death
+    net.backends["b"].step = 11
+    net.backends["a"].dead = True
+    router.poll_once()
+    assert router.handle_predict(b"{}", client_id="c1")[1]["weights_step"] == 11
+    net.backends["b"].dead = True
+    net.backends["a"].dead = False           # only the STALE backend lives
+    router.poll_once()
+
+    # the swap window resolves: a reaches 11 after ~0.1s of waiting
+    release_at = clock["now"] + 0.1
+    real_fetch = net.fetch
+
+    def fetch(url, timeout):
+        if clock["now"] >= release_at:
+            net.backends["a"].step = 11
+        return real_fetch(url, timeout)
+
+    router.collector.fetch = fetch
+    code, payload = router.handle_predict(b"{}", client_id="c1")
+    assert code == 200
+    assert payload["weights_step"] == 11 and payload["backend"] == "a"
+
+
+def test_swap_window_timeout_prefers_consistency(journal):
+    """If the fleet NEVER reaches the pin inside step_wait_s, the router
+    answers 503 rather than break the monotone guarantee (consistency
+    over availability, bounded)."""
+    net = _FakeNet({"a": _FakeBackend(step=10), "b": _FakeBackend(step=11)})
+    router, _clock = _make_router(net, ("a", "b"), step_wait_s=1.0)
+    net.backends["a"].dead = True            # pin the client on b @11
+    router.poll_once()
+    assert router.handle_predict(b"{}", client_id="c1")[1]["weights_step"] == 11
+    net.backends["a"].dead = False           # the stale a is all that's left
+    net.backends["b"].dead = True            # the only >=11 backend dies
+    router.poll_once()
+    code, payload = router.handle_predict(b"{}", client_id="c1")
+    assert code == 503 and "pinned step" in payload["error"]
+    # an UNpinned client is untouched: a serves it at 10
+    code, payload = router.handle_predict(b"{}", client_id="fresh")
+    assert code == 200 and payload["weights_step"] == 10
+
+
+def test_backend_death_mid_flight_retries_exactly_once(journal):
+    """A forward that dies on the wire re-dispatches onto a live backend
+    exactly once (idempotent /predict), latches the dead backend out
+    ahead of the scrape, and the client sees ONE 200."""
+    net = _FakeNet({"a": _FakeBackend(step=5), "b": _FakeBackend(step=5)})
+    router, _clock = _make_router(net, ("a", "b"))
+    router.poll_once()
+    net.backends["a"].die_next_posts = 1
+    code, payload = router.handle_predict(b"{}", client_id="c1")
+    assert code == 200 and payload["backend"] == "b"
+    assert net.backends["a"].posts == 1 and net.backends["b"].posts == 1
+    # the dead backend is OUT immediately — no scrape needed
+    assert not [v for v in router.views() if v.name == "a" and v.up]
+    types = _types(journal)
+    assert types.count("router_retry") == 1
+    assert "router_backend_down" in types
+    # and exactly once means ONCE: a second mid-flight death -> 502
+    net.backends["a"].dead = True
+    net.backends["b"].die_next_posts = 1
+    router.poll_once()
+    net.backends["b"].dead = True
+    net.backends["b"].die_next_posts = 0
+    code, payload = router.handle_predict(b"{}", client_id="c2")
+    assert code in (502, 503)
+
+
+def test_shed_is_a_fleet_decision(journal):
+    """One saturated backend does NOT shed the fleet; 429 fires only when
+    every healthy backend is at its bound — and a per-request backend 429
+    (the race since the last scrape) re-routes before giving up."""
+    net = _FakeNet({"a": _FakeBackend(step=1, queue_bound=4),
+                    "b": _FakeBackend(step=1, queue_bound=4)})
+    router, _clock = _make_router(net, ("a", "b"))
+    router.poll_once()
+    net.backends["a"].queue_depth = 4        # a saturated
+    router.poll_once()
+    code, payload = router.handle_predict(b"{}", client_id="c1")
+    assert code == 200 and payload["backend"] == "b"
+    net.backends["b"].queue_depth = 4        # whole fleet saturated
+    router.poll_once()
+    code, payload = router.handle_predict(b"{}", client_id="c1")
+    assert code == 429 and payload["error"] == "shed"
+    assert _types(journal).count("router_shed") == 1
+    # the race: scrape says free, the forward sheds -> other backend wins
+    net.backends["a"].queue_depth = net.backends["b"].queue_depth = 0
+    router.poll_once()
+    net.backends["a"].shed_next_posts = 1
+    net.backends["b"].shed_next_posts = 0
+    codes = {router.handle_predict(b"{}", client_id="c%d" % i)[0]
+             for i in range(2)}
+    assert codes == {200}
+
+
+def test_drain_reroutes_new_traffic(journal):
+    """A draining backend (SIGTERM'd serve) takes no NEW traffic; its
+    clients re-route with reason=drain; recovery re-admits it."""
+    net = _FakeNet({"a": _FakeBackend(step=2), "b": _FakeBackend(step=2)})
+    router, _clock = _make_router(net, ("a", "b"))
+    router.poll_once()
+    assert router.handle_predict(b"{}", client_id="c1")[1]["backend"] == "a"
+    net.backends["a"].draining = True
+    router.poll_once()
+    assert router.handle_predict(b"{}", client_id="c1")[1]["backend"] == "b"
+    assert net.backends["a"].posts == 1      # no new traffic to a
+    journal_types = _types(journal)
+    assert journal_types.count("router_drain") == 1
+    routes = [r for r in events.load_journal(journal)
+              if r["type"] == "router_route"]
+    assert routes[-1]["reason"] == "drain"
+    # both draining/down -> 503, not a hang
+    net.backends["b"].dead = True
+    router.poll_once()
+    assert router.handle_predict(b"{}", client_id="c1")[0] == 503
+
+
+def test_router_status_payload_shape():
+    net = _FakeNet({"a": _FakeBackend(step=4)})
+    router, _clock = _make_router(net, ("a",))
+    router.poll_once()
+    router.handle_predict(b"{}", client_id="c1")
+    payload = router.status_payload()
+    assert payload["role"] == "router"
+    assert payload["sessions"] == 1 and payload["polls"] == 1
+    entry = payload["backends"]["a"]
+    assert set(entry) == {"url", "up", "draining", "in_flight",
+                          "dispatched", "failures", "known_step",
+                          "queue_depth", "queue_bound", "at_ceiling"}
+    assert entry["up"] is True and entry["known_step"] == 4
+    assert entry["dispatched"] == 1 and entry["in_flight"] == 0
+    # constructor validation while we are here
+    with pytest.raises(UserException):
+        FleetRouter({})
+    router.close()
+
+
+def test_router_metrics_registered_and_released():
+    net = _FakeNet({"a": _FakeBackend(step=1)})
+    registry = MetricsRegistry()
+    router = FleetRouter({"a": "a"}, registry=registry, fetch=net.fetch,
+                         post=net.post, down_after=1,
+                         clock=lambda: 0.0, sleep=lambda s: None)
+    router.poll_once()
+    router.handle_predict(b"{}", client_id="c1")
+    parsed = parse_prometheus(registry.render_prometheus())
+    for name in ("router_requests_total", "router_forwards_total",
+                 "router_retries_total", "router_sheds_total",
+                 "router_backend_up", "router_backend_inflight",
+                 "router_sessions", "router_step_pin_waits_total",
+                 "router_request_latency_seconds"):
+        assert any(key.startswith(name) for key in parsed), name
+    router.close()
+    assert "router_requests_total" not in registry.render_prometheus()
+
+
+# --------------------------------------------------------------------- #
+# serve /status pressure fields + the /metrics format unification
+# (PR-16 satellites, shape pinned here)
+
+
+def _serve_server():
+    import jax
+
+    from aggregathor_tpu import models
+    from aggregathor_tpu.serve import InferenceEngine, InferenceServer
+
+    exp = models.instantiate("digits", ["batch-size:16"])
+    params = exp.init(jax.random.PRNGKey(0))
+    engine = InferenceEngine(exp, [params], max_batch=4, buckets=(4,))
+    engine.warmup()
+    return InferenceServer(engine, port=0, queue_bound=16, lanes=1,
+                           max_lanes=2, registry=MetricsRegistry())
+
+
+def test_serve_status_pressure_shape_and_shed_delta():
+    """The router routes on these fields: their presence and types are a
+    wire contract, pinned exactly."""
+    server = _serve_server()
+    try:
+        payload = server.status_payload()
+        assert set(payload) == {
+            "weights_step", "active_replicas", "lanes", "max_lanes",
+            "in_flight", "queue_depth", "queue_bound", "batch_count",
+            "compile_count", "custody_verified", "at_ceiling",
+            "shed_count", "shed_delta", "draining",
+        }
+        assert payload["queue_bound"] == 16
+        assert payload["at_ceiling"] is False  # 1 lane < max 2
+        assert payload["draining"] is False
+        assert payload["shed_count"] == 0 and payload["shed_delta"] == 0
+        # shed_delta is per-read (the scrape's per-tick shed rate)
+        server.scheduler.shed_count += 3
+        assert server.status_payload()["shed_delta"] == 3
+        assert server.status_payload()["shed_delta"] == 0
+        assert server.status_payload()["shed_count"] == 3
+        server.begin_drain()
+        assert server.status_payload()["draining"] is True
+        assert server.is_quiescent()
+    finally:
+        server.shutdown_all()
+
+
+def test_serve_metrics_format_unification():
+    """PR-16 compat: bare /metrics answers Prometheus text on the serve
+    exporter too (the pre-16 JSON default is gone); explicit format=json
+    keeps the JSON payload byte-compatible; the fleet scrape's explicit
+    ?format=prometheus keeps working."""
+    server = _serve_server()
+    host, port = server.serve_background()
+    base = "http://%s:%d" % (host, port)
+    try:
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as response:
+                return response.headers.get("Content-Type", ""), response.read()
+
+        ctype, body = get("/metrics")
+        assert ctype.startswith("text/plain")
+        assert "serve_compile_count" in parse_prometheus(body.decode())
+        ctype, body = get("/metrics?format=prometheus")
+        assert ctype.startswith("text/plain")
+        ctype, body = get("/metrics?format=json")
+        assert ctype.startswith("application/json")
+        snapshot = json.loads(body)
+        for key in ("queue_depth", "compile_count", "lanes", "shed_count"):
+            assert key in snapshot, key
+        with pytest.raises(urllib.error.HTTPError) as caught:
+            get("/metrics?format=yaml")
+        assert caught.value.code == 400
+        # the fleet collector reads the NEW default end to end
+        fc = FleetCollector({"serve": "%s:%d" % (host, port)})
+        fc.poll_once()
+        assert fc.instance_up("serve")
+        assert fc.status_payload()["instances"]["serve"]["status"][
+            "queue_bound"] == 16
+    finally:
+        server.shutdown_all()
+
+
+# --------------------------------------------------------------------- #
+# the fleet load document: schema round-trip + the checked-in artifact
+
+
+def test_fleet_load_schema_and_checked_in_artifact():
+    """The aggregathor.fleet.load.v1 validator accepts the benchmark's
+    shape and rejects mutations; the checked-in FLEET_r16.json (a passing
+    run on this box) round-trips through load() with every hard verdict
+    true: zero dropped, fleet-monotone steps, zero recompiles per backend
+    (the killed one judged from the HELD scrape), journal kill chain."""
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo_root, "benchmarks"))
+    try:
+        import fleet_load
+    finally:
+        sys.path.pop(0)
+
+    doc = fleet_load.load(os.path.join(repo_root, "FLEET_r16.json"))
+    verdict = doc["verdict"]
+    for key in ("zero_dropped", "fleet_monotonic", "swaps_ok",
+                "zero_recompiles", "journal_chain", "pass"):
+        assert verdict[key] is True, key
+    assert doc["traffic"]["dropped"] == 0
+    assert doc["fleet"]["killed"] in doc["fleet"]["backends"]
+    nb_buckets = doc["fleet"]["nb_buckets"]
+    assert set(doc["fleet"]["compile_counts"]) == set(doc["fleet"]["backends"])
+    assert all(count == nb_buckets
+               for count in doc["fleet"]["compile_counts"].values())
+    assert doc["swaps"]["observed"] == sorted(doc["swaps"]["observed"])
+    assert len(doc["swaps"]["steps"]) >= 3  # startup + >= 2 mid-run swaps
+    assert doc["journal"]["kill_chain"] is True
+    assert doc["journal"]["events"].get("router_retry", 0) >= 1
+
+    bad = json.loads(json.dumps(doc))
+    del bad["fleet"]["compile_counts"]
+    with pytest.raises(ValueError):
+        fleet_load.validate(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["verdict"]["pass"] = "yes"
+    with pytest.raises(ValueError):
+        fleet_load.validate(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["schema"] = "aggregathor.serve.load.v1"
+    with pytest.raises(ValueError):
+        fleet_load.validate(bad)
+
+
+# --------------------------------------------------------------------- #
+# one real-socket round trip: RouterServer in front of live HTTP backends
+
+
+class _HTTPBackend:
+    """A minimal live /predict+/status+/metrics process stand-in."""
+
+    def __init__(self, name, step):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, body):
+                body = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    self._reply(200, "serve_compile_count 3\n")
+                else:
+                    self._reply(200, json.dumps({
+                        "weights_step": backend.step, "queue_depth": 0,
+                        "queue_bound": 8, "in_flight": 0,
+                        "draining": False, "at_ceiling": False,
+                    }))
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                self._reply(200, json.dumps({
+                    "predictions": [backend.name],
+                    "weights_step": backend.step,
+                }))
+
+        self.name, self.step = name, step
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def address(self):
+        return "127.0.0.1:%d" % self.httpd.server_address[1]
+
+    def kill(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_router_server_round_trip_with_backend_kill():
+    """The one-port face over real sockets: routed /predict with the
+    X-Client-Id pin, /metrics + /status scrapeable, and a killed backend
+    that loses zero requests."""
+    backends = [_HTTPBackend("a", 7), _HTTPBackend("b", 7)]
+    router = FleetRouter({b.name: b.address for b in backends},
+                         registry=MetricsRegistry(), poll_interval=0.05,
+                         down_after=1, step_wait_s=2.0)
+    server = RouterServer(router)
+    router.start()
+    host, port = server.serve_background()
+    base = "http://%s:%d" % (host, port)
+    try:
+        def post(client):
+            request = urllib.request.Request(
+                base + "/predict", data=b'{"rows": []}',
+                headers={"Content-Type": "application/json",
+                         "X-Client-Id": client},
+            )
+            try:
+                with urllib.request.urlopen(request, timeout=10) as response:
+                    return response.status, json.loads(response.read())
+            except urllib.error.HTTPError as exc:
+                return exc.code, json.loads(exc.read())
+
+        code, payload = post("c1")
+        assert code == 200 and payload["weights_step"] == 7
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert "router_requests_total" in resp.read().decode()
+        with urllib.request.urlopen(base + "/status", timeout=10) as resp:
+            status = json.loads(resp.read())
+        assert status["role"] == "router" and status["backends"]["a"]["up"]
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            assert json.loads(resp.read())["role"] == "router"
+        with pytest.raises(urllib.error.HTTPError) as caught:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert caught.value.code == 404
+
+        backends[0].kill()  # mid-run: every request must still answer 200
+        outcomes = [post("k%d" % i)[0] for i in range(6)]
+        assert outcomes == [200] * 6
+    finally:
+        server.shutdown_all()
+        router.close()
+        for backend in backends[1:]:
+            backend.kill()

@@ -148,6 +148,7 @@ def test_engine_zero_recompile_over_reused_buckets():
     assert_zero_recompiles(engine, expect=compiled)
 
 
+@pytest.mark.slow  # thesis re-proved in tier 1 by the campaign matrix test
 def test_poisoned_replica_masked_by_median_not_average():
     """Acceptance: a NaN or scale-corrupted replica is absorbed by the
     median-of-replicas vote (served predictions identical to the clean
@@ -469,6 +470,9 @@ def _get(base, path, timeout=10):
         return json.loads(response.read())
 
 
+@pytest.mark.slow  # round trip re-proved in tier 1 over real sockets by
+# tests/test_router.py::test_router_server_round_trip_with_backend_kill,
+# and end to end by scripts/run_serve_smoke.sh + run_fleet_smoke.sh
 def test_train_checkpoint_serve_round_trip(tmp_path):
     """The full serving story: train digits through the real CLI runner,
     restore the checkpoint through cli.serve's replica loader (one replica
@@ -537,7 +541,7 @@ def test_train_checkpoint_serve_round_trip(tmp_path):
         assert status["lanes"] == 2
         assert status["compile_count"] == len(engine.buckets)
 
-        metrics = _get(base, "/metrics")
+        metrics = _get(base, "/metrics?format=json")
         for key in ("queue_depth", "batch_count", "served_rows", "shed_count",
                     "latency_ms", "batch_occupancy", "per_replica_disagreement",
                     "compile_count", "lanes", "in_flight", "active_replicas",
@@ -596,7 +600,7 @@ def test_server_sheds_under_synthetic_overload():
         assert set(codes) <= {200, 429}
         assert 429 in codes, "no request was shed under a 12-deep burst at bound 2"
         assert 200 in codes, "every request was shed"
-        metrics = _get(base, "/metrics")
+        metrics = _get(base, "/metrics?format=json")
         assert metrics["shed_count"] > 0
     finally:
         release.set()
@@ -634,7 +638,7 @@ def test_server_times_out_and_cancels_stuck_requests():
         assert code == 504, out
         release.set()
         wedge.join()
-        metrics = _get(base, "/metrics")
+        metrics = _get(base, "/metrics?format=json")
         assert metrics["cancelled_count"] >= 1
     finally:
         release.set()
