@@ -40,7 +40,49 @@ PARAM_FAULTS = ("nan", "scale", "zero", "noise")
 #: every accepted mode name
 REPLICA_FAULTS = PARAM_FAULTS + ("stale",)
 
+#: PROCESS-level fault keys the schedule DSL accepts (``kill=`` SIGKILLs
+#: the named fleet instance at regime entry, ``hang=`` SIGSTOPs it so its
+#: scrapes go stale without the process dying).  Host/fleet plane ONLY —
+#: the training engines never see them (``ChaosSchedule`` rejects them
+#: unless the caller opts in with ``allow_process_faults=True``; the
+#: supervisor soak is that caller).
+PROCESS_FAULTS = ("kill", "hang")
+
 _DEFAULTS = {"scale": 100.0, "noise": 0.1}
+
+
+def parse_process_targets(key, value):
+    """Parse a process-fault target list -> tuple of instance names.
+
+    Grammar: ``NAME("+"NAME)*`` — ``kill=serve_b`` or ``hang=train+router``
+    (``+`` separates targets because ``,`` already separates regime
+    settings).  Names are fleet-spec instance names (cli/supervise.py);
+    the schedule cannot validate them against a fleet it has never seen,
+    so it checks shape only and the soak driver fails loudly on an
+    unknown name.
+    """
+    if key not in PROCESS_FAULTS:
+        raise UserException(
+            "Unknown process fault %r (accepted: %s)"
+            % (key, ", ".join(PROCESS_FAULTS))
+        )
+    targets = tuple(value.split("+"))
+    for target in targets:
+        if not target or target != target.strip():
+            raise UserException(
+                "Chaos %s=%r: empty or padded instance name in target "
+                "list (expected NAME or NAME+NAME)" % (key, value)
+            )
+        if any(c in target for c in ":,= "):
+            raise UserException(
+                "Chaos %s=%r: instance name %r may not contain "
+                "':' ',' '=' or spaces" % (key, value, target)
+            )
+    if len(set(targets)) != len(targets):
+        raise UserException(
+            "Chaos %s=%r names the same instance twice" % (key, value)
+        )
+    return targets
 
 
 def parse_poison(spec):

@@ -39,7 +39,17 @@ Known keys:
 - ``tamper=RATE``          per-step probability that each coalition
   worker's row is bit-flipped IN TRANSIT, after honest signing — the tag
   no longer matches the received bytes, so ``--secure`` rejects it;
-  without verification the corrupted row enters aggregation.
+  without verification the corrupted row enters aggregation;
+- ``kill=NAME(+NAME)*``    PROCESS plane (fleet soak, ``cli.supervise``):
+  SIGKILL the named fleet instance(s) at regime entry — the supervisor
+  must notice through the scrape plane and restart them.  Host-side ONLY
+  and further gated: a ``ChaosSchedule`` built without
+  ``allow_process_faults=True`` (every training engine) REJECTS
+  schedules containing process-fault keys, because a training step has
+  no business killing fleet processes;
+- ``hang=NAME(+NAME)*``    like ``kill`` but SIGSTOP: the instance stays
+  alive yet stops answering scrapes — the hung-instance detection path
+  (consecutive scrape misses), distinct from the dead-process path.
 
 A regime named ``calm`` (or any segment's unset keys) means: no attack,
 no loss, no stragglers.  Segments sort by step; the regime starting at
@@ -67,10 +77,11 @@ Schedule-wide options (the CLI's ``--chaos-args``):
 import numpy as np
 
 from ..utils import UserException, parse_keyval
+from .replica_faults import PROCESS_FAULTS, parse_process_targets
 
 #: regime keys the DSL itself consumes; anything else must ride an ``attack=``
 _REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode", "jitter",
-                "forge", "tamper")
+                "forge", "tamper") + PROCESS_FAULTS
 
 _CALM = "calm"
 
@@ -80,11 +91,12 @@ class Regime:
 
     __slots__ = ("start", "spec", "attack", "drop_rate", "straggler_rate",
                  "straggler_stale", "straggler_jitter", "forge_rate",
-                 "tamper_rate")
+                 "tamper_rate", "kills", "hangs")
 
     def __init__(self, start, spec, attack=None, drop_rate=0.0,
                  straggler_rate=0.0, straggler_stale=False,
-                 straggler_jitter=0.0, forge_rate=0.0, tamper_rate=0.0):
+                 straggler_jitter=0.0, forge_rate=0.0, tamper_rate=0.0,
+                 kills=(), hangs=()):
         self.start = int(start)
         self.spec = spec
         self.attack = attack
@@ -94,6 +106,10 @@ class Regime:
         self.straggler_jitter = float(straggler_jitter)
         self.forge_rate = float(forge_rate)
         self.tamper_rate = float(tamper_rate)
+        #: process-plane fault targets (instance names), empty everywhere
+        #: the training engines run — never compiled, never traced
+        self.kills = tuple(kills)
+        self.hangs = tuple(hangs)
 
 
 def _parse_rate(key, value):
@@ -120,6 +136,8 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
     straggler_jitter = None
     forge_rate = 0.0
     tamper_rate = 0.0
+    kills = ()
+    hangs = ()
     seen = set()
     for setting in text.split(","):
         if "=" not in setting:
@@ -146,6 +164,10 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
             forge_rate = _parse_rate(key, value)
         elif key == "tamper":
             tamper_rate = _parse_rate(key, value)
+        elif key == "kill":
+            kills = parse_process_targets(key, value)
+        elif key == "hang":
+            hangs = parse_process_targets(key, value)
         elif key == "straggle-mode":
             if value not in ("drop", "stale"):
                 raise UserException(
@@ -200,6 +222,7 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
         straggler_stale=bool(straggler_stale),
         straggler_jitter=straggler_jitter or 0.0,
         forge_rate=forge_rate, tamper_rate=tamper_rate,
+        kills=kills, hangs=hangs,
     )
 
 
@@ -214,7 +237,8 @@ class ChaosSchedule:
     compile time, and regime transitions never retrace.
     """
 
-    def __init__(self, spec, nb_workers, nb_real_byz=0, args=None):
+    def __init__(self, spec, nb_workers, nb_real_byz=0, args=None,
+                 allow_process_faults=False):
         from ..parallel.lossy import PACKET_COORDS, LossyLink
 
         kv = parse_keyval(args or [], {
@@ -250,6 +274,19 @@ class ChaosSchedule:
         if regimes[0].start != 0:
             regimes.insert(0, Regime(0, _CALM))
         self.regimes = regimes
+        #: any regime kills or hangs a fleet process — the soak driver's
+        #: dispatch flag, and the gate below for everyone else
+        self.has_process_faults = any(r.kills or r.hangs for r in regimes)
+        if self.has_process_faults and not allow_process_faults:
+            offender = next(r for r in regimes if r.kills or r.hangs)
+            raise UserException(
+                "Chaos regime %d:%s declares process-level faults "
+                "(kill=/hang=) but this consumer is a training engine — "
+                "a training step cannot kill fleet processes.  Those keys "
+                "belong to the fleet plane: benchmarks/soak.py and "
+                "cli.supervise build their schedule with "
+                "allow_process_faults=True" % (offender.start, offender.spec)
+            )
         self._starts = np.asarray([r.start for r in regimes], np.int32)
         self._drop_rates = np.asarray([r.drop_rate for r in regimes], np.float32)
         self._straggler_rates = np.asarray([r.straggler_rate for r in regimes], np.float32)
@@ -371,6 +408,13 @@ class ChaosSchedule:
     def transitions(self):
         """[(start_step, spec), ...] for every regime, in order."""
         return [(r.start, r.spec) for r in self.regimes]
+
+    def process_faults(self):
+        """[(start_step, kills, hangs), ...] for regimes carrying
+        process-plane faults — what the soak driver walks, firing each
+        entry ONCE when its start step (tick) is reached."""
+        return [(r.start, r.kills, r.hangs)
+                for r in self.regimes if r.kills or r.hangs]
 
     def __len__(self):
         return len(self.regimes)

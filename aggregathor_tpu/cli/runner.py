@@ -2385,11 +2385,16 @@ def main(argv=None):
                     checkpoints.save(state, step)
                 if metrics and summary_trigger.last_step != step:
                     summaries.scalars(step, summary_scalars(step, metrics))
-            if step > offstep and not diverged and not aborting:
+            if (step > offstep and not diverged and not aborting
+                    and not stop["requested"]):
                 # Regression sentinel at run end (obs/slo.py): judge the
                 # run's measured throughput metrics against the stored
                 # baseline, and/or capture a fresh baseline.  Before
                 # summaries.close() — the verdict is a summary event too.
+                # Signal-interrupted runs are NOT judged: a truncated run's
+                # throughput is meaningless against a full-run baseline, and
+                # a supervisor's graceful retune restart must not synthesize
+                # a REGRESS verdict (docs/operations.md).
                 if sentinel is not None or args.slo_capture:
                     slo_current = obs_slo.collect_current(registry, perf)
                 if sentinel is not None:

@@ -46,6 +46,7 @@ Usage::
     events.uninstall()     # flush + close
 """
 
+import collections
 import json
 import os
 import threading
@@ -108,6 +109,24 @@ EVENT_TYPES = {
     "router_step_pin": "a client's weights_step pin advanced — routing "
                        "is now constrained to backends at >= this step "
                        "(the fleet-wide monotone-sequence guarantee)",
+    "supervisor_restart": "the fleet supervisor restarted a dead or hung "
+                          "instance (attempt index, backoff horizon, the "
+                          "down-judgment evidence)",
+    "supervisor_quarantine": "a crash-looping instance exhausted its "
+                             "restart budget and was QUARANTINED instead "
+                             "of restarted forever (flap damping)",
+    "supervisor_retune": "the supervisor rewrote an instance's knobs and "
+                         "gracefully restarted it — the Overrides "
+                         "rebuild discipline one level up (rung spec, "
+                         "the sustained-regime evidence)",
+    "supervisor_rollback": "a sentinel REGRESS rolled the checkpoint "
+                           "timeline back through the custody path "
+                           "(restore step, discarded steps, verdict ref)",
+    "supervisor_observe": "the supervisor saw a symptom but is "
+                          "deliberately waiting (backoff not elapsed, "
+                          "hysteresis, finished instance) — the no-op "
+                          "arm of the action ladder, journaled so the "
+                          "causal story has no gaps",
 }
 
 #: fields every event carries; ``emit`` keyword fields may not shadow them
@@ -314,6 +333,50 @@ def validate_event(record):
     return record
 
 
+#: resumable read position in one journal file: ``offset`` is the byte
+#: offset of the first unread line, ``line`` the 1-based number that line
+#: will carry in error messages, ``segment`` how many seq-restart segments
+#: have been consumed, and ``last_seq`` the seq of the last validated
+#: record (None before the first).  Immutable — each :func:`tail_journal`
+#: call returns a NEW cursor, so a caller can retry a failed poll from the
+#: old one.
+TailCursor = collections.namedtuple(
+    "TailCursor", ("offset", "line", "segment", "last_seq"))
+
+#: the start-of-file cursor (segment 0, nothing consumed yet)
+TAIL_START = TailCursor(offset=0, line=1, segment=0, last_seq=None)
+
+
+def _validate_line(nb, line, last_seq):
+    """Parse + validate ONE journal line against the chain state.  The
+    single validation path under both :func:`load_journal` and
+    :func:`tail_journal` — contiguity semantics cannot drift between the
+    whole-file and incremental readers.  Returns ``(record, resumed)``
+    where ``resumed`` flags a new segment (seq restarted at 0)."""
+    try:
+        record = json.loads(line)
+    except json.JSONDecodeError as exc:
+        raise ValueError("journal line %d does not parse: %s" % (nb, exc))
+    try:
+        validate_event(record)
+    except ValueError as exc:
+        raise ValueError("journal line %d: %s" % (nb, exc))
+    if last_seq is not None:
+        if record["seq"] not in (last_seq + 1, 0):
+            raise ValueError(
+                "journal line %d: seq %d breaks the chain "
+                "(previous %d wants %d, or 0 for a resumed "
+                "segment)" % (nb, record["seq"], last_seq, last_seq + 1)
+            )
+        return record, record["seq"] == 0
+    if record["seq"] != 0:
+        raise ValueError(
+            "journal line %d: first segment must start at seq 0, "
+            "got %d" % (nb, record["seq"])
+        )
+    return record, False
+
+
 def load_journal(path):
     """Load + validate one journal file.  Returns the event records in file
     order (encoded form — see :func:`decode_event`); raises ``ValueError``
@@ -323,36 +386,68 @@ def load_journal(path):
     interleaving appends into one file break contiguity within a line or
     two and fail here — point concurrent writers at DISTINCT paths (the
     fleet collector merges them)."""
-    records = []
-    with open(path) as fd:
-        for nb, line in enumerate(fd, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                record = json.loads(line)
-            except json.JSONDecodeError as exc:
-                raise ValueError("journal line %d does not parse: %s" % (nb, exc))
-            try:
-                validate_event(record)
-            except ValueError as exc:
-                raise ValueError("journal line %d: %s" % (nb, exc))
-            if records:
-                previous = records[-1]["seq"]
-                if record["seq"] not in (previous + 1, 0):
-                    raise ValueError(
-                        "journal line %d: seq %d breaks the chain "
-                        "(previous %d wants %d, or 0 for a resumed "
-                        "segment)" % (nb, record["seq"], previous,
-                                      previous + 1)
-                    )
-            elif record["seq"] != 0:
-                raise ValueError(
-                    "journal line %d: first segment must start at seq 0, "
-                    "got %d" % (nb, record["seq"])
-                )
-            records.append(record)
+    # A whole-file load of a missing journal is an error (the fleet
+    # collector reports it as "not written yet") — only the incremental
+    # tail treats missing-at-start-of-file as an empty poll.
+    with open(path, "rb"):
+        pass
+    records, _ = tail_journal(path)
     return records
+
+
+def tail_journal(path, cursor=None):
+    """Incremental :func:`load_journal`: read + validate only the records
+    appended since ``cursor`` (a :data:`TailCursor` from a previous call;
+    None or :data:`TAIL_START` reads from the beginning).  Returns
+    ``(new_records, next_cursor)``.
+
+    The chain check continues ACROSS calls — the cursor carries the
+    (segment, seq) position, so a seq break at a poll boundary fails
+    exactly as it would in one whole-file load.  A trailing line without
+    its newline (a writer mid-append) is left for the next call rather
+    than half-parsed; a file shorter than the cursor's offset (truncated
+    or replaced behind the reader) raises.  Missing file with a
+    start-of-file cursor is an empty poll — the supervisor tails journals
+    of instances that have not opened them yet."""
+    if cursor is None:
+        cursor = TAIL_START
+    offset, nb, segment, last_seq = cursor
+    records = []
+    try:
+        fd = open(path, "rb")
+    except OSError:
+        if offset:
+            raise ValueError(
+                "journal %r vanished behind its tail cursor (offset %d)"
+                % (path, offset))
+        return records, cursor
+    with fd:
+        fd.seek(0, os.SEEK_END)
+        size = fd.tell()
+        if size < offset:
+            raise ValueError(
+                "journal %r shrank below its tail cursor (size %d < "
+                "offset %d): truncated or replaced behind the reader"
+                % (path, size, offset))
+        fd.seek(offset)
+        while True:
+            line = fd.readline()
+            if not line:
+                break
+            if not line.endswith(b"\n"):
+                break     # a writer mid-append: re-read next poll
+            offset += len(line)
+            stripped = line.strip()
+            if stripped:
+                record, resumed = _validate_line(
+                    nb, stripped.decode("utf-8"), last_seq)
+                if resumed:
+                    segment += 1
+                last_seq = record["seq"]
+                records.append(record)
+            nb += 1
+    return records, TailCursor(offset=offset, line=nb, segment=segment,
+                               last_seq=last_seq)
 
 
 def counts_by_type(records):

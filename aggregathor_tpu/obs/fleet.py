@@ -270,6 +270,12 @@ class FleetCollector:
                     "up": up,
                     "stale": bool(inst.ever_seen and not up),
                     "misses": inst.misses,
+                    # the exact down-judgment inputs a restart decision
+                    # needs: misses under its canonical name (the down
+                    # threshold is consecutive_misses >= down_after) next
+                    # to the freshness age — supervisor/policy.py reads
+                    # these, "misses" stays for pre-PR-17 scrapers
+                    "consecutive_misses": inst.misses,
                     "last_scrape_age_seconds": (
                         None if inst.last_ok_at is None
                         else max(0.0, now - inst.last_ok_at)

@@ -1,0 +1,29 @@
+"""Fleet supervisor: the control loop the control room opened, closed.
+
+PR 15 made every steering decision observable (journal, fleet scrape,
+round timelines); PR 8's sentinel judges runs after the fact.  The
+supervisor *acts* on those signals live — one rung above the guardian,
+with the same separation the guardian pioneered:
+
+- :mod:`policy` — a PURE decision layer (``SupervisorPolicy``): fleet
+  snapshot + journal tail + sentinel verdicts in, typed actions out.
+  No I/O, no wall clock, fully exercised on a synthetic clock.
+- :mod:`actuator` — ``FleetSupervisor``: spawns the fleet, scrapes it,
+  tails its journals, feeds the policy and EXECUTES its actions
+  (restart / quarantine / retune / rollback), journaling every one with
+  its triggering evidence (``supervisor_*`` event types, obs/events.py).
+
+``cli.supervise`` is the operator face; ``benchmarks/soak.py`` is the
+proof; docs/operations.md is the long-form story.
+"""
+
+from .policy import (  # noqa: F401
+    Observe,
+    Quarantine,
+    Restart,
+    Retune,
+    Rollback,
+    SupervisorConfig,
+    SupervisorPolicy,
+)
+from .actuator import FleetSupervisor, InstanceSpec  # noqa: F401

@@ -1,0 +1,178 @@
+#!/usr/bin/env bash
+# Self-driving-run smoke on CPU (<60 s): the PR-17 supervisor story end
+# to end through the real CLIs (docs/operations.md).
+#
+#   1. train a tiny digits model under --secure -> custody-signed
+#      checkpoint stream (steps 10, 20)
+#   2. cli.supervise over a declarative fleet spec: one cli.serve
+#      backend (ready-file handshake, journal) + one already-finished
+#      trainer slot that owns the checkpoint stream and a sentinel
+#      verdict path
+#   3. restart leg: SIGKILL the backend -> the supervisor restarts it
+#      (new pid in the ready file, supervisor_restart journaled with
+#      its liveness evidence)
+#   4. rollback leg: hand the trainer slot a REGRESS verdict -> the
+#      supervisor restores the second-newest snapshot through the
+#      chain of custody and discards the regressed tail
+#      (supervisor_rollback journaled, citing the verdict's judged_at)
+#   5. journal leg: the supervisor's own journal is EV001-clean and
+#      replays the whole story in causal order
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_soak_smoke}"
+rm -rf "$out"
+mkdir -p "$out"
+secret="smoke-session-secret"
+
+# ---- 1. train -> custody-signed checkpoint stream (steps 10, 20)
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 4 --nb-devices 1 \
+  --max-step 20 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/ckpt" --checkpoint-delta 10 --checkpoint-period -1 \
+  --secure --session-secret "$secret" \
+  --summary-delta -1 --summary-period -1
+
+# ---- 2. the fleet spec: a live backend + the (finished) trainer slot
+JAX_PLATFORMS=cpu python - "$out" "$secret" <<'EOF'
+import json, sys
+
+out, secret = sys.argv[1], sys.argv[2]
+spec = {"instances": [
+    {"name": "backend", "role": "serve",
+     "argv": ["{python}", "-m", "aggregathor_tpu.cli.serve",
+              "--experiment", "digits", "--experiment-args", "batch-size:16",
+              "--ckpt-dir", "%s/ckpt" % out, "--replicas", "1",
+              "--gar", "none", "--max-batch", "8", "--queue-bound", "256",
+              "--lanes", "2", "--follow", "--follow-interval", "0.3",
+              "--drain-timeout", "5", "--session-secret", secret,
+              "--port", "0", "--ready-file", "%s/ready_backend" % out,
+              "--journal", "%s/journal_backend.jsonl" % out,
+              "--run-id", "smoke-backend"],
+     "env": {"JAX_PLATFORMS": "cpu"},
+     "ready_file": "ready_backend",
+     "journal": "journal_backend.jsonl",
+     "log": "log_backend.txt"},
+    {"name": "train", "role": "trainer",
+     "argv": ["{python}", "-c", "import time; time.sleep(2)"],
+     "verdict": "verdict_train.json",
+     "checkpoint_dir": "ckpt",
+     "session_secret": secret},
+]}
+with open("%s/fleet.json" % out, "w") as fd:
+    json.dump(spec, fd, indent=1)
+EOF
+
+# ---- the supervisor itself, through the real CLI
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.supervise \
+  --fleet "$out/fleet.json" --tick-interval 0.25 --down-after 2 \
+  --supervisor-args patience:0.5 backoff:2 max-restarts:4 flap-window:5 \
+  --ready-file "$out/ready_supervisor" \
+  --journal "$out/journal_supervisor.jsonl" --run-id smoke-supervisor \
+  > "$out/log_supervisor.txt" 2>&1 &
+sup_pid=$!
+trap 'kill -9 "$sup_pid" 2>/dev/null || true' EXIT
+for _ in $(seq 1 120); do
+  [ -f "$out/ready_supervisor" ] && [ -f "$out/ready_backend" ] && break
+  kill -0 "$sup_pid" 2>/dev/null || { echo "supervisor died during startup";
+    tail -5 "$out/log_supervisor.txt"; exit 1; }
+  sleep 0.5
+done
+[ -f "$out/ready_backend" ] || { echo "backend never became ready"; exit 1; }
+
+# ---- 3+4. the kill, the restart, the forced REGRESS, the rollback
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import json, os, signal, sys, time
+
+out = sys.argv[1]
+old_pid = int(open("%s/ready_backend" % out).read().split()[2])
+os.kill(old_pid, signal.SIGKILL)
+
+# restart leg: the supervisor notices the corpse, waits out its backoff
+# grace, respawns — the ready-file handshake carries the new pid
+deadline = time.monotonic() + 40.0
+new_pid = None
+while time.monotonic() < deadline:
+    try:
+        fields = open("%s/ready_backend" % out).read().split()
+        if len(fields) == 3 and int(fields[2]) != old_pid:
+            new_pid = int(fields[2])
+            break
+    except (OSError, ValueError):
+        pass                          # removed pre-spawn / mid-write
+    time.sleep(0.25)
+assert new_pid is not None, "supervisor never restarted the killed backend"
+os.kill(new_pid, 0)                   # the restarted process is alive
+print("restart leg OK: backend pid %d -> %d across the SIGKILL"
+      % (old_pid, new_pid))
+
+# rollback leg: hand the trainer slot a sentinel REGRESS verdict
+def steps():
+    return sorted(int(name.split("-")[1].split(".")[0])
+                  for name in os.listdir("%s/ckpt" % out)
+                  if name.startswith("model-") and name.endswith(".ckpt"))
+
+before = steps()                      # snapshot the stream pre-verdict
+assert len(before) >= 2, "seed run left fewer than 2 snapshots: %r" % before
+verdict = {
+    "schema": "aggregathor.obs.slo.v1.verdict", "verdict": "REGRESS",
+    "judged_at": 1234.5, "run_id": "smoke-train",
+    "baseline_run_id": "smoke-baseline", "regressed": ["steps_per_s"],
+    "checks": [{"metric": "steps_per_s", "baseline": 1e9, "tolerance": 0.1,
+                "direction": "higher", "current": 1.0, "bound": 9e8,
+                "status": "regressed"}],
+}
+tmp = "%s/verdict_train.json.tmp" % out
+with open(tmp, "w") as fd:
+    json.dump(verdict, fd)
+os.replace(tmp, "%s/verdict_train.json" % out)
+
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline and steps() != before[:-1]:
+    time.sleep(0.25)
+assert steps() == before[:-1], (
+    "rollback never discarded the regressed tail (steps %r, want %r)"
+    % (steps(), before[:-1]))
+print("rollback leg OK: step %d discarded, custody-verified step %d kept"
+      % (before[-1], before[-2]))
+EOF
+
+# ---- 5. journal leg: the supervisor's own causal record
+kill "$sup_pid"
+for _ in $(seq 1 40); do kill -0 "$sup_pid" 2>/dev/null || break; sleep 0.5; done
+if kill -0 "$sup_pid" 2>/dev/null; then
+  echo "supervisor ignored SIGTERM"; exit 1
+fi
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import os, sys
+from aggregathor_tpu.obs import events
+
+out = sys.argv[1]
+records = events.load_journal("%s/journal_supervisor.jsonl" % out)
+assert records[0]["type"] == "run_start" and records[-1]["type"] == "run_end"
+restarts = [r for r in records if r["type"] == "supervisor_restart"]
+assert restarts and all(r["evidence"] for r in restarts), restarts
+assert any(r["instance"] == "backend" for r in restarts), restarts
+rollbacks = [r for r in records if r["type"] == "supervisor_rollback"]
+assert len(rollbacks) == 1, rollbacks
+roll = rollbacks[0]
+remaining = sorted(int(name.split("-")[1].split(".")[0])
+                   for name in os.listdir("%s/ckpt" % out)
+                   if name.startswith("model-") and name.endswith(".ckpt"))
+assert roll["instance"] == "train", roll
+assert roll["restore_step"] == remaining[-1], (roll, remaining)
+assert roll["discarded_steps"], roll
+assert all(s > roll["restore_step"] for s in roll["discarded_steps"]), roll
+assert roll["custody_verified"] is True, roll
+assert roll["evidence"]["judged_at"] == 1234.5, (
+    "rollback does not cite the verdict that ordered it: %r" % roll)
+kills = [r["seq"] for r in restarts if r["instance"] == "backend"]
+assert kills[0] < roll["seq"], "journal order lost the causal story"
+print("journal leg OK: restart -> rollback replays in causal order "
+      "(%d records)" % len(records))
+EOF
+trap - EXIT
+
+echo "soak smoke PASSED"

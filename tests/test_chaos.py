@@ -126,6 +126,66 @@ def test_schedule_rejects_bad_args():
         ChaosSchedule("0:straggle=0.5", 8, args=["straggle-workers:9"])
 
 
+def test_schedule_process_fault_keys_parse():
+    """kill=/hang= are PROCESS-plane keys (benchmarks/soak.py): parsed
+    host-side into regime target lists, never shipped to devices."""
+    sched = ChaosSchedule(
+        "0:calm 10:kill=train 20:hang=backend-a+backend-b,kill=router",
+        4, allow_process_faults=True)
+    assert sched.has_process_faults
+    assert sched.regimes[0].kills == () and sched.regimes[0].hangs == ()
+    assert sched.regimes[1].kills == ("train",)
+    assert sched.regimes[2].kills == ("router",)
+    assert sched.regimes[2].hangs == ("backend-a", "backend-b")
+    assert sched.process_faults() == [
+        (10, ("train",), ()),
+        (20, ("router",), ("backend-a", "backend-b")),
+    ]
+    # composes with the existing device-plane grammar in one regime
+    mixed = ChaosSchedule("0:drop=0.5,kill=train", 4,
+                          allow_process_faults=True)
+    assert mixed.regimes[0].kills == ("train",)
+    # and a schedule WITHOUT process keys reports none
+    calm = ChaosSchedule("0:calm", 4, allow_process_faults=True)
+    assert not calm.has_process_faults and calm.process_faults() == []
+
+
+def test_schedule_process_fault_keys_gated():
+    """Outside the fleet plane (train CLI: allow_process_faults False)
+    kill=/hang= must be rejected loudly, naming the offending regime."""
+    with pytest.raises(UserException, match="kill"):
+        ChaosSchedule("0:calm 10:kill=train", 4)
+    with pytest.raises(UserException, match="fleet plane"):
+        ChaosSchedule("0:hang=backend-a", 4)
+
+
+@pytest.mark.parametrize("spec", [
+    "0:kill=",                       # empty target list
+    "0:kill=a+",                     # trailing separator
+    "0:kill=+a",                     # leading separator
+    "0:kill=a++b",                   # empty name between separators
+    "0:kill=a+a",                    # duplicate target
+    "0:kill=a b",                    # space inside a name
+    "0:hang=a,hang=b",               # duplicate key in one regime
+])
+def test_schedule_process_fault_rejects(spec):
+    with pytest.raises(UserException):
+        ChaosSchedule(spec, 4, allow_process_faults=True)
+
+
+def test_parse_process_targets_grammar():
+    from aggregathor_tpu.chaos.replica_faults import parse_process_targets
+
+    assert parse_process_targets("kill", "train") == ("train",)
+    assert parse_process_targets("hang", "a+b-2+c.3") == ("a", "b-2", "c.3")
+    with pytest.raises(UserException):
+        parse_process_targets("stop", "train")      # unknown key
+    with pytest.raises(UserException):
+        parse_process_targets("kill", " train")     # padded name
+    with pytest.raises(UserException):
+        parse_process_targets("kill", "a:b")        # DSL metachar in name
+
+
 def test_schedule_regime_boundaries():
     """Off-by-one discipline: the regime starting at s governs steps
     [s, next_start) — host and traced lookups agree at every boundary."""

@@ -127,6 +127,83 @@ def test_journal_append_survives_reinstall(tmp_path):
     assert [r["seq"] for r in records] == [0, 1, 0]
 
 
+def test_tail_journal_incremental_matches_full_load(tmp_path):
+    """The supervisor's tail-follow cursor: polling in increments yields
+    exactly what a fresh load_journal sees, under the same validation."""
+    path = str(tmp_path / "tail.jsonl")
+    events.install(path, run_id="a")
+    events.emit("run_start")
+    events.emit("bounded_round", round=0)
+    events.uninstall()
+    records, cursor = events.tail_journal(path)
+    assert [r["type"] for r in records] == ["run_start", "bounded_round"]
+    # nothing new: an empty poll, cursor unchanged
+    again, cursor2 = events.tail_journal(path, cursor)
+    assert again == [] and cursor2 == cursor
+    # a resumed segment (seq restarts at 0) arrives through the SAME
+    # cursor without tripping the contiguity check
+    events.install(path, run_id="b")
+    events.emit("run_start")
+    events.uninstall()
+    fresh, cursor3 = events.tail_journal(path, cursor)
+    assert [r["run_id"] for r in fresh] == ["b"]
+    assert cursor3.segment == cursor.segment + 1
+    assert records + fresh == events.load_journal(path)
+
+
+def test_tail_journal_leaves_partial_line_for_next_poll(tmp_path):
+    """A torn write (no trailing newline yet) must not be parsed early:
+    the cursor stops before it and picks it up once completed."""
+    path = str(tmp_path / "torn.jsonl")
+    base = {"schema": events.SCHEMA, "type": "run_start", "run_id": None,
+            "seq": 0, "step": None, "t_wall": 1.0, "t_mono": 1.0}
+    whole = json.dumps(base) + "\n"
+    torn = json.dumps(dict(base, type="run_end", seq=1))
+    with open(path, "w") as fd:
+        fd.write(whole + torn)          # second line still being written
+    records, cursor = events.tail_journal(path)
+    assert [r["type"] for r in records] == ["run_start"]
+    with open(path, "a") as fd:
+        fd.write("\n")                  # the write completes
+    records, cursor = events.tail_journal(path, cursor)
+    assert [r["type"] for r in records] == ["run_end"]
+
+
+def test_tail_journal_chain_break_detected_across_polls(tmp_path):
+    """Contiguity is enforced ACROSS polls, not just within one read:
+    a hole after the cursor position still fails loudly."""
+    path = str(tmp_path / "hole.jsonl")
+    base = {"schema": events.SCHEMA, "type": "run_start", "run_id": None,
+            "seq": 0, "step": None, "t_wall": 1.0, "t_mono": 1.0}
+    with open(path, "w") as fd:
+        fd.write(json.dumps(base) + "\n")
+    _, cursor = events.tail_journal(path)
+    with open(path, "a") as fd:
+        fd.write(json.dumps(dict(base, seq=5)) + "\n")   # 1..4 missing
+    with pytest.raises(ValueError, match="seq"):
+        events.tail_journal(path, cursor)
+
+
+def test_tail_journal_truncation_and_vanish_are_loud(tmp_path):
+    path = str(tmp_path / "gone.jsonl")
+    base = {"schema": events.SCHEMA, "type": "run_start", "run_id": None,
+            "seq": 0, "step": None, "t_wall": 1.0, "t_mono": 1.0}
+    with open(path, "w") as fd:
+        fd.write(json.dumps(base) + "\n")
+    _, cursor = events.tail_journal(path)
+    with open(path, "w") as fd:
+        fd.write("")                    # truncated under the cursor
+    with pytest.raises(ValueError, match="shrank"):
+        events.tail_journal(path, cursor)
+    os.remove(path)
+    with pytest.raises(ValueError, match="vanished"):
+        events.tail_journal(path, cursor)
+    # a not-yet-created journal is NOT an error before the first line:
+    # instances journal lazily, the supervisor polls from birth
+    missing, fresh = events.tail_journal(str(tmp_path / "later.jsonl"))
+    assert missing == [] and fresh == events.TAIL_START
+
+
 # --------------------------------------------------------------------- #
 # subsystem wiring: the decisions land on the timeline
 
@@ -506,6 +583,36 @@ def test_fleet_down_instance_holds_sample_with_staleness_marker():
     assert "refused" in status["instances"]["serve"]["last_error"]
 
 
+def test_fleet_status_payload_key_set_pinned():
+    """/fleet/status is an API surface the supervisor (and any dashboard)
+    reads: the per-instance key set is pinned so nothing renames or drops
+    a field silently.  consecutive_misses IS the down-judgment counter;
+    misses stays as its pre-supervisor alias."""
+    fake = _FakeFleet({"train": ({"x_total": 1.0}, {})})
+    clock = {"now": 0.0}
+    fc = FleetCollector({"train": "train"}, fetch=fake.fetch,
+                        down_after=2, clock=lambda: clock["now"])
+    fc.poll_once()
+    fake.dead.add("train")
+    clock["now"] = 3.0
+    fc.poll_once()
+    inst = fc.status_payload()["instances"]["train"]
+    assert sorted(inst) == [
+        "consecutive_misses", "journal", "last_error",
+        "last_scrape_age_seconds", "misses", "stale", "status", "up", "url",
+    ]
+    assert inst["consecutive_misses"] == inst["misses"] == 1
+    assert inst["last_scrape_age_seconds"] == pytest.approx(3.0)
+    clock["now"] = 6.0
+    fc.poll_once()
+    inst = fc.status_payload()["instances"]["train"]
+    assert inst["consecutive_misses"] == 2 and inst["up"] is False
+    fake.dead.discard("train")
+    fc.poll_once()
+    inst = fc.status_payload()["instances"]["train"]
+    assert inst["consecutive_misses"] == 0 and inst["up"] is True
+
+
 def test_fleet_scrape_error_degrades_not_raises():
     """A garbled exposition is a per-instance miss (error counted), never
     a poll failure — and an instance that NEVER answered is down without
@@ -636,6 +743,10 @@ def test_forensics_report_journal_section():
     assert ForensicsLedger(1).report()["journal"] is None
 
 
+@pytest.mark.slow  # journal-through-the-real-CLI re-proved in tier 1 by
+# the in-process subsystem-wiring tests above and end-to-end by
+# scripts/run_soak_smoke.sh (supervisor + backend journals through real
+# CLIs, chain asserted) — pays for the PR-17 supervisor/tail suites
 def test_cli_journal_end_to_end(tmp_path):
     """END-TO-END: a real runner invocation with --journal + --forensics —
     run_start/run_end bracket the journal, the forensics report's journal

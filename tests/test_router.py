@@ -206,6 +206,51 @@ def test_pinned_client_never_observes_step_regression(journal):
     assert [r["reason"] for r in routes] == ["initial", "backend_down"]
 
 
+def test_supervised_restart_readmits_backend_same_address(journal):
+    """The supervisor leg of the traffic plane (docs/operations.md): a
+    SIGKILLed backend restarted on the SAME host:port re-enters rotation
+    on the next successful scrape — the down-latch clears only through
+    poll_once, never through a lucky forward — and the restarted replica
+    (restored from the same snapshot dir, so at the same step) serves
+    pinned clients with no weights_step regression."""
+    net = _FakeNet({"a": _FakeBackend(step=10), "b": _FakeBackend(step=10)})
+    router, _clock = _make_router(net, ("a", "b"))
+    router.poll_once()
+    observed = []
+
+    def ask(client="c1"):
+        code, payload = router.handle_predict(b"{}", client_id=client)
+        assert code == 200, payload
+        observed.append(payload["weights_step"])
+        return payload["backend"]
+
+    assert ask() == "a"                      # tie-break: both @10
+    net.backends["a"].dead = True            # SIGKILL (scrape AND posts die)
+    router.poll_once()                       # down_after=1: latch immediately
+    assert not router.status_payload()["backends"]["a"]["up"]
+    assert ask() == "b"                      # traffic flows around the hole
+    # the supervisor respawns serve on the same address; until the router
+    # SCRAPES it, the latch holds — revival alone moves no traffic
+    net.backends["a"].dead = False           # restart: same addr, same step
+    posts_before = net.backends["a"].posts
+    assert ask() == "b"
+    assert net.backends["a"].posts == posts_before  # latch never probed it
+    router.poll_once()                       # the re-admitting scrape
+    assert router.status_payload()["backends"]["a"]["up"]
+    assert ask() == "a"                      # back in rotation, least name
+    assert observed == [10, 10, 10, 10]      # pinned: never backwards
+    types = _types(journal)
+    assert types.count("router_backend_down") == 1
+    assert types.count("router_backend_up") >= 1
+    # the re-admission is CAUSED and journaled; serving again is not a
+    # new assignment for the pinned client beyond the latch flip
+    last_up = max(i for i, t in enumerate(types)
+                  if t == "router_backend_up")
+    last_down = max(i for i, t in enumerate(types)
+                    if t == "router_backend_down")
+    assert last_up > last_down               # the timeline ends re-admitted
+
+
 def test_swap_window_waits_then_serves_consistent(journal):
     """A pinned request arriving mid-swap (nobody yet at the pin) waits
     for the fleet to catch up instead of serving a step that could read

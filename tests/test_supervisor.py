@@ -1,0 +1,443 @@
+"""Tests for the fleet supervisor (docs/operations.md "The self-driving
+run"): the PURE SupervisorPolicy driven entirely on a synthetic clock —
+restart backoff, flap damping -> quarantine, retune hysteresis,
+rollback-once — plus the actuator's rung rewriter, the fleet-spec
+loader, and the custody-gated rollback executor (no processes, no
+wall-clock sleeps anywhere in this file)."""
+
+import json
+import os
+
+import pytest
+
+from aggregathor_tpu.obs import events
+from aggregathor_tpu.supervisor import (
+    FleetSupervisor,
+    InstanceSpec,
+    Observe,
+    Quarantine,
+    Restart,
+    Retune,
+    Rollback,
+    SupervisorConfig,
+    SupervisorPolicy,
+)
+from aggregathor_tpu.supervisor.actuator import (
+    apply_rung,
+    load_fleet_spec,
+    validate_retunes,
+)
+from aggregathor_tpu.supervisor.policy import InstanceObs
+from aggregathor_tpu.utils import UserException
+
+
+@pytest.fixture
+def journal(tmp_path):
+    j = events.install(str(tmp_path / "sup.journal.jsonl"), run_id="suptest")
+    yield j
+    events.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _no_journal_leak():
+    yield
+    events.uninstall()
+
+
+def _config(**kw):
+    args = ["%s:%s" % (k.replace("_", "-"), v) for k, v in kw.items()]
+    return SupervisorConfig(args)
+
+
+def _obs(name="train", role="train", alive=True, exit_code=None, up=True,
+         misses=0, age=0.1):
+    return InstanceObs(name, role, alive, exit_code, up, misses, age)
+
+
+DEAD = dict(alive=False, exit_code=-9, up=False, misses=5, age=9.0)
+
+
+# --------------------------------------------------------------------- #
+# restart backoff (the watchdog discipline, one level up)
+
+
+def test_restart_backoff_discipline_on_synthetic_clock():
+    """Restart k opens a patience * backoff^k grace window; inside it a
+    still-down instance only Observes (once), never restarts."""
+    policy = SupervisorPolicy(_config(patience=2, backoff=2, max_restarts=9))
+    (action,) = policy.tick(10.0, [_obs(**DEAD)])
+    assert isinstance(action, Restart)
+    assert action.attempt == 0 and action.backoff_s == 2.0
+    assert action.reason == "dead"
+    # the down-judgment evidence rides the action
+    assert action.evidence["consecutive_misses"] == 5
+    assert action.evidence["exit_code"] == -9
+    # inside the grace window: one Observe, then silence
+    (wait,) = policy.tick(10.5, [_obs(**DEAD)])
+    assert isinstance(wait, Observe) and wait.reason == "backoff_wait"
+    assert wait.evidence["not_before"] == 12.0
+    assert policy.tick(11.0, [_obs(**DEAD)]) == []
+    # past it: attempt 1, window doubles
+    (again,) = policy.tick(12.1, [_obs(**DEAD)])
+    assert isinstance(again, Restart)
+    assert again.attempt == 1 and again.backoff_s == 4.0
+    assert policy.tick(13.0, [_obs(**DEAD)]) != []      # observe resumes
+    (third,) = policy.tick(16.2, [_obs(**DEAD)])
+    assert isinstance(third, Restart) and third.backoff_s == 8.0
+
+
+def test_hung_instance_restarts_dead_process_semantics():
+    """Alive but scrape-down is 'hung' (SIGSTOP, wedged event loop);
+    exit 0 is 'finished' and NEVER restarts; exit != 0 is 'dead'."""
+    policy = SupervisorPolicy(_config())
+    (action,) = policy.tick(0.0, [_obs(alive=True, up=False, misses=4)])
+    assert isinstance(action, Restart) and action.reason == "hung"
+    # a run that completed is not a fault: one Observe, no restart, ever
+    policy2 = SupervisorPolicy(_config())
+    (done,) = policy2.tick(0.0, [_obs(alive=False, exit_code=0, up=False)])
+    assert isinstance(done, Observe) and done.reason == "finished"
+    assert policy2.tick(1.0, [_obs(alive=False, exit_code=0, up=False)]) == []
+
+
+def test_never_scraped_instance_is_not_hung():
+    """up=None (no scrape URL, or not seen yet) must not read as hung —
+    process liveness is then the only restart signal."""
+    policy = SupervisorPolicy(_config())
+    assert policy.tick(0.0, [_obs(up=None)]) == []
+    (action,) = policy.tick(1.0, [_obs(alive=False, exit_code=1, up=None)])
+    assert isinstance(action, Restart) and action.reason == "dead"
+
+
+# --------------------------------------------------------------------- #
+# flap damping: quarantine, and the healthy-window refund
+
+
+def test_crash_looper_escalates_to_quarantine():
+    config = _config(patience=1, backoff=2, max_restarts=3)
+    policy = SupervisorPolicy(config)
+    now, restarts = 0.0, 0
+    while True:
+        actions = policy.tick(now, [_obs(**DEAD)])
+        if actions and isinstance(actions[0], Quarantine):
+            break
+        restarts += sum(isinstance(a, Restart) for a in actions)
+        now += 0.5
+        assert now < 60.0, "never quarantined"
+    assert restarts == 3
+    assert actions[0].reason == "crash_loop" and actions[0].attempts == 3
+    assert policy.is_quarantined("train")
+    # quarantined stays down: one Observe, then silence, never Restart
+    (obs_action,) = policy.tick(now + 1.0, [_obs(**DEAD)])
+    assert isinstance(obs_action, Observe)
+    assert obs_action.reason == "quarantined"
+    assert policy.tick(now + 2.0, [_obs(**DEAD)]) == []
+
+
+def test_full_healthy_window_refunds_restart_budget():
+    """A one-off kill must not count against the quarantine budget
+    forever: flap_window healthy seconds reset the attempt counter."""
+    policy = SupervisorPolicy(_config(patience=1, flap_window=30))
+    (first,) = policy.tick(0.0, [_obs(**DEAD)])
+    assert isinstance(first, Restart) and first.attempt == 0
+    policy.tick(1.0, [_obs()])               # healthy again
+    policy.tick(32.0, [_obs()])              # ... for a full window
+    (second,) = policy.tick(33.0, [_obs(**DEAD)])
+    assert isinstance(second, Restart)
+    assert second.attempt == 0               # budget refunded
+    # but a SHORT healthy stretch does NOT refund
+    policy.tick(34.5, [_obs()])
+    (third,) = policy.tick(35.0, [_obs(**DEAD)])
+    assert isinstance(third, Restart) and third.attempt == 1
+
+
+# --------------------------------------------------------------------- #
+# retune: sustained regime shifts, hysteresis, ladder exhaustion
+
+
+def _ceiling(seq):
+    return ("train", {"type": "deadline_window", "seq": seq,
+                      "at_ceiling": True})
+
+
+def _timeouts(seq):
+    return ("train", {"type": "bounded_round", "seq": seq,
+                      "timed_out": [1, 3]})
+
+
+def test_retune_triggers_on_at_ceiling_streak_with_evidence():
+    policy = SupervisorPolicy(
+        _config(retune_streak=3),
+        retunes={"train": ("step-deadline*2", "exchange=int8")})
+    assert policy.tick(0.0, [_obs()], [_ceiling(0), _ceiling(1)]) == []
+    (action,) = policy.tick(1.0, [_obs()], [_ceiling(2)])
+    assert isinstance(action, Retune)
+    assert action.rung == "step-deadline*2" and action.rung_index == 0
+    assert action.reason == "deadline_ceiling"
+    # the triggering events are cross-referenced, replayably
+    assert action.evidence["events"] == [
+        {"type": "deadline_window", "seq": 0},
+        {"type": "deadline_window", "seq": 1},
+        {"type": "deadline_window", "seq": 2},
+    ]
+
+
+def test_retune_streak_resets_on_healthy_event():
+    policy = SupervisorPolicy(_config(retune_streak=3),
+                              retunes={"train": ("step-deadline*2",)})
+    calm = ("train", {"type": "deadline_window", "seq": 2,
+                      "at_ceiling": False})
+    assert policy.tick(0.0, [_obs()], [_ceiling(0), _ceiling(1), calm,
+                                       _ceiling(3), _ceiling(4)]) == []
+    (action,) = policy.tick(1.0, [_obs()], [_ceiling(5)])
+    assert isinstance(action, Retune)
+
+
+def test_timeout_wave_triggers_retune():
+    policy = SupervisorPolicy(_config(retune_streak=2),
+                              retunes={"train": ("step-deadline*2",)})
+    (action,) = policy.tick(0.0, [_obs()], [_timeouts(0), _timeouts(1)])
+    assert isinstance(action, Retune) and action.reason == "timeout_wave"
+
+
+def test_retune_hysteresis_and_ladder_exhaustion():
+    policy = SupervisorPolicy(
+        _config(retune_streak=2, retune_cooldown=30),
+        retunes={"train": ("step-deadline*2", "exchange=int8")})
+    (first,) = policy.tick(0.0, [_obs()], [_ceiling(0), _ceiling(1)])
+    assert isinstance(first, Retune) and first.rung_index == 0
+    # the symptom returns INSIDE the cooldown: observe, do not thrash
+    (wait,) = policy.tick(5.0, [_obs()], [_ceiling(2), _ceiling(3)])
+    assert isinstance(wait, Observe) and wait.reason == "retune_hysteresis"
+    assert policy.tick(6.0, [_obs()]) == []  # deduped while unchanged
+    # past the cooldown: rung 1
+    (second,) = policy.tick(31.0, [_obs()])
+    assert isinstance(second, Retune) and second.rung == "exchange=int8"
+    # ladder exhausted: the symptom can only be observed
+    (spent,) = policy.tick(70.0, [_obs()], [_ceiling(4), _ceiling(5)])
+    assert isinstance(spent, Observe)
+    assert spent.reason == "retune_ladder_exhausted"
+
+
+def test_no_ladder_never_retunes():
+    policy = SupervisorPolicy(_config(retune_streak=1))
+    assert policy.tick(0.0, [_obs()], [_ceiling(0), _ceiling(1)]) == []
+
+
+# --------------------------------------------------------------------- #
+# rollback: sentinel REGRESS, once per verdict identity
+
+
+def _regress(judged_at=77.0):
+    return {"schema": "aggregathor.obs.slo.v1.verdict", "verdict": "REGRESS",
+            "judged_at": judged_at, "run_id": "r1",
+            "failures": [{"metric": "final_loss"}]}
+
+
+def test_rollback_once_per_verdict_identity():
+    policy = SupervisorPolicy(_config())
+    (action,) = policy.tick(0.0, [_obs()], verdicts=[("train", _regress())])
+    assert isinstance(action, Rollback)
+    assert action.reason == "sentinel_regress"
+    assert action.evidence["failures"] == ["final_loss"]
+    # the SAME verdict re-observed: rollback_once, no second unwind
+    (again,) = policy.tick(1.0, [_obs()], verdicts=[("train", _regress())])
+    assert isinstance(again, Observe) and again.reason == "rollback_once"
+    # a NEW judgment is a new regression: roll back again
+    (fresh,) = policy.tick(2.0, [_obs()],
+                           verdicts=[("train", _regress(judged_at=99.0))])
+    assert isinstance(fresh, Rollback)
+
+
+def test_pass_verdict_is_ignored():
+    policy = SupervisorPolicy(_config())
+    ok = dict(_regress(), verdict="PASS")
+    assert policy.tick(0.0, [_obs()], verdicts=[("train", ok)]) == []
+
+
+# --------------------------------------------------------------------- #
+# config + rung grammar validation
+
+
+def test_supervisor_config_validation():
+    assert SupervisorConfig().describe().startswith("patience=")
+    with pytest.raises(UserException, match="patience"):
+        SupervisorConfig(["patience:0"])
+    with pytest.raises(UserException, match="backoff"):
+        SupervisorConfig(["backoff:0.5"])
+    with pytest.raises(UserException, match="max-restarts"):
+        SupervisorConfig(["max-restarts:0"])
+    with pytest.raises(UserException, match="retune-streak"):
+        SupervisorConfig(["retune-streak:0"])
+    with pytest.raises(UserException):
+        SupervisorConfig(["unknown-knob:1"])
+
+
+def test_apply_rung_grammar():
+    argv = ["prog", "--step-deadline", "1.5", "--exchange", "none"]
+    assert apply_rung(argv, "step-deadline*2") == \
+        ["prog", "--step-deadline", "3", "--exchange", "none"]
+    assert apply_rung(argv, "exchange=int8:ef") == \
+        ["prog", "--step-deadline", "1.5", "--exchange", "int8:ef"]
+    # setting an absent flag appends it; the input argv is never mutated
+    assert apply_rung(["prog"], "lanes=4") == ["prog", "--lanes", "4"]
+    assert argv == ["prog", "--step-deadline", "1.5", "--exchange", "none"]
+    with pytest.raises(UserException, match="baseline"):
+        apply_rung(["prog"], "step-deadline*2")
+    with pytest.raises(UserException, match="not a number"):
+        apply_rung(argv, "step-deadline*fast")
+    with pytest.raises(UserException, match="not numeric"):
+        apply_rung(["prog", "--exchange", "none"], "exchange*2")
+    with pytest.raises(UserException, match="KEY=VALUE or KEY"):
+        apply_rung(argv, "bogus")
+    with pytest.raises(UserException, match="empty key"):
+        apply_rung(argv, "=3")
+
+
+def test_validate_retunes_rejects_malformed_ladders():
+    validate_retunes({"train": ("step-deadline*2", "exchange=int8")})
+    with pytest.raises(UserException, match="neither"):
+        validate_retunes({"train": ("bogus",)})
+    with pytest.raises(UserException, match="factor"):
+        validate_retunes({"train": ("k*fast",)})
+    with pytest.raises(UserException, match="empty key"):
+        validate_retunes({"train": ("=v",)})
+
+
+# --------------------------------------------------------------------- #
+# fleet spec loading
+
+
+def test_load_fleet_spec_resolves_relative_paths(tmp_path):
+    spec_path = tmp_path / "fleet.json"
+    spec_path.write_text(json.dumps({"instances": [
+        {"name": "train", "role": "train",
+         "argv": ["{python}", "-m", "x"],
+         "journal": "journal_train.jsonl", "verdict": "verdict.json",
+         "checkpoint_dir": "ckpt", "retunes": ["step-deadline*2"]},
+        {"name": "router", "role": "router",
+         "argv": ["{python}", "-m", "y"], "url": "127.0.0.1:9000"},
+    ]}))
+    specs = load_fleet_spec(str(spec_path))
+    assert [s.name for s in specs] == ["train", "router"]
+    train = specs[0]
+    assert train.journal == str(tmp_path / "journal_train.jsonl")
+    assert train.checkpoint_dir == str(tmp_path / "ckpt")
+    assert train.retunes == ("step-deadline*2",)
+    assert os.path.isabs(train.argv[0])      # {python} resolved
+    assert specs[1].url == "127.0.0.1:9000"
+
+
+def test_load_fleet_spec_rejects_malformed(tmp_path):
+    spec_path = tmp_path / "fleet.json"
+    spec_path.write_text(json.dumps({"fleet": []}))
+    with pytest.raises(UserException, match="instances"):
+        load_fleet_spec(str(spec_path))
+    spec_path.write_text(json.dumps({"instances": [
+        {"name": "a", "role": "x", "argv": ["p"]},
+        {"name": "a", "role": "y", "argv": ["p"]},
+    ]}))
+    with pytest.raises(UserException, match="duplicate"):
+        load_fleet_spec(str(spec_path))
+    spec_path.write_text(json.dumps({"instances": [
+        {"name": "a", "role": "x", "argv": ["p"], "bogus_key": 1},
+    ]}))
+    with pytest.raises(UserException, match="bogus_key"):
+        load_fleet_spec(str(spec_path))
+    with pytest.raises(UserException, match="empty argv"):
+        InstanceSpec("a", "x", [])
+
+
+# --------------------------------------------------------------------- #
+# the actuator's rollback executor: custody-gated, journaled (no
+# processes involved — the instance is spec'd but never spawned)
+
+
+def _snapshot_dir(tmp_path, secret=b"soak-secret"):
+    """Two custody-signed snapshots (steps 10, 20) the executor can roll
+    back across, exactly as Checkpoints(custody=...) lays them out."""
+    from aggregathor_tpu.secure import ChainOfCustody
+
+    directory = tmp_path / "ckpt"
+    directory.mkdir()
+    custody = ChainOfCustody(secret, run_id="r1")
+    for step in (10, 20):
+        path = directory / ("model-%d.ckpt" % step)
+        data = b"snapshot-bytes-%d" % step
+        path.write_bytes(data)
+        custody.write(str(path), step, data)
+    return str(directory)
+
+
+def _rollback_supervisor(tmp_path, **spec_kw):
+    spec = InstanceSpec(
+        "train", "train", ["{python}", "-c", "pass"],
+        checkpoint_dir=spec_kw.pop("checkpoint_dir"), **spec_kw)
+    return FleetSupervisor([spec], config=SupervisorConfig())
+
+
+def _roll(supervisor):
+    action = Rollback(instance="train", verdict_id="judged_at:77.0",
+                      reason="sentinel_regress",
+                      evidence={"verdict_id": "judged_at:77.0"})
+    supervisor._execute(action)
+
+
+def test_rollback_executor_discards_regressed_tail(tmp_path, journal):
+    directory = _snapshot_dir(tmp_path)
+    supervisor = _rollback_supervisor(
+        tmp_path, checkpoint_dir=directory, session_secret="soak-secret")
+    _roll(supervisor)
+    # the regressed tail is gone; the restore target and its custody stay
+    assert sorted(os.listdir(directory)) == [
+        "model-10.ckpt", "model-10.ckpt.manifest.json"]
+    (record,) = [r for r in events.load_journal(journal.path)
+                 if r["type"] == "supervisor_rollback"]
+    assert record["restore_step"] == 10
+    assert record["discarded_steps"] == [20]
+    assert record["custody_verified"] is True
+    assert record["stopped"] is False        # nothing was running
+    assert record["evidence"]["verdict_id"] == "judged_at:77.0"
+
+
+def test_rollback_executor_refuses_tampered_custody(tmp_path, journal):
+    directory = _snapshot_dir(tmp_path)
+    # tamper with the restore target AFTER signing
+    with open(os.path.join(directory, "model-10.ckpt"), "wb") as fd:
+        fd.write(b"swapped-bytes")
+    supervisor = _rollback_supervisor(
+        tmp_path, checkpoint_dir=directory, session_secret="soak-secret")
+    _roll(supervisor)
+    # NOTHING was discarded: fail-closed
+    assert "model-20.ckpt" in os.listdir(directory)
+    (record,) = [r for r in events.load_journal(journal.path)
+                 if r["type"] == "supervisor_observe"]
+    assert record["reason"] == "rollback_custody_refused"
+
+
+def test_rollback_executor_fail_closed_without_secret(tmp_path, journal):
+    directory = _snapshot_dir(tmp_path)
+    supervisor = _rollback_supervisor(tmp_path, checkpoint_dir=directory)
+    _roll(supervisor)
+    assert "model-20.ckpt" in os.listdir(directory)   # refused
+    # ... unless unsigned restores were explicitly allowed (serve's
+    # --allow-unsigned discipline)
+    supervisor = _rollback_supervisor(
+        tmp_path, checkpoint_dir=directory, allow_unsigned=True)
+    _roll(supervisor)
+    assert "model-20.ckpt" not in os.listdir(directory)
+    (record,) = [r for r in events.load_journal(journal.path)
+                 if r["type"] == "supervisor_rollback"]
+    assert record["custody_verified"] is False
+
+
+def test_rollback_executor_needs_two_snapshots(tmp_path, journal):
+    directory = tmp_path / "ckpt"
+    directory.mkdir()
+    (directory / "model-10.ckpt").write_bytes(b"only-one")
+    supervisor = _rollback_supervisor(
+        tmp_path, checkpoint_dir=str(directory), allow_unsigned=True)
+    _roll(supervisor)
+    assert os.path.exists(str(directory / "model-10.ckpt"))
+    (record,) = [r for r in events.load_journal(journal.path)
+                 if r["type"] == "supervisor_observe"]
+    assert record["reason"] == "rollback_unavailable"
