@@ -63,6 +63,12 @@ COMPOSITE_SPECS = (
     "bucketing:s=2,inner=krum",
     "bucketing:s=2,inner=hier(g=2,inner=median,outer=average-nan)",
     "hier:g=4,inner=bucketing(s=2,inner=median),outer=average-nan",
+    # the aggregation tree (topology/spec.py) in BOTH nesting directions:
+    # composites inside a tree level, and a tree as another meta-rule's
+    # outer — the registry accepts it anywhere a GAR name is
+    "tree:g=2x2,rules=median>median>average-nan",
+    "tree:g=4,rules=bucketing(s=2,inner=median)>krum",
+    "hier:g=2,inner=median,outer=tree(g=2,rules=median>average-nan)",
 )
 
 

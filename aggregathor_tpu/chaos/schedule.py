@@ -79,11 +79,51 @@ import numpy as np
 from ..utils import UserException, parse_keyval
 from .replica_faults import PROCESS_FAULTS, parse_process_targets
 
+#: sub-aggregator fault keys (the TOPOLOGY plane, topology/tree.py): a
+#: ``corrupt-agg`` unit signs its custody tag without the session secret,
+#: a ``straggle-agg`` unit stalls past its level window.  Targets are
+#: ``LEVEL.UNIT`` pairs joined with ``+`` (``corrupt-agg=1.0+2.1``) —
+#: tree nodes, NOT workers.  Gated like the process faults: only a
+#: consumer that actually runs a tree (``--topology``) may accept them.
+TOPOLOGY_FAULTS = ("corrupt-agg", "straggle-agg")
+
 #: regime keys the DSL itself consumes; anything else must ride an ``attack=``
 _REGIME_KEYS = ("attack", "drop", "straggle", "straggle-mode", "jitter",
-                "forge", "tamper") + PROCESS_FAULTS
+                "forge", "tamper") + PROCESS_FAULTS + TOPOLOGY_FAULTS
 
 _CALM = "calm"
+
+
+def parse_topology_targets(key, value):
+    """``1.0+2.1`` -> ((1, 0), (2, 1)) — (level, unit) sub-aggregator
+    targets (1-based level, 0-based unit within the level).  Structural
+    validation only; the TreeSpec bounds-check the targets against the
+    live tree (``validate_fault_target``) at wiring time."""
+    targets = []
+    for part in value.split("+"):
+        part = part.strip()
+        pieces = part.split(".")
+        try:
+            level, unit = (int(p) for p in pieces)
+        except ValueError:
+            raise UserException(
+                "Chaos %s=%r: each target must be LEVEL.UNIT (two "
+                "integers, e.g. %s=1.0+2.1)" % (key, value, key)
+            )
+        if level < 1:
+            raise UserException(
+                "Chaos %s=%r: levels are 1-based (got level %d)"
+                % (key, value, level)
+            )
+        if unit < 0:
+            raise UserException(
+                "Chaos %s=%r: unit indices are >= 0 (got %d)"
+                % (key, value, unit)
+            )
+        targets.append((level, unit))
+    if not targets:
+        raise UserException("Chaos %s= names no targets" % key)
+    return tuple(targets)
 
 
 class Regime:
@@ -91,12 +131,13 @@ class Regime:
 
     __slots__ = ("start", "spec", "attack", "drop_rate", "straggler_rate",
                  "straggler_stale", "straggler_jitter", "forge_rate",
-                 "tamper_rate", "kills", "hangs")
+                 "tamper_rate", "kills", "hangs", "agg_corrupt",
+                 "agg_straggle")
 
     def __init__(self, start, spec, attack=None, drop_rate=0.0,
                  straggler_rate=0.0, straggler_stale=False,
                  straggler_jitter=0.0, forge_rate=0.0, tamper_rate=0.0,
-                 kills=(), hangs=()):
+                 kills=(), hangs=(), agg_corrupt=(), agg_straggle=()):
         self.start = int(start)
         self.spec = spec
         self.attack = attack
@@ -110,6 +151,10 @@ class Regime:
         #: the training engines run — never compiled, never traced
         self.kills = tuple(kills)
         self.hangs = tuple(hangs)
+        #: topology-plane fault targets ((level, unit) tree nodes), empty
+        #: outside ``--topology`` runs — host-side only, never traced
+        self.agg_corrupt = tuple(agg_corrupt)
+        self.agg_straggle = tuple(agg_straggle)
 
 
 def _parse_rate(key, value):
@@ -138,6 +183,8 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
     tamper_rate = 0.0
     kills = ()
     hangs = ()
+    agg_corrupt = ()
+    agg_straggle = ()
     seen = set()
     for setting in text.split(","):
         if "=" not in setting:
@@ -168,6 +215,10 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
             kills = parse_process_targets(key, value)
         elif key == "hang":
             hangs = parse_process_targets(key, value)
+        elif key == "corrupt-agg":
+            agg_corrupt = parse_topology_targets(key, value)
+        elif key == "straggle-agg":
+            agg_straggle = parse_topology_targets(key, value)
         elif key == "straggle-mode":
             if value not in ("drop", "stale"):
                 raise UserException(
@@ -223,6 +274,7 @@ def _parse_regime(start, text, nb_workers, nb_real_byz):
         straggler_jitter=straggler_jitter or 0.0,
         forge_rate=forge_rate, tamper_rate=tamper_rate,
         kills=kills, hangs=hangs,
+        agg_corrupt=agg_corrupt, agg_straggle=agg_straggle,
     )
 
 
@@ -238,7 +290,7 @@ class ChaosSchedule:
     """
 
     def __init__(self, spec, nb_workers, nb_real_byz=0, args=None,
-                 allow_process_faults=False):
+                 allow_process_faults=False, allow_topology_faults=False):
         from ..parallel.lossy import PACKET_COORDS, LossyLink
 
         kv = parse_keyval(args or [], {
@@ -286,6 +338,26 @@ class ChaosSchedule:
                 "belong to the fleet plane: benchmarks/soak.py and "
                 "cli.supervise build their schedule with "
                 "allow_process_faults=True" % (offender.start, offender.spec)
+            )
+        #: any regime faults a sub-aggregator — only meaningful when a
+        #: tree topology actually runs (the gate below: a star has no
+        #: sub-aggregators to corrupt, so accepting the keys silently
+        #: would no-op the declared fault)
+        self.has_topology_faults = any(
+            r.agg_corrupt or r.agg_straggle for r in regimes
+        )
+        if self.has_topology_faults and not allow_topology_faults:
+            offender = next(
+                r for r in regimes if r.agg_corrupt or r.agg_straggle
+            )
+            raise UserException(
+                "Chaos regime %d:%s declares sub-aggregator faults "
+                "(corrupt-agg=/straggle-agg=) but this run has no "
+                "aggregation tree — a parameter-server star has no "
+                "sub-aggregators to fault.  Those keys need --topology "
+                "tree:... (the runner then builds its schedule with "
+                "allow_topology_faults=True)"
+                % (offender.start, offender.spec)
             )
         self._starts = np.asarray([r.start for r in regimes], np.int32)
         self._drop_rates = np.asarray([r.drop_rate for r in regimes], np.float32)

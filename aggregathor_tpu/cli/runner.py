@@ -180,6 +180,23 @@ def build_parser():
              "in-graph transport simulation (--UDP/non-straggler --chaos)",
     )
     parser.add_argument(
+        "--topology", default=None, metavar="SPEC",
+        help="aggregation-tree topology (topology/, docs/topology.md): "
+             "replace the PS star with L levels of untrusted sub-"
+             "aggregators, e.g. tree:g=16x4,rules=median>trimmed-mean>"
+             "krum,link=int8,redundancy=2,agg-f=1x0.  The tree IS the "
+             "aggregation rule (pass --aggregator tree; the spec "
+             "substitutes into the guardian's Overrides record): "
+             "f-budgets compose through the levels at parse time, every "
+             "inter-level link rides the declared wire codec, each level "
+             "closes its own bounded-wait round, sub-aggregator custody "
+             "is chain-verified (a forged emission NAMES its (level, "
+             "unit) in forensics — never laundered into worker blame), "
+             "and redundancy=r serves a faulted unit from a sibling "
+             "shadow.  Needs the flat engine; implies bounded-wait "
+             "dispatch (add --step-deadline for real per-level windows)",
+    )
+    parser.add_argument(
         "--straggler-stall", type=float, default=0.0, metavar="SECONDS",
         help="bounded-wait straggler injection: a worker drawn late holds "
              "its submission this long before dispatching (the chaos "
@@ -821,12 +838,38 @@ def main(argv=None):
             )
     watchdog = Watchdog(guardian) if guardian is not None else None
 
+    # Aggregation topology (--topology, topology/): the tree spec parses
+    # and runs its f-composition arithmetic HERE, before anything compiles,
+    # and substitutes for --aggregator in the Overrides record — so a
+    # guardian escalation that swaps the rule for a ladder rung also
+    # retires the host tree plane (a flat rung has no sub-aggregators to
+    # supervise; rolling back to the tree rung reactivates it).
+    topology_spec = None
+    topology = None
+    if args.topology is not None:
+        from ..topology import parse_topology_spec
+
+        if args.aggregator != "tree":
+            raise UserException(
+                "--topology replaces the aggregation rule with the tree "
+                "spec; pass --aggregator tree (got %r)" % args.aggregator
+            )
+        if args.aggregator_args:
+            raise UserException(
+                "--topology carries the tree's arguments inline "
+                "(tree:g=...,rules=...); drop --aggregator-args"
+            )
+        topology_spec = parse_topology_spec(args.topology, n, f)
+        info("Topology: %s" % topology_spec.describe())
+
     # The escalation ladder overrides exactly these knobs; everything else
     # about the run is immutable.  The training stack is built FROM an
     # Overrides record so a guardian rollback can rebuild it mid-run (one
     # recompile per escalation, paid only on the rare recovery path).
     overrides = Overrides(
-        f, args.aggregator, tuple(args.aggregator_args),
+        f,
+        args.topology if topology_spec is not None else args.aggregator,
+        () if topology_spec is not None else tuple(args.aggregator_args),
         reputation_decay=args.reputation_decay,
         quarantine_threshold=args.quarantine_threshold,
     )
@@ -835,7 +878,9 @@ def main(argv=None):
     # Bounded-wait mode flag (parallel/bounded.py), needed before the
     # flight-recorder lane set: under a deadline the chaos schedule moves
     # to the host clock, so the in-graph regime lane does not exist.
-    bounded_wait = args.step_deadline is not None or args.straggler_stall > 0
+    bounded_wait = (args.step_deadline is not None
+                    or args.straggler_stall > 0
+                    or args.topology is not None)
 
     # Flight recorder (obs/flight.py): the ring's lane set mirrors exactly
     # what the engine will compute (validated again by the engine itself).
@@ -869,10 +914,20 @@ def main(argv=None):
         if args.chaos:
             from ..chaos import ChaosSchedule
 
-            chaos = ChaosSchedule(args.chaos, n, nb_real_byz=r, args=args.chaos_args)
+            chaos = ChaosSchedule(
+                args.chaos, n, nb_real_byz=r, args=args.chaos_args,
+                allow_topology_faults=args.topology is not None,
+            )
             info("Chaos schedule: %d regime(s): %s" % (
                 len(chaos), "  ".join("%d:%s" % t for t in chaos.transitions())
             ))
+            if topology_spec is not None:
+                # every corrupt-agg/straggle-agg target must name a node
+                # the declared tree actually has — rejected here, loudly,
+                # before any compilation
+                for regime in chaos.regimes:
+                    for lvl, unit in regime.agg_corrupt + regime.agg_straggle:
+                        topology_spec.validate_fault_target(lvl, unit)
 
         base_schedule = build_schedule(args.learning_rate, args.learning_rate_args)
 
@@ -1003,10 +1058,21 @@ def main(argv=None):
                     "--step-deadline is single-process (the submission "
                     "threads poll one host's device streams)"
                 )
-            if args.straggler_stall > 0 or args.straggler_rate > 0 or chaos is not None:
+            # a schedule whose only content is topology faults belongs to
+            # the TREE plane (topology.schedule above); the worker-plane
+            # straggler model consumes straggler regimes and refuses
+            # in-graph fault kinds — hand it the schedule only when there
+            # is worker-plane content to consume or refuse
+            chaos_worker = chaos
+            if chaos is not None and not (
+                    chaos.has_stragglers or chaos.has_attacks
+                    or chaos.has_drop or chaos.has_forgery):
+                chaos_worker = None
+            if (args.straggler_stall > 0 or args.straggler_rate > 0
+                    or chaos_worker is not None):
                 straggler_model = HostStragglerModel(
                     n, args.straggler_stall, rate=args.straggler_rate,
-                    chaos=chaos, seed=args.seed,
+                    chaos=chaos_worker, seed=args.seed,
                     jitter=args.straggler_jitter,
                 )
             elif args.straggler_jitter > 0:
@@ -1040,6 +1106,45 @@ def main(argv=None):
                     "--stale-infill needs --step-deadline: the synchronous "
                     "protocol never times anyone out"
                 )
+            if topology_spec is not None:
+                if mesh_axes is not None:
+                    raise UserException(
+                        "--topology needs the flat engine: the tree's "
+                        "custody plane signs the stacked per-worker wire "
+                        "rows, which the sharded submesh submissions never "
+                        "materialize — drop --mesh"
+                    )
+                if args.incremental_aggregation:
+                    raise UserException(
+                        "--topology and --incremental-aggregation are "
+                        "mutually exclusive: the tree's custody plane "
+                        "signs the stacked wire rows at the round "
+                        "barrier, which the incremental fold never "
+                        "materializes"
+                    )
+                from ..topology import TreeAggregator
+
+                # constructed ONCE, outside the guardian rebuild path,
+                # exactly like the deadline controller: the custody chain
+                # head and the learned per-level windows are host protocol
+                # state that must survive an escalation (per-level
+                # controllers carry no registry instruments of their own —
+                # the TreeAggregator's labeled counters are the metrics
+                # surface, so they cannot collide with the leaf
+                # controller's gauges)
+                topology = TreeAggregator(
+                    topology_spec, registry=registry,
+                    session_secret=(args.session_secret.encode()
+                                    if args.session_secret else None),
+                    deadline=args.step_deadline,
+                    deadline_opts=(dict(
+                        percentile=args.deadline_percentile,
+                        floor=args.deadline_floor,
+                        ceiling=args.deadline_ceiling,
+                        ema=args.deadline_ema,
+                    ) if args.deadline_percentile is not None else None),
+                )
+                topology.schedule = chaos
         elif (args.deadline_percentile is not None or args.stale_infill
                 or args.straggler_jitter > 0 or args.incremental_aggregation):
             raise UserException(
@@ -1219,6 +1324,11 @@ def main(argv=None):
                         stale_infill=args.stale_infill,
                         stale_max_age=args.stale_max_age,
                         incremental=args.incremental_aggregation,
+                        # the tree rides only its own rung: an escalation
+                        # that swaps the rule retires the host plane with
+                        # it (nothing to supervise under a flat rule)
+                        topology=(topology if topology is not None
+                                  and ov.gar_name == args.topology else None),
                     )
                     ts.step_fn = ts.bounded_step
                 else:
@@ -1380,6 +1490,11 @@ def main(argv=None):
     ledger = None
     if args.forensics and lead:
         ledger = ForensicsLedger(n, run_id=run_id)
+    if topology is not None and ledger is not None:
+        # the tree's custody verdicts land on the run ledger's SEPARATE
+        # sub-aggregator surface (obs/forensics.py) — a forged emission
+        # names its (level, unit), never a worker
+        topology.ledger = ledger
 
     # Compile observability (obs/profiler.py): every compile-cache miss of
     # a wrapped executable becomes a named counter + a tagged summary event

@@ -1,0 +1,168 @@
+"""``tree`` — the L-level aggregation-tree meta-GAR (in-graph plane).
+
+``hier`` (gars/hierarchical.py) is the 2-level special case; ``tree``
+generalizes it to any depth and adds the topology subsystem's concerns
+(aggregathor_tpu/topology/):
+
+- per-level rules drawn from the live registry, f-budgets COMPOSED through
+  the levels at parse time (topology/spec.py owns the arithmetic:
+  ``b_{l+1} = min(b_l, m_l) + agg_f_l``, a Byzantine parent corrupts at
+  most one outer row);
+- the PR-14 wire codec on every inter-level ``link`` — each level's
+  summaries take a traced encode/decode round trip before the next rule
+  sees them, so in-graph numerics match what the host-plane
+  sub-aggregators actually ship (and the tree multiplies the wire win:
+  ``sum(m_l)`` rows cross compressed links every round instead of one);
+- ``redundancy``/``agg-f`` declarations that size the HOST plane
+  (topology/tree.py: shadow reconstruction, custody chain, per-level
+  bounded wait) — honest shadows compute bit-identical summaries, so the
+  in-graph function is the r-fold-replicated tree's numerics already.
+
+Spec grammar (full reference: topology/spec.py)::
+
+    tree:g=16x4,rules=median>trimmed-mean>krum,link=int8,redundancy=2,agg-f=1x0
+
+**NaN rows.**  A NaN leaf row is absorbed by the first tolerant level on
+its root path; a fully-NaN group (a whole excluded subtree) NaN-poisons
+every rule's summary — average and median alike — so the exclusion
+propagates upward to the first level where a tolerant rule can drop it as
+ONE row.  ``nan_row_tolerant`` is declared the hier way: any tolerant
+level makes the tree tolerant (per-level capacity is bounded by that
+level's feasibility, which parse-time composition already enforces).
+
+**Keys.**  Per-group streams at level l derive from ``fold_in(key, l)``
+folded with the group index; the root uses ``fold_in(key, L + 1)`` — all
+disjoint, and exactly hier's layout at L=1 (inner=fold_in 1, outer=2).
+
+**Participation.**  Composes level by level like hier's: each level
+scatters its rows' weights through its groups' inner weights (uniform
+1/g_l fallback for coordinate-wise rules), so the (n,) vector sums to 1.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import GAR, register
+from .common import centered_gram_sq_distances
+
+
+class TreeGAR(GAR):
+    coordinate_wise = False
+    needs_distances = False  # distances (if any) are per level, computed here
+    uses_axis = True
+    uses_key = True
+    # must mirror topology.spec.TREE_ARG_DEFAULTS (the import is lazy —
+    # topology/tree.py reaches back through parallel/ into this package,
+    # and gars/__init__'s import_directory runs this module mid-init);
+    # tests/test_topology.py asserts the two dicts stay equal
+    ARG_DEFAULTS = {
+        "g": "4",
+        "rules": "median>krum",
+        "link": "f32",
+        "redundancy": 1,
+        "agg-f": "0",
+    }
+
+    def __init__(self, nb_workers, nb_byz_workers, args=None):
+        super().__init__(nb_workers, nb_byz_workers, args)
+        from ..topology.spec import TreeSpec
+
+        self.spec = TreeSpec(nb_workers, nb_byz_workers, self.args)
+        self.nan_row_tolerant = any(
+            r.nan_row_tolerant for r in self.spec.rules
+        ) or self.spec.root_rule.nan_row_tolerant
+
+    # ------------------------------------------------------------------ #
+
+    def _link_roundtrip(self, summaries):
+        """The inter-level wire: what a sub-aggregator ships is what the
+        next level aggregates.  Traced in-graph (compress.py codecs are
+        vmappable), so the fused path and the host plane agree bit-wise."""
+        spec = self.spec
+        if spec.link_codec is not None:
+            return spec.link_codec.roundtrip_rows(summaries)
+        if spec.link_dtype is not None:
+            return summaries.astype(spec.link_dtype).astype(summaries.dtype)
+        return summaries
+
+    def _level_call(self, level, rows, axis_name, key, with_participation):
+        """One level: (m_{l-1}, d_block) rows -> (m_l, d_block) summaries
+        (+ per-group (m_l, g_l) participation when requested)."""
+        rule = self.spec.rules[level]
+        g = self.spec.group_sizes[level]
+        nb_groups = rows.shape[0] // g
+        grouped = rows.reshape(nb_groups, g, rows.shape[-1])
+        dist2 = None
+        if rule.needs_distances:
+            partial = jax.vmap(centered_gram_sq_distances)(
+                grouped.astype(jnp.float32)
+            )
+            if axis_name is not None:
+                partial = jax.lax.psum(partial, axis_name)
+            dist2 = jnp.maximum(partial, 0.0)
+        keys = None
+        if key is not None:
+            base = jax.random.fold_in(key, level + 1)
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(nb_groups)
+            )
+
+        def one(block, d2, k):
+            if with_participation:
+                return rule.aggregate_block_and_participation(
+                    block, d2, axis_name=axis_name, key=k
+                )
+            return rule._call_aggregate(block, d2, axis_name=axis_name, key=k), None
+
+        in_axes = (0, 0 if dist2 is not None else None, 0 if keys is not None else None)
+        summaries, part = jax.vmap(one, in_axes=in_axes)(grouped, dist2, keys)
+        if part is None and with_participation:
+            part = jnp.full((nb_groups, g), 1.0 / g, jnp.float32)
+        return self._link_roundtrip(summaries), part
+
+    def _root_dist2(self, summaries, axis_name):
+        if not self.spec.root_rule.needs_distances:
+            return None
+        partial = centered_gram_sq_distances(summaries.astype(jnp.float32))
+        if axis_name is not None:
+            partial = jax.lax.psum(partial, axis_name)
+        return jnp.maximum(partial, 0.0)
+
+    def _root_key(self, key):
+        return None if key is None else jax.random.fold_in(
+            key, self.spec.nb_levels + 2
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def aggregate_block(self, block, dist2=None, axis_name=None, key=None):
+        rows = block
+        for level in range(self.spec.nb_levels):
+            rows, _ = self._level_call(level, rows, axis_name, key, False)
+        return self.spec.root_rule._call_aggregate(
+            rows, self._root_dist2(rows, axis_name),
+            axis_name=axis_name, key=self._root_key(key),
+        )
+
+    def aggregate_block_and_participation(self, block, dist2=None,
+                                          axis_name=None, key=None):
+        rows = block
+        level_parts = []
+        for level in range(self.spec.nb_levels):
+            rows, part = self._level_call(level, rows, axis_name, key, True)
+            level_parts.append(part)
+        agg, root_part = self.spec.root_rule.aggregate_block_and_participation(
+            rows, self._root_dist2(rows, axis_name),
+            axis_name=axis_name, key=self._root_key(key),
+        )
+        if root_part is None:
+            return agg, None
+        # scatter root weights back down: at each level a group's weight
+        # distributes through its members' within-group weights
+        weights = root_part
+        for part in reversed(level_parts):
+            weights = (weights[:, None] * part).reshape(-1)
+        return agg, weights
+
+
+register("tree", TreeGAR)

@@ -127,6 +127,18 @@ EVENT_TYPES = {
                           "hysteresis, finished instance) — the no-op "
                           "arm of the action ladder, journaled so the "
                           "causal story has no gaps",
+    "topology_level_timeout": "a tree level's bounded-wait window closed "
+                              "on a straggling sub-aggregator unit — the "
+                              "whole subtree timed out as one row "
+                              "(topology/tree.py)",
+    "topology_reconstruction": "a faulted sub-aggregator's summary was "
+                               "served by a verified redundant sibling "
+                               "shadow instead of spending the level's f "
+                               "budget",
+    "topology_corruption_verdict": "a sub-aggregator's custody tag failed "
+                                   "chain verification — NAMED as a "
+                                   "(level, unit) sub-aggregator, not "
+                                   "laundered into worker blame",
 }
 
 #: fields every event carries; ``emit`` keyword fields may not shadow them

@@ -153,6 +153,11 @@ class ForensicsLedger:
         #: the run's causal journal (obs/events.py) cross-ref: path + event
         #: counts by type, so a post-mortem starts from ONE file
         self._journal = None
+        #: [(step, level, unit, kind, payload)] sub-aggregator verdicts
+        #: from the topology plane — a separate surface from worker
+        #: evidence (a forged PARENT is named as a tree node, never
+        #: laundered into the leaf workers it relayed)
+        self._subaggregators = []
         self._steps_observed = 0
 
     # ------------------------------------------------------------------ #
@@ -251,6 +256,26 @@ class ForensicsLedger:
         ))
         self._steps_observed += 1
 
+    def note_subaggregator(self, step, level, unit, kind, payload=None):
+        """Record a SUB-AGGREGATOR verdict from the topology plane
+        (topology/tree.py): a (level, unit) tree node whose custody tag
+        failed chain verification (``forgery``), whose subtree timed out
+        as a unit (``timeout``), or whose summary was served by a
+        redundant sibling shadow (``reconstructed``).
+
+        Deliberately a SEPARATE ledger surface from worker evidence: a
+        forged intermediate is an infrastructure node, and naming it as a
+        (level, unit) keeps the blame where the cryptography put it —
+        never laundered into the leaf workers whose honest rows it
+        relayed (they keep their clean per-worker records)."""
+        self._subaggregators.append({
+            "step": int(step),
+            "level": int(level),
+            "unit": int(unit),
+            "kind": str(kind),
+            "payload": dict(payload or {}),
+        })
+
     def note_guardian(self, step, kind, payload=None):
         """Record a guardian verdict (``rollback``/``escalation``/
         ``recovered``) — the recovery layer's contribution to the audit
@@ -292,6 +317,9 @@ class ForensicsLedger:
         before = len(self._timeline)
         self._timeline = [row for row in self._timeline if row[0] <= step]
         self._guardian = [row for row in self._guardian if row[0] <= step]
+        self._subaggregators = [
+            row for row in self._subaggregators if row["step"] <= step
+        ]
         self._steps_observed = len(self._timeline)
         return before - len(self._timeline)
 
@@ -401,6 +429,15 @@ class ForensicsLedger:
                 if w["timeout_rate"] >= self.straggler_fraction
             ],
             "workers": workers,
+            # topology-plane verdicts (topology/tree.py): per-(level, unit)
+            # sub-aggregator records, aggregated from note_subaggregator —
+            # ``corrupt_subaggregators`` names every tree node with a
+            # custody-forgery verdict as "LEVEL.UNIT"
+            "sub_aggregators": self._subaggregator_records(),
+            "corrupt_subaggregators": sorted({
+                "%d.%d" % (row["level"], row["unit"])
+                for row in self._subaggregators if row["kind"] == "forgery"
+            }),
             "guardian_events": [
                 {"step": step, "kind": kind, "payload": payload}
                 for step, kind, payload in self._guardian
@@ -408,6 +445,29 @@ class ForensicsLedger:
             "flight_postmortems": list(self._flight),
             "journal": None if self._journal is None else dict(self._journal),
         }
+
+    def _subaggregator_records(self):
+        """Aggregate the sub-aggregator timeline into per-(level, unit)
+        records: step span, per-kind counts, and the corrupt verdict (any
+        custody forgery names the node)."""
+        records = {}
+        for row in self._subaggregators:
+            node = (row["level"], row["unit"])
+            rec = records.setdefault(node, {
+                "level": row["level"], "unit": row["unit"],
+                "first_step": row["step"], "last_step": row["step"],
+                "steps": 0, "evidence": {},
+            })
+            rec["first_step"] = min(rec["first_step"], row["step"])
+            rec["last_step"] = max(rec["last_step"], row["step"])
+            rec["steps"] += 1
+            rec["evidence"][row["kind"]] = rec["evidence"].get(row["kind"], 0) + 1
+        out = []
+        for node in sorted(records):
+            rec = records[node]
+            rec["corrupt"] = rec["evidence"].get("forgery", 0) > 0
+            out.append(rec)
+        return out
 
     @staticmethod
     def _merge_intervals(timeline, suspect_steps):
@@ -515,6 +575,29 @@ def render_markdown(report):
             "**BYZANTINE**" if worker["byzantine"] else "honest",
             evidence, spans,
         ))
+    subaggs = report.get("sub_aggregators", [])
+    if subaggs:
+        corrupt = report.get("corrupt_subaggregators", [])
+        lines += ["", "## Sub-aggregators (topology plane)", ""]
+        if corrupt:
+            lines.append("**Corrupt sub-aggregator(s): %s** (custody-chain "
+                         "forgery — named as tree nodes, not workers)."
+                         % ", ".join(corrupt))
+            lines.append("")
+        lines += [
+            "| node | steps | span | verdict | evidence |",
+            "|---|---:|---|---|---|",
+        ]
+        for rec in subaggs:
+            evidence = ", ".join(
+                "%s x%d" % kv for kv in sorted(rec["evidence"].items())
+            ) or "—"
+            lines.append("| %d.%d | %d | %d-%d | %s | %s |" % (
+                rec["level"], rec["unit"], rec["steps"],
+                rec["first_step"], rec["last_step"],
+                "**CORRUPT**" if rec["corrupt"] else "clean",
+                evidence,
+            ))
     events = report.get("guardian_events", [])
     if events:
         lines += ["", "## Guardian events", ""]

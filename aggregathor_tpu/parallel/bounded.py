@@ -199,7 +199,8 @@ class BoundedWaitStep:
 
     def __init__(self, engine, loss_fn, tx, params_template, deadline=None,
                  straggler_model=None, registry=None, controller=None,
-                 stale_infill=False, stale_max_age=4, incremental=False):
+                 stale_infill=False, stale_max_age=4, incremental=False,
+                 topology=None):
         if deadline is not None and deadline <= 0.0:
             raise UserException("--step-deadline must be > 0 seconds")
         if stale_infill and deadline is None and controller is None:
@@ -234,6 +235,27 @@ class BoundedWaitStep:
                 "--incremental-aggregation folds per-WORKER rows; the "
                 "sharded mode's per-submesh submissions need a per-group "
                 "fold layout, a different protocol — run the flat engine"
+            )
+        # the aggregation-tree host plane (topology/tree.py): drives the
+        # per-level protocol once per round at the barrier, over the
+        # stacked leaf rows — flat submissions only (the sharded mode's
+        # per-submesh units are a different grouping than the tree's),
+        # and not composable with the incremental fold (the tree needs
+        # the stacked WIRE rows; the fold buffer is already decoded and
+        # consumed)
+        self.topology = topology
+        if topology is not None and self.grouped:
+            raise UserException(
+                "--topology drives per-WORKER leaf rows; the sharded "
+                "engine's per-submesh submission units are a different "
+                "grouping than the tree's — run the flat engine"
+            )
+        if topology is not None and self.incremental:
+            raise UserException(
+                "--topology and --incremental-aggregation are mutually "
+                "exclusive: the tree's custody plane signs the stacked "
+                "wire rows at the barrier, which the incremental fold "
+                "never materializes"
             )
         if self.grouped:
             self.group_size = engine.workers_per_device
@@ -284,6 +306,11 @@ class BoundedWaitStep:
             miss_row = np.full((d,), np.nan, row_dtype)
         self._nan_template = (np.zeros((), np.float32), miss_row)
         self._zero_row = np.zeros((d,), np.float32)
+        if self.topology is not None:
+            # late-bind the leaf plane: row width + the worker exchange
+            # codec (the tree recomputes and signs the level emissions
+            # over exactly these wire rows)
+            self.topology.bind(self.nb_workers, d, codec=self.codec)
         # incremental mode: the fold executable + the per-round fresh
         # buffer (engine.build_incremental_fold); the fold is our own
         # dispatch against our own buffer, so it shares no donation race
@@ -770,6 +797,20 @@ class BoundedWaitStep:
             rows_in = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *rows
             )
+            if self.topology is not None:
+                # the tree protocol (topology/tree.py): per-level bounded
+                # wait + custody over the stacked wire rows.  Runs AFTER
+                # the worker-plane bookkeeping above (timeout counters,
+                # tracer, bounded_round, the leaf controller) — those
+                # describe what the WORKERS did; the masks below are what
+                # the aggregate consumes, with excluded subtrees cleared
+                # (their NaN infill spends the declared per-level budget)
+                with trace.span("bounded_wait.topology", cat="train",
+                                step=step_idx):
+                    arrived, stale = self.topology.process_round(
+                        step_idx, arrived, stale, arrival_seconds, rows_in,
+                        leaf_window=deadline,
+                    )
         with trace.span("bounded_wait.aggregate", cat="train", step=step_idx):
             return self.agg_fn(
                 state, rows_in, jnp.stack(losses),
@@ -786,6 +827,8 @@ class BoundedWaitStep:
         sizes = [self.grad_fn._cache_size(), self.agg_fn._cache_size()]
         if self._fold_fn is not None:
             sizes.append(self._fold_fn._cache_size())
+        if self.topology is not None:
+            sizes.append(self.topology.cache_size())
         return max(sizes)
 
     def close(self, timeout=5.0):

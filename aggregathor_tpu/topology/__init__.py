@@ -1,0 +1,17 @@
+"""Byzantine-tolerant tree-aggregation topologies (beyond the PS star).
+
+Two planes, one declarative spec:
+
+- :mod:`~aggregathor_tpu.topology.spec` — the ``tree:`` grammar and its
+  parse-time f-composition arithmetic (``TreeSpec``);
+- :mod:`~aggregathor_tpu.gars.tree` — the in-graph numerics (``tree`` in
+  the GAR registry: L-level aggregation + inter-level wire codec);
+- :mod:`~aggregathor_tpu.topology.tree` — the host protocol
+  (``TreeAggregator``: per-level bounded wait, chained custody, redundant
+  reconstruction), driven per round by ``parallel/bounded.py``.
+
+Long-form semantics: docs/topology.md.
+"""
+
+from .spec import TreeSpec, parse_topology_spec  # noqa: F401
+from .tree import TreeAggregator  # noqa: F401

@@ -1,0 +1,549 @@
+"""The host plane of the aggregation tree: per-level bounded wait,
+chained custody, redundant reconstruction.
+
+``gars/tree.py`` is the tree's NUMERICS — one fused in-graph function.
+This module is the tree's PROTOCOL: the per-round decisions a real
+deployment of untrusted sub-aggregators has to make, driven one host step
+per round from ``parallel/bounded.py``:
+
+- **Per-level bounded wait.**  Each level is its own round with its own
+  :class:`~aggregathor_tpu.parallel.deadline.DeadlineController`: a unit's
+  arrival is the max of its children's effective arrivals (a child that
+  missed ITS window was resolved at window close) plus the level's
+  measured aggregation time plus any injected stall (chaos
+  ``straggle-agg``).  A unit past its level window times out AS A UNIT —
+  the whole subtree is one row to its parent.
+- **Redundant reconstruction** (CodedReduce, arXiv:1902.01981).  With
+  ``redundancy=r`` each group is computed by its primary and ``r - 1``
+  circularly-assigned sibling units; honest shadows compute the identical
+  summary from the identical child rows (the tree is deterministic), so a
+  faulted primary is served by its first live verified shadow — the
+  aggregate is unchanged and no budget is spent.  With no live shadow the
+  subtree is EXCLUDED: its leaf workers' ``arrived``/``stale`` flags are
+  cleared, the in-graph NaN conventions propagate one NaN row to the
+  parent level, and the declared per-level budget (``agg-f``) is spent.
+- **Chained custody.**  Every unit HMAC-signs the digest of the wire
+  image it emitted (per-(level, unit) keys under the ``b"topology"``
+  context — disjoint from the worker ``b"submit"`` family); the root
+  verifies every tag and folds them into a rolling chain head
+  (``SHA-256(head || step || level || tags || verdicts)``).  A failed tag
+  NAMES the (level, unit) node — ``topology_corruption_verdict`` in the
+  journal, ``note_subaggregator`` in forensics — and the node is
+  reconstructed or excluded like a timeout.  What the chaos
+  ``corrupt-agg`` fault models is an IMPERSONATED/custody-violating
+  sub-aggregator (it signs without the session secret, the detectable
+  crime); a sub-aggregator that signs its own poison honestly is the
+  ``agg-f`` budget's job, enforced by the levels above it
+  (topology/spec.py's composition arithmetic, probed in the benchmark's
+  breakdown cells).
+
+Everything here is synthetic-clock testable: :meth:`TreeAggregator.
+resolve_round` is the pure decision core (arrivals in, verdicts out — no
+devices, no sleeps, no wall clock), and the chaos stalls are arithmetic
+on the arrival vectors, never ``time.sleep``.
+"""
+
+import hashlib
+import struct
+import time
+
+import numpy as np
+
+from ..obs import events
+from ..parallel.auth import GradientAuthenticator
+from ..parallel.deadline import DeadlineController
+from ..secure.submit import FORGER_SECRET, digest_to_bytes
+from ..utils import UserException
+
+#: what an unsecured tree signs with — custody needs SOME key material so
+#: the chain head is well-defined; forgery DETECTION additionally needs
+#: the operator's --session-secret (the forger's keys must differ)
+DEFAULT_TOPOLOGY_SECRET = b"aggregathor-topology-default-secret"
+
+
+class TreeAggregator:
+    """Per-round tree protocol driver (one per run, survives guardian
+    Overrides rebuilds exactly like the deadline controller).
+
+    Args:
+      spec: a validated :class:`~aggregathor_tpu.topology.spec.TreeSpec`.
+      registry: optional ``MetricsRegistry`` — per-level timing, timeout/
+        reconstruction/corruption counters, bytes-on-wire, link ratio.
+      session_secret: custody key material; ``None`` falls back to
+        :data:`DEFAULT_TOPOLOGY_SECRET` (chain still well-defined, but an
+        impersonator could derive the same keys — pass ``--session-secret``
+        for real forgery detection, docs/security.md).
+      deadline: initial per-level bounded-wait window (seconds); ``None``
+        disables level deadlines (only injected stalls and custody
+        verdicts fault a unit).
+      deadline_opts: dict of DeadlineController knobs (percentile, floor,
+        ceiling, ema) shared by every level's controller.
+
+    Post-construction attachments (the runner's wiring order):
+    ``ledger`` (ForensicsLedger, attached after its construction) and
+    ``schedule`` (ChaosSchedule, queried per round for ``corrupt-agg``/
+    ``straggle-agg`` targets).
+    """
+
+    def __init__(self, spec, registry=None, session_secret=None,
+                 deadline=None, deadline_opts=None):
+        self.spec = spec
+        self.ledger = None
+        self.schedule = None
+        self.deadline = deadline
+        secret = session_secret or DEFAULT_TOPOLOGY_SECRET
+        self.auth = GradientAuthenticator(
+            secret, spec.total_units, context=b"topology"
+        )
+        self._forger = GradientAuthenticator(
+            FORGER_SECRET, spec.total_units, context=b"topology"
+        )
+        self._chain = hashlib.sha256(b"aggregathor-topology-chain-v1").digest()
+        self._chain_steps = 0
+        self.controllers = None
+        if deadline is not None:
+            opts = dict(deadline_opts or {})
+            self.controllers = [
+                DeadlineController(deadline, **opts)
+                for _ in range(spec.nb_levels)
+            ]
+        # bound by the BoundedWaitStep that drives this tree (bind())
+        self._d = None
+        self._codec = None
+        self._level_fns = None
+        self._warm = False
+        self.rounds_total = 0
+        self._c_seconds = self._c_timeouts = self._c_reconstructions = None
+        self._c_corruptions = self._c_exclusions = self._c_bytes = None
+        self._c_rounds = self._g_ratio = None
+        if registry is not None:
+            self._c_seconds = registry.counter(
+                "topology_level_seconds_total",
+                "Cumulative per-level sub-aggregation wall time",
+                labelnames=("level",),
+            )
+            self._c_timeouts = registry.counter(
+                "topology_level_timeouts_total",
+                "Sub-aggregator units that missed their level window",
+                labelnames=("level",),
+            )
+            self._c_reconstructions = registry.counter(
+                "topology_reconstructions_total",
+                "Faulted units served by a redundant sibling shadow",
+                labelnames=("level",),
+            )
+            self._c_corruptions = registry.counter(
+                "topology_corruptions_total",
+                "Units whose custody tag failed chain verification",
+                labelnames=("level",),
+            )
+            self._c_exclusions = registry.counter(
+                "topology_exclusions_total",
+                "Faulted units with no live shadow — whole subtree "
+                "excluded (NaN row, budget spent)",
+                labelnames=("level",),
+            )
+            self._c_bytes = registry.counter(
+                "topology_bytes_on_wire_total",
+                "Bytes shipped on the inter-level links (all redundant "
+                "copies counted)",
+                labelnames=("level",),
+            )
+            self._c_rounds = registry.counter(
+                "topology_rounds_total", "Tree aggregation rounds processed"
+            )
+            self._g_ratio = registry.gauge(
+                "topology_link_compression_ratio",
+                "Inter-level link compression ratio vs the f32 wire",
+            )
+
+    # ------------------------------------------------------------------ #
+    # binding (BoundedWaitStep construction time)
+
+    def bind(self, nb_workers, d, codec=None):
+        """Late-bind the leaf plane: the flattened row width, the WORKER
+        exchange codec (the leaf links' wire — the tree's own inter-level
+        wire is ``spec.link_*``).  Called once by the driving
+        BoundedWaitStep; the per-level jitted emission functions build
+        here and compile on first use (one executable each, counted by
+        :meth:`cache_size` for the zero-recompile assertions)."""
+        if nb_workers != self.spec.nb_workers:
+            raise UserException(
+                "topology tree is sized for n=%d but the engine runs n=%d"
+                % (self.spec.nb_workers, nb_workers)
+            )
+        self._d = int(d)
+        self._codec = codec
+        if self.spec.link_codec is not None:
+            self.spec.link_codec.validate_d(self._d)
+        if self._g_ratio is not None:
+            self._g_ratio.set(self.spec.link_ratio(self._d))
+        self._level_fns = [
+            self._make_level_fn(level) for level in range(self.spec.nb_levels)
+        ]
+
+    def _make_level_fn(self, level):
+        """Level ``level`` (0-based) emission: child rows in, (summaries,
+        per-unit digests) out — the custody plane recomputes what each
+        sub-aggregator ships so there is a concrete wire image to sign.
+        Level 0 additionally decodes the leaf wire and applies the
+        ``arrived|stale`` NaN mask, so the chain signs EXACTLY what the
+        in-graph aggregate consumes."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..gars.common import centered_gram_sq_distances
+        from ..secure.submit import row_digest
+
+        spec = self.spec
+        rule = spec.rules[level]
+        g = spec.group_sizes[level]
+        m = spec.nb_units[level]
+        codec = self._codec
+        d = self._d
+
+        def fn(rows, valid, key):
+            if level == 0:
+                if codec is not None:
+                    rows = codec.decode_rows(rows, d)
+                else:
+                    rows = rows.astype(jnp.float32)
+                rows = jnp.where(valid[:, None], rows, jnp.nan)
+            grouped = rows.reshape(m, g, rows.shape[-1])
+            dist2 = None
+            if rule.needs_distances:
+                partial = jax.vmap(centered_gram_sq_distances)(
+                    grouped.astype(jnp.float32)
+                )
+                dist2 = jnp.maximum(partial, 0.0)
+            base = jax.random.fold_in(key, level + 1)
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(m)
+            )
+
+            def one(block, d2, k):
+                return rule._call_aggregate(block, d2, axis_name=None, key=k)
+
+            in_axes = (0, 0 if dist2 is not None else None, 0)
+            summaries = jax.vmap(one, in_axes=in_axes)(grouped, dist2, keys)
+            # the inter-level wire: ship what the next level aggregates
+            if spec.link_codec is not None:
+                summaries = spec.link_codec.roundtrip_rows(summaries)
+            elif spec.link_dtype is not None:
+                summaries = summaries.astype(spec.link_dtype).astype(
+                    jnp.float32
+                )
+            digests = jax.vmap(row_digest)(summaries)
+            return summaries, digests
+
+        return jax.jit(fn)
+
+    # ------------------------------------------------------------------ #
+    # the pure decision core (synthetic-clock tests drive this directly)
+
+    def resolve_round(self, step, child_arrivals, compute_seconds,
+                      corrupt_units=(), straggle_units=(), windows=None):
+        """One round's per-level verdicts — pure arithmetic, no devices.
+
+        The clock is ABSOLUTE (zero = the leaf round's open).  Level l's
+        round opens when level l-1's round closes (a bounded-wait round
+        closes at its last effective arrival — early when everyone made
+        it, the window when someone did not), and level l's window judges
+        arrivals RELATIVE to that open: a unit whose children all arrived
+        early is ready before its round even opens (relative arrival 0 —
+        the pipelining a tree buys), while a unit resolved by exclusion
+        at level l-1 charges exactly that level's window, never its
+        parent's (no spurious timeout cascade up the root path).
+
+        Args:
+          step: the training step (stamped on ledger notes by the caller).
+          child_arrivals: (n,) FINITE effective leaf arrivals (the caller
+            caps censored leaf timeouts at the leaf window — those rows
+            were already resolved by the leaf protocol).
+          compute_seconds: per-level measured aggregation seconds.
+          corrupt_units: iterable of (level, unit) whose custody tag
+            FAILED verification (1-based level).
+          straggle_units: iterable of (level, unit) with an injected
+            stall — the unit's arrival becomes +inf (a stall is
+            ARITHMETIC here, never a sleep).
+          windows: per-level window seconds (None entries disable that
+            level's deadline); defaults to the live controller windows.
+
+        Returns a list of per-level verdict dicts: ``{level, window,
+        arrivals, timed_out, corrupt, reconstructed: {unit: shadow},
+        excluded: [unit, ...]}`` — ``arrivals`` are the round-RELATIVE
+        per-unit arrivals the level's controller observes.  ``excluded``
+        units' leaf spans are what :meth:`process_round` clears from
+        ``arrived``/``stale``.
+        """
+        spec = self.spec
+        if windows is None:
+            if self.controllers is not None:
+                windows = [c.window for c in self.controllers]
+            else:
+                windows = [None] * spec.nb_levels
+        corrupt_units = set((int(l), int(u)) for l, u in corrupt_units)
+        straggle_units = set((int(l), int(u)) for l, u in straggle_units)
+        arrivals = np.asarray(child_arrivals, np.float64).reshape(-1)
+        close = float(arrivals.max()) if arrivals.size else 0.0
+        verdicts = []
+        for index in range(spec.nb_levels):
+            level = index + 1
+            g = spec.group_sizes[index]
+            m = spec.nb_units[index]
+            window = windows[index]
+            # absolute availability: a unit starts when its last child
+            # lands, takes the level's measured compute, plus any injected
+            # stall (a stall is arithmetic, never a sleep)
+            avail = (
+                arrivals.reshape(m, g).max(axis=1)
+                + float(compute_seconds[index])
+            )
+            for (l, u) in straggle_units:
+                if l == level:
+                    avail[u] = np.inf
+            finite = np.isfinite(avail)
+            # round-relative arrival: this level's round opens at the
+            # previous close; a unit done before then arrives at 0
+            relative = np.maximum(avail - close, 0.0)
+            if window is None:
+                timed_out = ~finite
+            else:
+                timed_out = ~finite | (relative > window)
+            corrupt = np.zeros((m,), bool)
+            for (l, u) in corrupt_units:
+                if l == level:
+                    corrupt[u] = True
+            faulted = timed_out | corrupt
+            # resolution: first live verified shadow serves, else exclude.
+            # Shadow liveness is judged against the full fault set — a
+            # shadow that is itself faulted this round cannot serve.
+            reconstructed = {}
+            excluded = []
+            for unit in np.nonzero(faulted)[0]:
+                shadow = next(
+                    (s for s in spec.shadows(level, int(unit))
+                     if not faulted[s]),
+                    None,
+                )
+                if shadow is not None:
+                    reconstructed[int(unit)] = int(shadow)
+                else:
+                    excluded.append(int(unit))
+            # this level's absolute close: its last effective arrival —
+            # a clean unit at its own availability (capped at the window
+            # close), a reconstructed unit at its shadow's, an excluded
+            # unit at the full window (the level waited it out)
+            if window is not None:
+                cap = close + float(window)
+            elif finite.any():
+                cap = float(avail[finite].max())
+            else:
+                cap = close + float(compute_seconds[index])
+            effective = np.minimum(np.where(finite, avail, cap), cap)
+            for unit, shadow in reconstructed.items():
+                effective[unit] = effective[shadow]
+            for unit in excluded:
+                effective[unit] = cap
+            verdicts.append({
+                "level": level,
+                "window": window,
+                "arrivals": relative,
+                "timed_out": timed_out,
+                "corrupt": corrupt,
+                "reconstructed": reconstructed,
+                "excluded": excluded,
+            })
+            arrivals = effective
+            close = float(effective.max()) if effective.size else close
+        return verdicts
+
+    # ------------------------------------------------------------------ #
+    # the per-round protocol (driven by parallel/bounded.py)
+
+    def process_round(self, step, arrived, stale, arrival_seconds, rows_in,
+                      leaf_window=None):
+        """One completed leaf round through the tree: emissions + custody
+        + per-level bounded wait + reconstruction/exclusion.  Returns the
+        updated ``(arrived, stale)`` masks (excluded subtrees cleared —
+        the in-graph aggregate NaN-masks them like any other drop).
+        """
+        import jax
+
+        if self._level_fns is None:
+            raise UserException(
+                "TreeAggregator.process_round before bind() — the driving "
+                "BoundedWaitStep binds the leaf plane at construction"
+            )
+        spec = self.spec
+        arrived = np.asarray(arrived).astype(bool).copy()
+        stale = np.asarray(stale).astype(bool).copy()
+        valid = arrived | stale
+
+        regime = None
+        if self.schedule is not None:
+            regime = self.schedule.regimes[self.schedule.regime_at(step)]
+        corrupt_targets = tuple(getattr(regime, "agg_corrupt", ()) or ())
+        straggle_targets = tuple(getattr(regime, "agg_straggle", ()) or ())
+
+        # ---- emissions: recompute each level's wire images + digests ----
+        import jax.numpy as jnp
+
+        key = jax.random.PRNGKey(int(step))
+        valid_dev = jnp.asarray(valid)
+        compute_seconds = []
+        level_digests = []
+        rows = rows_in
+        for index, fn in enumerate(self._level_fns):
+            begin = time.perf_counter()
+            rows, digests = fn(rows, valid_dev, key)
+            digests = np.asarray(jax.device_get(digests))
+            elapsed = time.perf_counter() - begin
+            compute_seconds.append(elapsed)
+            level_digests.append(digests)
+            if self._c_seconds is not None:
+                self._c_seconds.labels(level=str(index + 1)).inc(elapsed)
+
+        # ---- custody: sign every unit's wire image, verify the chain ----
+        corrupt_units = []
+        for index, digests in enumerate(level_digests):
+            level = index + 1
+            tags = []
+            verdicts = []
+            for unit in range(spec.nb_units[index]):
+                idx = spec.unit_index(level, unit)
+                payload = digest_to_bytes(digests[unit])
+                if (level, unit) in set(corrupt_targets):
+                    # the chaos fault: this unit signs WITHOUT the session
+                    # secret (impersonation / custody violation)
+                    tag = self._forger.sign(idx, int(step), payload)
+                else:
+                    tag = self.auth.sign(idx, int(step), payload)
+                ok = self.auth.verify(idx, int(step), payload, tag)
+                tags.append(tag)
+                verdicts.append(ok)
+                if not ok:
+                    corrupt_units.append((level, unit))
+                    if self._c_corruptions is not None:
+                        self._c_corruptions.labels(level=str(level)).inc()
+            self._chain = hashlib.sha256(
+                self._chain + struct.pack("<qq", int(step), level)
+                + b"".join(tags)
+                + np.asarray(verdicts, bool).tobytes()
+            ).digest()
+        self._chain_steps += 1
+
+        # ---- per-level bounded wait over the SYNTHETIC+measured clock ---
+        if leaf_window is not None:
+            cap = float(leaf_window)
+        elif np.isfinite(arrival_seconds).any():
+            cap = float(np.asarray(arrival_seconds)[
+                np.isfinite(arrival_seconds)].max())
+        else:
+            cap = 0.0
+        leaf_arrivals = np.where(
+            np.isfinite(arrival_seconds), arrival_seconds, cap
+        )
+        warm = self._warm
+        self._warm = True
+        windows = None
+        if not warm or self.controllers is None:
+            # the first processed round compiles the emission executables;
+            # charging XLA against the level windows would fault every
+            # unit of round 0 (the leaf protocol gates its deadline the
+            # same way) — injected stalls still resolve (inf beats any
+            # window, including none)
+            windows = [None] * spec.nb_levels
+        verdicts = self.resolve_round(
+            step, leaf_arrivals, compute_seconds,
+            corrupt_units=corrupt_units, straggle_units=straggle_targets,
+            windows=windows,
+        )
+
+        # ---- apply + account -------------------------------------------
+        for verdict in verdicts:
+            level = verdict["level"]
+            index = level - 1
+            if self.controllers is not None and warm:
+                censored = np.where(
+                    verdict["timed_out"], np.inf, verdict["arrivals"]
+                )
+                self.controllers[index].observe_round(censored, step=step)
+            if self._c_bytes is not None:
+                self._c_bytes.labels(level=str(level)).inc(
+                    spec.nb_units[index] * spec.redundancy
+                    * spec.link_bytes_per_row(self._d)
+                )
+            for unit in np.nonzero(verdict["timed_out"])[0]:
+                unit = int(unit)
+                excluded = unit in verdict["excluded"]
+                if self._c_timeouts is not None:
+                    self._c_timeouts.labels(level=str(level)).inc()
+                events.emit(
+                    "topology_level_timeout", step=int(step), level=level,
+                    unit=unit,
+                    window=None if verdict["window"] is None
+                    else float(verdict["window"]),
+                    excluded=excluded,
+                )
+                if self.ledger is not None:
+                    self.ledger.note_subaggregator(
+                        step, level, unit, "timeout",
+                        {"excluded": excluded},
+                    )
+            for unit in np.nonzero(verdict["corrupt"])[0]:
+                unit = int(unit)
+                excluded = unit in verdict["excluded"]
+                events.emit(
+                    "topology_corruption_verdict", step=int(step),
+                    level=level, unit=unit, excluded=excluded,
+                )
+                if self.ledger is not None:
+                    self.ledger.note_subaggregator(
+                        step, level, unit, "forgery",
+                        {"excluded": excluded},
+                    )
+            for unit, shadow in verdict["reconstructed"].items():
+                cause = (
+                    "forgery" if verdict["corrupt"][unit] else "timeout"
+                )
+                if self._c_reconstructions is not None:
+                    self._c_reconstructions.labels(level=str(level)).inc()
+                events.emit(
+                    "topology_reconstruction", step=int(step), level=level,
+                    unit=int(unit), shadow=int(shadow), cause=cause,
+                )
+                if self.ledger is not None:
+                    self.ledger.note_subaggregator(
+                        step, level, unit, "reconstructed",
+                        {"shadow": int(shadow), "cause": cause},
+                    )
+            for unit in verdict["excluded"]:
+                if self._c_exclusions is not None:
+                    self._c_exclusions.labels(level=str(level)).inc()
+                span = spec.leaf_span(level, unit)
+                arrived[span.start:span.stop] = False
+                stale[span.start:span.stop] = False
+        self.rounds_total += 1
+        if self._c_rounds is not None:
+            self._c_rounds.inc()
+        return arrived, stale
+
+    # ------------------------------------------------------------------ #
+
+    def chain(self):
+        """The custody-chain lineage (the topology twin of
+        ``SubmissionAuthenticator.chain()``)."""
+        return {
+            "head": self._chain.hex(),
+            "steps": self._chain_steps,
+            "nb_units": self.spec.total_units,
+        }
+
+    def cache_size(self):
+        """Max compile count over the per-level emission executables —
+        the zero-recompile surface (steady state reads 1, like every
+        other executable the CompileWatch sums over)."""
+        if not self._level_fns:
+            return 0
+        return max(fn._cache_size() for fn in self._level_fns)

@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# Topology smoke on CPU (<45 s; docs/topology.md).  (Leg 1) one real-CLI
+# --topology run (in-graph tree GAR + host tree plane) with a chaos
+# corrupt-agg fault forging sub-aggregator (1, 0)'s custody tag:
+# (1) forensics NAMES "1.0" on the sub-aggregator surface and blames NO
+# leaf worker, (2) the journal replays the causal per-level chain
+# (topology_corruption_verdict -> topology_reconstruction, EV001-clean
+# types), (3) the int8 inter-level link reads a >1 compression ratio on
+# the one metrics registry and the corruption counter is nonzero,
+# (4) training loss stays finite through every summary.  (Leg 2) the
+# aggregathor.topology.sweep.v1 schema round-trips on the checked-in
+# TOPO_r18.json and its verdict still reads PASS at n >= 256.  (Leg 3)
+# the graftcheck GAR-contract sweep over the tree composite nestings
+# (tree-of-composites AND tree-under-hier) probes clean.
+# The CI-sized version of benchmarks/topology_sweep.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_topology}"
+rm -rf "$out"
+mkdir -p "$out"
+
+# ---- leg 1: the tree through the real CLI, corrupted sub-aggregator -- #
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:8 \
+  --aggregator tree \
+  --topology "tree:g=4,rules=median>average-nan,link=int8,redundancy=2" \
+  --nb-workers 8 --nb-decl-byz-workers 1 \
+  --max-step 10 --platform cpu --learning-rate-args initial-rate:0.05 \
+  --chaos "0:corrupt-agg=1.0" \
+  --evaluation-delta 0 --summary-delta 4 \
+  --metrics-file "$out/metrics.prom" \
+  --summary-dir "$out/summaries" \
+  --journal "$out/journal.jsonl" --run-id toposmoke01 \
+  --forensics "$out/forensics.json"
+
+python - "$out" <<'EOF'
+import glob, json, os, sys
+
+import numpy as np
+
+out = sys.argv[1]
+
+# (1) the forged sub-aggregator is NAMED as a tree node — and the blame
+# stays off the leaf workers (naming, not laundering)
+report = json.load(open(os.path.join(out, "forensics.json")))
+assert report["corrupt_subaggregators"] == ["1.0"], report["corrupt_subaggregators"]
+assert report["suspects"] == [], report["suspects"]
+named = [r for r in report["sub_aggregators"]
+         if (r["level"], r["unit"]) == (1, 0)]
+assert named and named[0]["corrupt"], named
+assert named[0]["evidence"].get("forgery", 0) > 0, named[0]["evidence"]
+assert named[0]["evidence"].get("reconstructed", 0) > 0, named[0]["evidence"]
+
+# (2) the journal replays the causal chain per step: the custody verdict
+# on (1, 0), then the redundant shadow serving the reconstruction
+from aggregathor_tpu.obs import events
+records = events.load_journal(os.path.join(out, "journal.jsonl"))
+verdicts = [r for r in records if r["type"] == "topology_corruption_verdict"]
+recons = [r for r in records if r["type"] == "topology_reconstruction"]
+assert verdicts and recons, sorted({r["type"] for r in records})
+assert all((r["level"], r["unit"]) == (1, 0) for r in verdicts), verdicts[:2]
+for rec in recons:
+    assert (rec["level"], rec["unit"]) == (1, 0) and rec["shadow"] != rec["unit"], rec
+steps = {r["step"] for r in verdicts}
+assert steps == {r["step"] for r in recons}, (steps, recons[:2])
+index = {(r["type"], r.get("step")): i for i, r in enumerate(records)
+         if r["type"].startswith("topology_")}
+for step in steps:
+    assert index[("topology_corruption_verdict", step)] \
+        < index[("topology_reconstruction", step)], step
+
+# (3) inter-level wire accounting + the corruption counter on the one
+# metrics registry
+prom = open(os.path.join(out, "metrics.prom")).read()
+def value(prefix):
+    rows = [float(l.rsplit(" ", 1)[1]) for l in prom.splitlines()
+            if l.startswith(prefix)]
+    assert rows, prefix
+    return sum(rows)
+assert value("topology_link_compression_ratio ") > 1.0, prom
+assert value("topology_corruptions_total") > 0, prom
+assert value("topology_reconstructions_total") > 0, prom
+assert value("topology_bytes_on_wire_total") > 0, prom
+
+# (4) training converged through the faulted round: finite losses
+losses = []
+for path in glob.glob(os.path.join(out, "summaries", "*.jsonl")):
+    for line in open(path):
+        event = json.loads(line)
+        if "total_loss" in event:
+            losses.append(float(event["total_loss"]))
+assert losses and np.isfinite(losses).all(), losses
+
+print("topology smoke: CLI leg OK (corrupt 1.0 named, %d verdicts, "
+      "%d reconstructions, %d summaries finite)"
+      % (len(verdicts), len(recons), len(losses)))
+EOF
+
+# ---- leg 2: sweep schema round-trip on the checked-in document ------- #
+JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+
+sys.path.insert(0, "benchmarks")
+import topology_sweep
+
+doc = topology_sweep.load("TOPO_r18.json")
+assert doc["verdict"]["pass"], doc["verdict"]
+assert doc["config"]["nb_workers"] >= 256
+assert doc["forensics"]["corrupt_subaggregators"] == ["1.0"]
+assert doc["forensics"]["workers_blamed"] == []
+print("topology smoke: schema leg OK (n=%d, %d cells, named %s)"
+      % (doc["config"]["nb_workers"], len(doc["cells"]),
+         doc["forensics"]["corrupt_subaggregators"]))
+EOF
+
+# ---- leg 3: the graftcheck tree-nesting contract sweep --------------- #
+JAX_PLATFORMS=cpu python - <<'EOF'
+from aggregathor_tpu.analysis import gar_contract
+
+for spec in ("tree",
+             "tree:g=2x2,rules=median>median>average-nan",
+             "tree:g=4,rules=bucketing(s=2,inner=median)>krum",
+             "hier:g=2,inner=median,outer=tree(g=2,rules=median>average-nan)"):
+    findings = gar_contract.check_spec(spec)
+    assert not findings, (spec, [str(f) for f in findings])
+print("topology smoke: contract leg OK (tree nestings probe clean)")
+EOF
+
+echo "topology smoke: ALL OK -> $out"

@@ -103,6 +103,9 @@ def test_nasnet_odd_spatial_sizes():
     assert model.apply(params, x).shape == (1, 10)
 
 
+@pytest.mark.slow  # 60+ s of inception compiles on CPU; the aux-head
+# parity it pins is zoo-plumbing exercised by test_zoo_experiment_end_to_end
+# — slow-tiered to pay for the PR-18 topology suite (tier-1 discipline)
 def test_inception_aux_head_trains():
     """The aux-logits head contributes to the loss (slims.py:122-124 parity)."""
     exp = models.instantiate("slim-inception_v1-cifar10", ["batch-size:2", "aux-weight:0.4"])

@@ -1,0 +1,512 @@
+"""Aggregation-tree topology tests (ISSUE 18 tentpole, topology/ +
+gars/tree.py): parse-time f-composition and refusals, TreeGAR numerics
+(nested-hier equivalence, NaN absorption, participation, the int8 link),
+the per-level f-budget composition boundary (coalition inside one group
+vs spread across groups, pinned at r=f and r=f+1), the host plane's pure
+decision core (reconstruction, exclusion, the no-cascade clock), chained
+custody (a forged sub-aggregator is NAMED, never laundered into worker
+blame), chaos corrupt-agg/straggle-agg DSL + gate, and zero steady-state
+recompiles.  Everything host-plane here runs on a SYNTHETIC clock — no
+sleeps, no wall-clock deadlines."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aggregathor_tpu import gars
+from aggregathor_tpu.chaos import ChaosSchedule
+from aggregathor_tpu.chaos.schedule import parse_topology_targets
+from aggregathor_tpu.gars.tree import TreeGAR
+from aggregathor_tpu.obs.forensics import ForensicsLedger
+from aggregathor_tpu.obs.metrics import MetricsRegistry
+from aggregathor_tpu.topology import TreeAggregator, parse_topology_spec
+from aggregathor_tpu.topology.spec import TREE_ARG_DEFAULTS
+from aggregathor_tpu.utils import UserException
+
+
+# --------------------------------------------------------------------- #
+# spec parsing + f-composition (topology/spec.py)
+
+
+def test_spec_parses_the_full_grammar():
+    spec = parse_topology_spec(
+        "tree:g=4x2,rules=median>average-nan>median,link=int8,redundancy=2,"
+        "agg-f=1x0", 32, 2)
+    assert spec.group_sizes == [4, 2]
+    assert spec.nb_units == [8, 4]
+    assert spec.nb_levels == 2
+    assert spec.redundancy == 2
+    assert spec.agg_fs == [1, 0]
+    # b1 = f = 2; b2 = min(2, 8) + 1 = 3; b_root = min(3, 4) + 0 = 3
+    assert spec.row_budgets == [2, 3, 3]
+    assert spec.total_units == 12
+    assert spec.link_codec is not None  # int8
+    assert "g=4x2" in spec.describe()
+
+
+def test_spec_defaults_mirror_the_gar():
+    # gars/tree.py carries a literal copy (the import is lazy to survive
+    # gars/__init__'s mid-init import_directory) — they must stay equal
+    assert TreeGAR.ARG_DEFAULTS == TREE_ARG_DEFAULTS
+
+
+@pytest.mark.parametrize("spec,n,f,fragment", [
+    # g must divide the rows entering its level
+    ("tree:g=3,rules=median>average-nan", 8, 1, "does not divide"),
+    # one rule per level plus the root
+    ("tree:g=4x2,rules=median>krum", 16, 1, "rules wants 3"),
+    # the composed budget may never reach a corrupt majority-or-all
+    ("tree:g=2,rules=average-nan>average-nan,agg-f=3", 8, 1,
+     "corrupt majority"),
+    # the ROOT rule's own feasibility check runs at parse time
+    ("tree:g=4,rules=median>krum", 16, 2, "krum"),
+    # shadows are sibling units — a level cannot host more copies
+    ("tree:g=4,rules=median>average-nan,redundancy=3", 8, 1, "redundancy"),
+    # an inter-level link carries no residual state
+    ("tree:g=4,rules=median>average-nan,link=int8:ef", 8, 1, "ef"),
+    # group size 1 aggregates nothing
+    ("tree:g=1,rules=median>average-nan", 8, 1, ">= 2"),
+])
+def test_spec_refusals(spec, n, f, fragment):
+    with pytest.raises(UserException, match=fragment):
+        parse_topology_spec(spec, n, f)
+
+
+def test_spec_refuses_non_tree_names():
+    with pytest.raises(UserException, match="tree"):
+        parse_topology_spec("krum", 8, 1)
+
+
+def test_spec_shape_helpers():
+    spec = parse_topology_spec(
+        "tree:g=4x2,rules=median>average-nan>average-nan,redundancy=2",
+        32, 1)
+    # level 2 unit 1 covers leaf workers 8..15 (width 4*2)
+    assert list(spec.leaf_span(2, 1)) == list(range(8, 16))
+    assert list(spec.leaf_span(1, 3)) == list(range(12, 16))
+    # circular shadow assignment at each level's width
+    assert spec.shadows(1, 7) == [0]
+    assert spec.shadows(2, 3) == [0]
+    # flat custody indices: level 1 units first, then level 2
+    assert spec.unit_index(1, 0) == 0
+    assert spec.unit_index(2, 0) == 8
+    assert spec.total_units == 12
+    spec.validate_fault_target(2, 3)
+    with pytest.raises(UserException, match="level"):
+        spec.validate_fault_target(3, 0)
+    with pytest.raises(UserException, match="unit"):
+        spec.validate_fault_target(1, 8)
+
+
+def test_spec_link_accounting():
+    d = 64
+    spec = parse_topology_spec(
+        "tree:g=4,rules=median>average-nan,link=int8", 8, 1)
+    flat = parse_topology_spec(
+        "tree:g=4,rules=median>average-nan", 8, 1)
+    assert spec.link_ratio(d) > 3.0  # int8 vs the f32 wire
+    assert flat.link_ratio(d) == 1.0
+    assert spec.link_bytes_per_round(d) == 2 * spec.link_bytes_per_row(d)
+
+
+# --------------------------------------------------------------------- #
+# TreeGAR numerics (gars/tree.py)
+
+
+def test_tree_matches_nested_hier_bit_exactly():
+    """The tree at L=2 IS hier-in-hier: same group keys, same rule calls,
+    same participation — the generalization must not move a bit."""
+    n, f, d = 8, 0, 16
+    tree = gars.instantiate("tree:g=2x2,rules=median>median>average-nan", n, f)
+    hier = gars.instantiate(
+        "hier:g=2,inner=median,"
+        "outer=hier(g=2,inner=median,outer=average-nan)", n, f)
+    rows = jnp.asarray(
+        np.random.default_rng(7).normal(size=(n, d)).astype(np.float32))
+    key = jax.random.PRNGKey(3)
+    np.testing.assert_array_equal(
+        np.asarray(tree.aggregate(rows, key=key)),
+        np.asarray(hier.aggregate(rows, key=key)))
+
+
+def test_tree_absorbs_nan_rows_within_budget():
+    n, f, d = 8, 1, 8
+    tree = gars.instantiate("tree:g=4,rules=average-nan>average-nan", n, f)
+    assert tree.nan_row_tolerant
+    rows = np.random.default_rng(1).normal(size=(n, d)).astype(np.float32)
+    rows[0] = np.nan
+    out = np.asarray(tree.aggregate(jnp.asarray(rows), key=jax.random.PRNGKey(0)))
+    assert np.isfinite(out).all()
+
+
+def test_tree_participation_sums_to_one():
+    # krum root: real selection weights, scattered down through the
+    # levels' uniform 1/g fallbacks to a (n,) vector summing to 1
+    n, f, d = 16, 1, 8
+    tree = gars.instantiate("tree:g=2x2,rules=median>median>krum", n, f)
+    rows = jnp.asarray(
+        np.random.default_rng(2).normal(size=(n, d)).astype(np.float32))
+    agg, part = tree.aggregate_block_and_participation(
+        rows, key=jax.random.PRNGKey(1))
+    part = np.asarray(part)
+    assert part.shape == (n,)
+    np.testing.assert_allclose(part.sum(), 1.0, rtol=1e-5)
+
+
+def test_tree_int8_link_stays_close_to_f32():
+    n, f, d = 8, 1, 32
+    key = jax.random.PRNGKey(5)
+    rows = jnp.asarray(
+        np.random.default_rng(3).normal(size=(n, d)).astype(np.float32))
+    exact = np.asarray(gars.instantiate(
+        "tree:g=4,rules=median>average-nan", n, f).aggregate(rows, key=key))
+    quant = np.asarray(gars.instantiate(
+        "tree:g=4,rules=median>average-nan,link=int8", n, f
+    ).aggregate(rows, key=key))
+    assert np.isfinite(quant).all()
+    # int8 quantization moves values, but not far at this magnitude
+    np.testing.assert_allclose(quant, exact, atol=0.05)
+
+
+# --------------------------------------------------------------------- #
+# the per-level f-budget composition boundary (ISSUE 18 satellite):
+# a level is a PARTITION of its rows — b corrupted rows contaminate at
+# most min(b, m) outer rows, so a coalition INSIDE one group burns its
+# budget on a single outer row while the same coalition SPREAD across
+# groups corrupts one outer row each.  Pinned at r=f (converges) and
+# r=f+1 (the outer rule's order statistic is captured).
+
+
+def _boundary_tree(n=8, f=1):
+    # average inner: ANY attacker corrupts its group's summary — the
+    # sharpest instrument for counting corrupted outer rows; the root
+    # median(4) takes the UPPER median (index 2), captured by 2 big rows
+    return gars.instantiate("tree:g=2,rules=average-nan>median", n, f)
+
+
+def _boundary_rows(attackers, n=8, d=8, k=1000.0):
+    rows = np.random.default_rng(11).normal(size=(n, d)).astype(np.float32)
+    rows *= 0.1
+    for w in attackers:
+        rows[w] = k
+    return jnp.asarray(rows)
+
+
+def _boundary_agg(attackers):
+    tree = _boundary_tree()
+    out = tree.aggregate(_boundary_rows(attackers), key=jax.random.PRNGKey(9))
+    return np.asarray(out)
+
+
+def test_budget_boundary_r_eq_f_converges_any_placement():
+    # r = f = 1: one corrupted outer row of four — the root median holds
+    for attackers in ([0], [3], [7]):
+        out = _boundary_agg(attackers)
+        assert np.isfinite(out).all()
+        assert np.abs(out).max() < 10.0, (attackers, out)
+
+
+def test_budget_boundary_r_eq_f_plus_one_spread_poisons_the_root():
+    # r = f + 1 = 2 SPREAD across two groups: two corrupted outer rows
+    # capture the upper median of four — the declared budget is the
+    # breakdown point, exactly as the composition arithmetic promises
+    out = _boundary_agg([0, 2])
+    assert np.abs(out).max() > 100.0, out
+
+
+def test_budget_boundary_coalition_in_one_group_is_contained():
+    # the SAME r = f + 1 coalition concentrated inside one group corrupts
+    # only that group's row — the partition bound caps the damage and the
+    # root still converges (over-budget, but wasted on one outer row)
+    out = _boundary_agg([0, 1])
+    assert np.isfinite(out).all()
+    assert np.abs(out).max() < 10.0, out
+
+
+# --------------------------------------------------------------------- #
+# the host plane's pure decision core (topology/tree.py resolve_round —
+# synthetic clock: arrivals in, verdicts out, no devices, no sleeps)
+
+
+def _aggregator(spec_text="tree:g=2,rules=average-nan>average-nan,redundancy=2",
+                n=8, f=1, deadline=None, registry=None):
+    spec = parse_topology_spec(spec_text, n, f)
+    return TreeAggregator(spec, registry=registry, deadline=deadline)
+
+
+def test_resolve_round_reconstructs_from_a_live_shadow():
+    agg = _aggregator()
+    verdicts = agg.resolve_round(
+        0, child_arrivals=np.full(8, 0.1), compute_seconds=[0.01],
+        straggle_units=[(1, 2)], windows=[0.5])
+    (v,) = verdicts
+    assert v["timed_out"][2] and not v["timed_out"][[0, 1, 3]].any()
+    assert v["reconstructed"] == {2: 3}
+    assert v["excluded"] == []
+
+
+def test_resolve_round_excludes_without_redundancy():
+    agg = _aggregator("tree:g=2,rules=average-nan>average-nan", 8, 1)
+    verdicts = agg.resolve_round(
+        0, child_arrivals=np.full(8, 0.1), compute_seconds=[0.01],
+        straggle_units=[(1, 2)], windows=[0.5])
+    (v,) = verdicts
+    assert v["reconstructed"] == {}
+    assert v["excluded"] == [2]
+    # the exclusion clears exactly the unit's leaf span
+    assert list(agg.spec.leaf_span(1, 2)) == [4, 5]
+
+
+def test_resolve_round_faulted_shadow_cannot_serve():
+    # shadow liveness is judged against the FULL fault set: unit 2's
+    # only shadow (3) is itself faulted — excluded; unit 3's circular
+    # shadow wraps to live unit 0 — reconstructed
+    agg = _aggregator()
+    verdicts = agg.resolve_round(
+        0, child_arrivals=np.full(8, 0.1), compute_seconds=[0.01],
+        straggle_units=[(1, 2), (1, 3)], windows=[0.5])
+    (v,) = verdicts
+    assert v["excluded"] == [2]
+    assert v["reconstructed"] == {3: 0}
+
+
+def test_resolve_round_exclusion_does_not_cascade():
+    """An excluded level-1 unit charges exactly its own level's window,
+    never its parent's: level 2 opens at level 1's close, so the parent
+    of an excluded subtree is judged on ITS OWN relative lateness (the
+    absolute-clock semantics; a spurious cascade would exclude the whole
+    root path and clear 4 workers instead of 2)."""
+    agg = _aggregator(
+        "tree:g=2x2,rules=average-nan>average-nan>average-nan", 8, 1)
+    verdicts = agg.resolve_round(
+        0, child_arrivals=np.full(8, 0.1), compute_seconds=[0.01, 0.01],
+        straggle_units=[(1, 2)], windows=[0.5, 0.5])
+    level1, level2 = verdicts
+    assert level1["excluded"] == [2]
+    # the parent (level 2 unit 1) saw its straggling child resolved at
+    # level 1's window close — it is NOT late at its own level
+    assert not level2["timed_out"].any()
+    assert level2["excluded"] == []
+
+
+def test_resolve_round_pipelines_early_arrivals():
+    # a unit whose children all arrived early is ready before its round
+    # even opens: relative arrival 0 (the pipelining a tree buys)
+    agg = _aggregator(deadline=None)
+    verdicts = agg.resolve_round(
+        0, child_arrivals=np.linspace(0.0, 0.4, 8), compute_seconds=[0.01],
+        windows=[0.5])
+    (v,) = verdicts
+    assert v["arrivals"][0] == 0.0  # children landed long before close
+    assert not v["timed_out"].any()
+
+
+# --------------------------------------------------------------------- #
+# the per-round protocol: emissions, custody, naming, metrics
+
+
+def _protocol_stack(spec_text, chaos_spec=None, n=8, f=1, d=16,
+                    registry=None, ledger=None):
+    agg = _aggregator(spec_text, n=n, f=f, registry=registry)
+    agg.bind(n, d)
+    if chaos_spec is not None:
+        agg.schedule = ChaosSchedule(chaos_spec, n,
+                                     allow_topology_faults=True)
+    agg.ledger = ledger
+    return agg
+
+
+def _drive_rounds(agg, steps, n=8, d=16, seed=21):
+    rng = np.random.default_rng(seed)
+    arrived = stale = None
+    for step in range(steps):
+        rows = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        arrived, stale = agg.process_round(
+            step, np.ones(n, bool), np.zeros(n, bool),
+            np.full(n, 0.05), rows, leaf_window=1.0)
+    return arrived, stale
+
+
+def test_corrupt_subaggregator_is_named_not_laundered():
+    """ACCEPTANCE: a corrupt-agg unit signs under the forger's keys, the
+    chain names (level, unit) on the ledger's SEPARATE sub-aggregator
+    surface, the shadow reconstructs it (r=2: no worker excluded), and
+    NO worker picks up forgery blame."""
+    reg = MetricsRegistry()
+    ledger = ForensicsLedger(8)
+    agg = _protocol_stack(
+        "tree:g=2,rules=average-nan>average-nan,redundancy=2",
+        chaos_spec="0:corrupt-agg=1.0", registry=reg, ledger=ledger)
+    arrived, stale = _drive_rounds(agg, 3)
+    assert arrived.all()  # reconstructed, not excluded
+    report = ledger.report()
+    assert report["corrupt_subaggregators"] == ["1.0"]
+    (rec,) = report["sub_aggregators"]
+    assert rec["level"] == 1 and rec["unit"] == 0 and rec["corrupt"]
+    assert rec["evidence"]["forgery"] == 3
+    assert rec["evidence"]["reconstructed"] == 3
+    # worker evidence stays CLEAN: custody violations are never worker blame
+    assert report["suspects"] == []
+    fams = {f.name: f for f in reg.families()}
+    assert fams["topology_corruptions_total"].labels(level="1").value == 3
+    assert fams["topology_reconstructions_total"].labels(level="1").value == 3
+    assert fams["topology_rounds_total"].value == 3
+    assert fams["topology_bytes_on_wire_total"].labels(level="1").value > 0
+
+
+def test_corrupt_subaggregator_excluded_without_redundancy():
+    ledger = ForensicsLedger(8)
+    agg = _protocol_stack(
+        "tree:g=2,rules=average-nan>average-nan",
+        chaos_spec="0:corrupt-agg=1.1", ledger=ledger)
+    arrived, stale = _drive_rounds(agg, 2)
+    # exactly unit (1, 1)'s leaf span cleared — workers 2 and 3
+    np.testing.assert_array_equal(
+        arrived, [True, True, False, False, True, True, True, True])
+    assert not stale.any()
+    (rec,) = ledger.report()["sub_aggregators"]
+    assert rec["evidence"]["forgery"] == 2
+
+
+def test_straggle_agg_reconstructs_a_whole_subtree_timeout():
+    """The redundancy satellite: a straggling sub-aggregator (whole
+    subtree late as a unit) is served by its sibling shadow — masks
+    untouched, evidence notes the reconstruction's cause."""
+    ledger = ForensicsLedger(8)
+    agg = _protocol_stack(
+        "tree:g=2,rules=average-nan>average-nan,redundancy=2",
+        chaos_spec="0:straggle-agg=1.3", ledger=ledger)
+    arrived, stale = _drive_rounds(agg, 2)
+    assert arrived.all()
+    (rec,) = ledger.report()["sub_aggregators"]
+    assert rec["level"] == 1 and rec["unit"] == 3
+    assert rec["evidence"]["timeout"] == 2
+    assert rec["evidence"]["reconstructed"] == 2
+    assert not rec["corrupt"]
+
+
+def test_custody_chain_is_deterministic_and_tamper_evident():
+    a = _protocol_stack("tree:g=2,rules=average-nan>average-nan")
+    b = _protocol_stack("tree:g=2,rules=average-nan>average-nan")
+    forged = _protocol_stack("tree:g=2,rules=average-nan>average-nan",
+                             chaos_spec="0:corrupt-agg=1.0")
+    _drive_rounds(a, 2)
+    _drive_rounds(b, 2)
+    _drive_rounds(forged, 2)
+    assert a.chain() == b.chain()
+    assert a.chain()["steps"] == 2
+    # the forged timeline's verdict bits fold into the head: it diverges
+    assert forged.chain()["head"] != a.chain()["head"]
+
+
+def test_process_round_zero_steady_state_recompiles():
+    agg = _protocol_stack("tree:g=2x2,rules=median>median>average-nan")
+    _drive_rounds(agg, 4)
+    assert agg.cache_size() == 1
+    assert agg.rounds_total == 4
+
+
+def test_process_round_requires_bind():
+    agg = _aggregator()
+    with pytest.raises(UserException, match="bind"):
+        agg.process_round(0, np.ones(8, bool), np.zeros(8, bool),
+                          np.full(8, 0.1), jnp.zeros((8, 4)))
+
+
+def test_tree_aggregator_rejects_mismatched_n():
+    agg = _aggregator()
+    with pytest.raises(UserException, match="n=4"):
+        agg.bind(4, 16)
+
+
+# --------------------------------------------------------------------- #
+# chaos DSL: corrupt-agg/straggle-agg parsing + the gate (ISSUE 18
+# satellite — mirrors the allow_process_faults discipline)
+
+
+def test_parse_topology_targets_grammar():
+    assert parse_topology_targets("corrupt-agg", "1.0+2.1") == ((1, 0), (2, 1))
+    assert parse_topology_targets("straggle-agg", "1.3") == ((1, 3),)
+
+
+@pytest.mark.parametrize("value", ["", "1", "0.0", "1.-1", "a.b", "1.0+"])
+def test_parse_topology_targets_rejects(value):
+    with pytest.raises(UserException):
+        parse_topology_targets("corrupt-agg", value)
+
+
+def test_chaos_topology_faults_parse_into_regimes():
+    sched = ChaosSchedule("0:calm 4:corrupt-agg=1.0+1.1,straggle-agg=2.0", 8,
+                          allow_topology_faults=True)
+    assert sched.regimes[0].agg_corrupt == ()
+    assert sched.regimes[1].agg_corrupt == ((1, 0), (1, 1))
+    assert sched.regimes[1].agg_straggle == ((2, 0),)
+    assert sched.has_topology_faults
+
+
+def test_chaos_topology_faults_are_gated():
+    # without a tree there is no sub-aggregator to fault — loud refusal
+    with pytest.raises(UserException, match="--topology"):
+        ChaosSchedule("0:corrupt-agg=1.0", 8)
+    calm = ChaosSchedule("0:calm", 8)
+    assert not calm.has_topology_faults
+
+
+def test_chaos_topology_faults_compose_with_stragglers():
+    sched = ChaosSchedule("0:straggle=0.5,corrupt-agg=1.0", 8,
+                          allow_topology_faults=True)
+    assert sched.has_stragglers and sched.has_topology_faults
+
+
+# --------------------------------------------------------------------- #
+# the sweep schema + the checked-in document (benchmarks/topology_sweep.py)
+
+
+def test_topology_sweep_checked_in_document():
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "benchmarks"))
+    import topology_sweep
+
+    doc = topology_sweep.load(os.path.join(repo, "TOPO_r18.json"))
+    assert doc["verdict"]["pass"]
+    assert doc["config"]["nb_workers"] >= 256
+    # the corrupted sub-aggregator is NAMED — and no worker carries blame
+    assert doc["forensics"]["corrupt_subaggregators"] == ["1.0"]
+    assert doc["forensics"]["workers_blamed"] == []
+    assert doc["forensics"]["host_cache_size"] == 1
+    # every training cell (flat AND tree, attacked or not) stayed finite
+    # and compiled exactly once
+    assert all(c["losses_finite"] and c["compile_count"] == 1
+               for c in doc["cells"])
+    # the per-level breakdown record: spread r=f+1 poisons, packed holds
+    assert doc["breakdown"]["at_f_spread_contained"]
+    assert doc["breakdown"]["at_f_plus_1_spread_poisoned"]
+    assert all(doc["breakdown"]["per_level"].values())
+
+
+def test_topology_sweep_validator_rejects():
+    import copy
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "benchmarks"))
+    import topology_sweep
+
+    doc = topology_sweep.load(os.path.join(repo, "TOPO_r18.json"))
+    bad = dict(doc)
+    bad["schema"] = "aggregathor.other.v1"
+    with pytest.raises(ValueError):
+        topology_sweep.validate(bad)
+    bad = copy.deepcopy(doc)
+    bad["config"]["nb_workers"] = 8  # the n >= 256 sizing is the claim
+    with pytest.raises(ValueError):
+        topology_sweep.validate(bad)
+    bad = copy.deepcopy(doc)
+    del bad["verdict"]["pass"]
+    with pytest.raises(ValueError):
+        topology_sweep.validate(bad)
