@@ -10,6 +10,14 @@ property over the WHOLE package, the graftcheck way (docs/analysis.md):
 - **EV001** — a resolved journal ``emit(...)`` call whose event type is
   (a) a string literal NOT declared in ``obs.events.EVENT_TYPES``, (b) not
   a string literal at all (unverifiable statically), or (c) missing.
+- **EV002** — a resolved journal ``emit(...)`` of an ACTION event type
+  (``obs.events.ACTION_EVENT_TYPES`` — restarts, retunes, rollbacks,
+  retries, exclusions: the events that CHANGE the fleet) without an
+  explicit ``cause=`` keyword.  ``cause=None`` is legal — some actions
+  genuinely have no journal-event trigger (a liveness restart's evidence
+  is the ABSENCE of scrapes) — but the author must SAY so at the emit
+  site; an action event silently minted without the kwarg is exactly how
+  orphan actions (obs/causal.py) enter a postmortem.
 
 Resolution is conservative and import-driven: a call counts as a journal
 emit only when its callee resolves to the events module through the file's
@@ -80,9 +88,16 @@ def _declared_types():
     return EVENT_TYPES
 
 
+def _action_types():
+    from ..obs.events import ACTION_EVENT_TYPES
+
+    return ACTION_EVENT_TYPES
+
+
 def check(modules):
-    """Run EV001 over parsed modules; returns Finding records."""
+    """Run EV001/EV002 over parsed modules; returns Finding records."""
     declared = _declared_types()
+    actions = _action_types()
     findings = []
     for module in modules:
         if module.path in EXCLUDED_PATHS:
@@ -121,6 +136,17 @@ def check(modules):
                     message="journal emit of UNDECLARED event type %r "
                             "(declare it in obs.events.EVENT_TYPES)"
                             % first.value,
+                ))
+                continue
+            if first.value in actions and not any(
+                    kw.arg == "cause" for kw in node.keywords):
+                findings.append(Finding(
+                    checker=CHECKER, code="EV002", path=module.path,
+                    line=node.lineno, scope=scope, symbol=first.value,
+                    message="action event %r emitted without an explicit "
+                            "cause= keyword (pass cause=None if no journal "
+                            "event triggered it — the causal plane wants "
+                            "the author to say so)" % first.value,
                 ))
     return findings
 

@@ -66,9 +66,12 @@ def build_parser():
     parser.add_argument("--journal", default=None, metavar="JSONL",
                         help="causal run journal (obs/events.py): append every "
                              "routing decision as typed JSONL (schema "
-                             "aggregathor.obs.events.v1)")
+                             "aggregathor.obs.events.v2)")
     parser.add_argument("--run-id", default=None, metavar="ID",
                         help="run id stamped on journal lines (default: generated)")
+    from . import add_causal_flags
+
+    add_causal_flags(parser)
     return parser
 
 
@@ -95,12 +98,17 @@ def main(argv=None):
     from ..serve import FleetRouter, RouterServer
     from ..utils import info
 
+    from . import parse_cause_flag
+
     backends = parse_backends(args.backend)
     run_id = args.run_id if args.run_id else make_run_id()
+    cause = parse_cause_flag(args.cause)
     if args.journal:
-        obs_events.install(args.journal, run_id=run_id)
+        obs_events.install(args.journal, run_id=run_id,
+                           max_bytes=args.journal_max_bytes)
         obs_events.emit("run_start", role="router",
-                        backends=sorted(backends), pid=os.getpid())
+                        backends=sorted(backends), pid=os.getpid(),
+                        cause=cause)
         info("Run journal to %r (run_id %s)" % (args.journal, run_id))
 
     router = FleetRouter(

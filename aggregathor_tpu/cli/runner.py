@@ -390,7 +390,7 @@ def build_parser():
              "'The control room'): append every decision event — guardian "
              "rollbacks/escalations, deadline-window moves, bounded-wait "
              "timeouts/stale infill, forgery verdicts, flight post-mortems "
-             "— as typed JSONL (schema aggregathor.obs.events.v1) with "
+             "— as typed JSONL (schema aggregathor.obs.events.v2) with "
              "run_id, step, wall+monotonic time; cross-referenced from the "
              "forensics report and served fleet-wide by obs/fleet.py; "
              "host-side only, zero added recompiles; lead process only",
@@ -402,6 +402,9 @@ def build_parser():
              "training-side counterpart of serve's /metrics endpoint); the "
              "final flush runs on normal exit, SIGTERM and divergence alike",
     )
+    from . import add_causal_flags
+
+    add_causal_flags(parser)
     parser.add_argument(
         "--flight", type=int, default=0, metavar="CAPACITY",
         help="flight recorder (obs/flight.py, docs/observability.md): carry "
@@ -804,11 +807,14 @@ def main(argv=None):
     # timeline.  Lead-only, like summaries/forensics — the decisions it
     # records are host policy, which is lead-side by construction.
     if args.journal and jax.process_index() == 0:
-        obs_events.install(args.journal, run_id=run_id)
+        from . import parse_cause_flag
+
+        obs_events.install(args.journal, run_id=run_id,
+                           max_bytes=args.journal_max_bytes)
         obs_events.emit(
             "run_start", role="train", experiment=args.experiment,
             aggregator=args.aggregator, nb_workers=n, declared_f=f,
-            pid=os.getpid(),
+            pid=os.getpid(), cause=parse_cause_flag(args.cause),
         )
         info("Run journal to %r (run_id %s)" % (args.journal, run_id))
 

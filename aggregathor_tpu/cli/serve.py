@@ -158,7 +158,7 @@ def build_parser():
                         help="causal run journal (obs/events.py): append every "
                              "serving decision — autoscale moves, weight swaps "
                              "and their failures — as typed JSONL (schema "
-                             "aggregathor.obs.events.v1); merged fleet-wide by "
+                             "aggregathor.obs.events.v2); merged fleet-wide by "
                              "obs/fleet.py /fleet/journal")
     parser.add_argument("--run-id", default=None, metavar="ID",
                         help="run id stamped on summary lines and trace metadata "
@@ -171,6 +171,9 @@ def build_parser():
                              "traffic off a draining /status) before exiting anyway")
     parser.add_argument("--seed", type=int, default=0, help="base PRNG seed (template init)")
     parser.add_argument("--platform", default=None, help="force a JAX platform (tpu/cpu)")
+    from . import add_causal_flags
+
+    add_causal_flags(parser)
     return parser
 
 
@@ -316,11 +319,14 @@ def main(argv=None):
         # installed BEFORE compile so the warmup's serve.jit spans land too
         trace.install(args.trace_file, run_id=run_id)
     if args.journal:
+        from . import parse_cause_flag
         from ..obs import events as obs_events
 
-        obs_events.install(args.journal, run_id=run_id)
+        obs_events.install(args.journal, run_id=run_id,
+                           max_bytes=args.journal_max_bytes)
         obs_events.emit("run_start", role="serve",
-                        experiment=args.experiment, pid=os.getpid())
+                        experiment=args.experiment, pid=os.getpid(),
+                        cause=parse_cause_flag(args.cause))
         info("Run journal to %r (run_id %s)" % (args.journal, run_id))
 
     with Context("load"):

@@ -65,6 +65,13 @@ def build_parser():
     parser.add_argument("--keep-fleet", action="store_true",
                         help="leave the fleet running on exit (default: "
                              "SIGTERM every instance the supervisor spawned)")
+    parser.add_argument("--instance-name", default="supervisor", metavar="NAME",
+                        help="this supervisor's name in cross-journal cause "
+                             "references (children spawned by an action cite "
+                             "NAME:RUN_ID:SEQ; may not contain ':')")
+    from . import add_causal_flags
+
+    add_causal_flags(parser)
     return parser
 
 
@@ -77,19 +84,25 @@ def main(argv=None):
     from ..supervisor.actuator import load_fleet_spec
     from ..utils import info
 
+    from . import parse_cause_flag
+
     specs = load_fleet_spec(args.fleet)
     config = SupervisorConfig(args.supervisor_args)
     run_id = args.run_id if args.run_id else make_run_id()
+    cause = parse_cause_flag(args.cause)
     if args.journal:
-        obs_events.install(args.journal, run_id=run_id)
+        obs_events.install(args.journal, run_id=run_id,
+                           max_bytes=args.journal_max_bytes)
         obs_events.emit("run_start", role="supervisor",
                         instances=sorted(s.name for s in specs),
-                        config=config.describe(), pid=os.getpid())
+                        config=config.describe(), pid=os.getpid(),
+                        cause=cause)
         info("Run journal to %r (run_id %s)" % (args.journal, run_id))
 
     supervisor = FleetSupervisor(
         specs, config=config, down_after=args.down_after,
         scrape_timeout=args.scrape_timeout,
+        instance_name=args.instance_name,
     )
 
     stop = threading.Event()

@@ -98,6 +98,11 @@ class Watchdog:
         self.last_reason = None    # human-readable cause of the last rollback
         self.timeout_streak = 0    # consecutive steps with timeouts beyond f
         self.ceiling_streak = 0    # consecutive steps controller-at-ceiling
+        #: the journal record of the last guardian_rollback_decision —
+        #: note_rollback cites it as the guardian_rollback's cause (the
+        #: causal plane: the actuation points at the decision that forced
+        #: it, same-journal, so ``instance`` stays None in the reference)
+        self._last_decision = None
 
     @property
     def healthy(self):
@@ -133,8 +138,8 @@ class Watchdog:
             self.last_reason = "non-finite loss at step %d" % step
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="non-finite")
-            events.emit("guardian_rollback_decision", step=step,
-                        reason="non-finite")
+            self._last_decision = events.emit(
+                "guardian_rollback_decision", step=step, reason="non-finite")
             return "rollback"
         if step >= self.cooldown_until and self.unhealthy_streak >= self.config.patience:
             self.last_reason = (
@@ -144,9 +149,10 @@ class Watchdog:
             )
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="spike", spike=float(spike))
-            events.emit("guardian_rollback_decision", step=step,
-                        reason="spike", spike=float(spike),
-                        streak=self.unhealthy_streak)
+            self._last_decision = events.emit(
+                "guardian_rollback_decision", step=step,
+                reason="spike", spike=float(spike),
+                streak=self.unhealthy_streak)
             return "rollback"
         return None
 
@@ -170,10 +176,11 @@ class Watchdog:
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="straggler_timeouts",
                           nb_timeouts=int(nb_timeouts), budget=int(budget))
-            events.emit("guardian_rollback_decision", step=step,
-                        reason="straggler_timeouts",
-                        nb_timeouts=int(nb_timeouts), budget=int(budget),
-                        streak=self.timeout_streak)
+            self._last_decision = events.emit(
+                "guardian_rollback_decision", step=step,
+                reason="straggler_timeouts",
+                nb_timeouts=int(nb_timeouts), budget=int(budget),
+                streak=self.timeout_streak)
             return "rollback"
         return None
 
@@ -201,9 +208,10 @@ class Watchdog:
             trace.instant("guardian.rollback_decision", cat="guardian",
                           step=int(step), reason="deadline_ceiling",
                           streak=int(self.ceiling_streak))
-            events.emit("guardian_rollback_decision", step=step,
-                        reason="deadline_ceiling",
-                        streak=int(self.ceiling_streak))
+            self._last_decision = events.emit(
+                "guardian_rollback_decision", step=step,
+                reason="deadline_ceiling",
+                streak=int(self.ceiling_streak))
             return "rollback"
         return None
 
@@ -225,7 +233,10 @@ class Watchdog:
         trace.instant("guardian.rollback", cat="guardian",
                       restore_step=int(restore_step), attempt=attempt,
                       cooldown_until=int(self.cooldown_until))
+        decision, self._last_decision = self._last_decision, None
         events.emit("guardian_rollback", step=restore_step,
                     reason=self.last_reason, attempt=attempt,
-                    cooldown_until=int(self.cooldown_until))
+                    cooldown_until=int(self.cooldown_until),
+                    cause=(events.cause_of(decision)
+                           if decision is not None else None))
         return attempt

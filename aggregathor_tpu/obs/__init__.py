@@ -40,16 +40,28 @@ The device-side layer (docs/observability.md "Device-side observability"):
 The control room (docs/observability.md "The control room"):
 
 - ``events``          causal run journal — typed, append-only JSONL
-  decision events (schema ``aggregathor.obs.events.v1``): guardian
+  decision events (schema ``aggregathor.obs.events.v2``): guardian
   rollbacks/escalations, deadline-window moves, stale infill, forgery
   verdicts, autoscale actions, weight swaps — ONE ``emit()`` API, every
-  event type declared (graftcheck EV001 proves it statically)
+  event type declared (graftcheck EV001 proves it statically) and every
+  action event citing its cause (EV002)
+- ``causal``          the causal plane — edge-respecting fleet journal
+  merge + the postmortem audit (``cli.postmortem``; report schema
+  ``aggregathor.obs.postmortem.v1``)
 - ``fleet``           one-scrape federation — ``FleetCollector`` polls N
   child ``/metrics`` + ``/status`` endpoints and serves
   ``/fleet/metrics`` / ``/fleet/status`` / ``/fleet/journal`` from one
   port; a dead instance reads ``down`` with its last sample HELD
+
+The causal plane (docs/observability.md "The causal plane"):
+
+- ``causal``          the reader half of schema v2's ``cause`` edges —
+  the edge-respecting deterministic fleet merge, the causal DAG audit
+  and the ``aggregathor.obs.postmortem.v1`` checker behind
+  ``cli.postmortem`` (exit code = verdict)
 """
 
+from . import causal  # noqa: F401
 from . import events  # noqa: F401
 from . import flight  # noqa: F401
 from . import live  # noqa: F401

@@ -5,7 +5,8 @@ moves, stale infill, forgery verdicts, autoscale actions, weight swaps —
 were scattered across info lines, summary events, forensics records and
 trace instants with no single causal timeline.  The journal is that
 timeline: ONE append-only JSONL file per process (schema
-``aggregathor.obs.events.v1``), one :func:`emit` API threaded through the
+``aggregathor.obs.events.v2``; v1 files still load), one :func:`emit`
+API threaded through the
 guardian, the deadline controller, bounded-wait, the secure verdicts and
 serve's autoscaler/weight-watcher, so a post-mortem starts from one file
 instead of five.
@@ -25,6 +26,18 @@ Design rules (the trace.py discipline, docs/observability.md):
   increasing per file, wall time (``t_wall``, joins across processes) and
   monotonic time (``t_mono``, orders within one) — so ``/fleet/journal``
   (obs/fleet.py) can merge several processes' journals into one timeline.
+- **Causally LINKED (schema v2).**  An event may cite the event that
+  triggered it through the optional ``cause`` field — a validated
+  ``{"instance", "run_id", "seq"}`` reference (``instance`` None = the
+  same journal).  Cause references survive process boundaries as tokens
+  (``format_cause``/``parse_cause``: the router's ``X-Causal-Id`` header,
+  the supervisor's ``--cause`` argv injection), so the fleet merge
+  (obs/causal.py) can order effects after their causes even when clock
+  skew says otherwise.  v1 journals (no ``cause``) still load.
+- **Bounded on disk.**  A journal constructed with ``max_bytes`` rotates
+  to ``path.1``, ``path.2``, … segment files once the live file crosses
+  the limit; :func:`tail_journal` cursors follow the rotation loudly
+  (a vanished segment raises, it is never skipped).
 - **Cross-referenced.**  Events carry pointers into the OTHER evidence
   stores instead of duplicating them: a ``flight_postmortem`` event names
   the dump path (obs/flight.py), ``run_end`` names the forensics report,
@@ -54,7 +67,12 @@ import time
 
 import numpy as np
 
-SCHEMA = "aggregathor.obs.events.v1"
+SCHEMA_V1 = "aggregathor.obs.events.v1"
+SCHEMA = "aggregathor.obs.events.v2"
+
+#: schemas :func:`validate_event` accepts on load — new journals are
+#: written as v2; v1 files (pre-``cause``) remain loadable forever
+ACCEPTED_SCHEMAS = (SCHEMA_V1, SCHEMA)
 
 #: the declared event catalog: type -> one-line meaning.  EVERY ``emit``
 #: call in the package must name one of these (enforced at runtime here
@@ -141,8 +159,34 @@ EVENT_TYPES = {
                                    "laundered into worker blame",
 }
 
-#: fields every event carries; ``emit`` keyword fields may not shadow them
-BASE_FIELDS = ("schema", "type", "run_id", "seq", "step", "t_wall", "t_mono")
+#: fields every event carries (plus the optional ``cause``); ``emit``
+#: keyword fields may not shadow them
+BASE_FIELDS = ("schema", "type", "run_id", "seq", "step", "t_wall", "t_mono",
+               "cause")
+
+#: event types that ACTUATE (change the fleet) rather than observe — every
+#: emit of one of these must pass an explicit ``cause=`` keyword (None is
+#: legal when no journal event triggered it, e.g. a liveness restart whose
+#: evidence is the ABSENCE of scrapes); graftcheck EV001 proves the
+#: discipline statically and obs/causal.py audits the written journals.
+ACTION_EVENT_TYPES = frozenset((
+    "supervisor_restart",
+    "supervisor_quarantine",
+    "supervisor_retune",
+    "supervisor_rollback",
+    "supervisor_observe",
+    "router_retry",
+    "guardian_rollback",
+    "topology_level_timeout",
+    "topology_corruption_verdict",
+    "topology_reconstruction",
+))
+
+_undeclared_actions = ACTION_EVENT_TYPES - set(EVENT_TYPES)
+if _undeclared_actions:       # fail-loud at import: the two catalogs may not drift
+    raise AssertionError(
+        "ACTION_EVENT_TYPES not in EVENT_TYPES: %s"
+        % ", ".join(sorted(_undeclared_actions)))
 
 #: the process-wide installed journal (None = journaling disabled)
 _journal = None
@@ -196,12 +240,105 @@ def decode_event(record):
     return {key: decode_value(value) for key, value in record.items()}
 
 
+# --------------------------------------------------------------------- #
+# cause references (schema v2)
+
+#: the exact key set of a cause reference
+CAUSE_KEYS = frozenset(("instance", "run_id", "seq"))
+
+
+def validate_cause(cause):
+    """Structural check of one cause reference.  Returns the reference;
+    raises ``ValueError`` on violations.  ``instance`` None means "the
+    journal this event was written to" (resolved by the fleet merge);
+    ``run_id`` None cites a record whose own run_id is null."""
+    if not isinstance(cause, dict):
+        raise ValueError("cause reference is not an object: %r" % (cause,))
+    if set(cause) != CAUSE_KEYS:
+        raise ValueError(
+            "cause reference wants exactly keys %s, got %s"
+            % (sorted(CAUSE_KEYS), sorted(cause)))
+    if not isinstance(cause["seq"], int) or isinstance(cause["seq"], bool) \
+            or cause["seq"] < 0:
+        raise ValueError(
+            "cause reference wants an int seq >= 0: %r" % (cause,))
+    for key in ("instance", "run_id"):
+        value = cause[key]
+        if value is not None and not isinstance(value, str):
+            raise ValueError(
+                "cause reference %s must be str or null: %r" % (key, value))
+    return cause
+
+
+def _normalize_cause(cause):
+    """Accept a validated dict or an ``(instance, run_id, seq)`` triple."""
+    if isinstance(cause, (tuple, list)):
+        if len(cause) != 3:
+            raise ValueError(
+                "cause triple wants (instance, run_id, seq), got %r" % (cause,))
+        cause = {"instance": cause[0], "run_id": cause[1], "seq": cause[2]}
+    return validate_cause(cause)
+
+
+def cause_of(record, instance=None):
+    """A cause reference citing ``record`` (a loaded journal record or an
+    :meth:`Journal.emit` return value).  ``instance`` names the fleet
+    instance whose journal holds the record; None = the same journal the
+    citing event is written to."""
+    return validate_cause({
+        "instance": instance,
+        "run_id": record.get("run_id"),
+        "seq": record["seq"],
+    })
+
+
+def format_cause(cause):
+    """Serialize a cause reference to the one-token wire form
+    ``INSTANCE:RUN_ID:SEQ`` (empty instance/run_id encode None) — the
+    router's ``X-Causal-Id`` header and the supervisor's ``--cause`` argv
+    flag.  ``instance`` may not contain ``:`` (run_id may — the token
+    splits instance off the front and seq off the back)."""
+    cause = _normalize_cause(cause)
+    instance = cause["instance"] or ""
+    if ":" in instance:
+        raise ValueError(
+            "cause instance %r may not contain ':' (the token separator)"
+            % (instance,))
+    return "%s:%s:%d" % (instance, cause["run_id"] or "", cause["seq"])
+
+
+def parse_cause(token):
+    """Inverse of :func:`format_cause`; raises ``ValueError`` on garbage."""
+    if not isinstance(token, str):
+        raise ValueError("cause token is not a string: %r" % (token,))
+    instance, sep, rest = token.partition(":")
+    if not sep:
+        raise ValueError(
+            "cause token %r wants INSTANCE:RUN_ID:SEQ (instance/run_id "
+            "may be empty)" % (token,))
+    run_id, sep, seq = rest.rpartition(":")
+    if not sep:
+        raise ValueError(
+            "cause token %r wants INSTANCE:RUN_ID:SEQ (instance/run_id "
+            "may be empty)" % (token,))
+    try:
+        seq = int(seq)
+    except ValueError:
+        raise ValueError("cause token %r: seq %r is not an int" % (token, seq))
+    return validate_cause({
+        "instance": instance or None,
+        "run_id": run_id or None,
+        "seq": seq,
+    })
+
+
 class Journal:
     """One append-only JSONL journal file.  Use the module-level
     :func:`install` / :func:`emit` / :func:`uninstall` in application code;
     construct directly only in tests (clocks injectable)."""
 
-    def __init__(self, path, run_id=None, wall_clock=None, mono_clock=None):
+    def __init__(self, path, run_id=None, wall_clock=None, mono_clock=None,
+                 max_bytes=None):
         self.path = path
         self.run_id = run_id
         self._wall = wall_clock if wall_clock is not None else time.time
@@ -209,15 +346,45 @@ class Journal:
         self._lock = threading.Lock()
         self._seq = 0
         self._counts = {}
+        if max_bytes is not None and (not isinstance(max_bytes, int)
+                                      or max_bytes < 1):
+            raise ValueError(
+                "journal max_bytes must be a positive int or None, got %r"
+                % (max_bytes,))
+        self.max_bytes = max_bytes
         directory = os.path.dirname(path)
         if directory:
             os.makedirs(directory, exist_ok=True)
+        # a resumed run may find rotated segments from its predecessor:
+        # continue the numbering instead of overwriting history
+        self._nb_rotations = 0
+        while os.path.exists("%s.%d" % (path, self._nb_rotations + 1)):
+            self._nb_rotations += 1
         # append mode: a journal survives the process that wrote it and a
         # resumed run extends the same causal file instead of replacing it
         self._fd = open(path, "a")
 
-    def emit(self, etype, step=None, **fields):
-        """Append one event; returns the written record (decoded form)."""
+    def _rotate_locked(self):
+        """Roll the live file to ``path.N`` and start a fresh segment file
+        (seq restarts at 0 — each segment file validates standalone and the
+        cross-file chain reads as a resumed segment)."""
+        self._fd.close()
+        self._nb_rotations += 1
+        os.replace(self.path, "%s.%d" % (self.path, self._nb_rotations))
+        self._fd = open(self.path, "a")
+        self._seq = 0
+
+    @property
+    def nb_rotations(self):
+        """How many ``path.N`` segment files this journal has rolled."""
+        with self._lock:
+            return self._nb_rotations
+
+    def emit(self, etype, step=None, cause=None, **fields):
+        """Append one event; returns the written record (decoded form).
+        ``cause`` optionally cites the triggering event — a validated
+        reference dict (:func:`validate_cause`) or an ``(instance, run_id,
+        seq)`` triple."""
         if etype not in EVENT_TYPES:
             raise ValueError(
                 "undeclared journal event type %r (declare it in "
@@ -229,6 +396,8 @@ class Journal:
             raise ValueError(
                 "journal event %r fields %r shadow the base fields" % (etype, clash)
             )
+        if cause is not None:
+            cause = _normalize_cause(cause)
         with self._lock:
             if self._fd is None:
                 raise ValueError(
@@ -243,11 +412,16 @@ class Journal:
                 "t_wall": self._wall(),
                 "t_mono": self._mono(),
             }
+            if cause is not None:
+                record["cause"] = cause
             record.update(_encode(fields))
             self._seq += 1
             self._counts[etype] = self._counts.get(etype, 0) + 1
             self._fd.write(json.dumps(record) + "\n")
             self._fd.flush()
+            # rotate AFTER the write: a record never splits across segments
+            if self.max_bytes is not None and self._fd.tell() >= self.max_bytes:
+                self._rotate_locked()
         return record
 
     def counts_by_type(self):
@@ -272,14 +446,15 @@ class Journal:
 # module-level lifecycle (the trace.py shape)
 
 
-def install(path, run_id=None, wall_clock=None, mono_clock=None):
+def install(path, run_id=None, wall_clock=None, mono_clock=None,
+            max_bytes=None):
     """Enable journaling process-wide, appending to ``path``.  Installing
     over a live journal closes the old one first."""
     global _journal
     if _journal is not None:
         _journal.close()
     _journal = Journal(path, run_id=run_id, wall_clock=wall_clock,
-                       mono_clock=mono_clock)
+                       mono_clock=mono_clock, max_bytes=max_bytes)
     return _journal
 
 
@@ -288,7 +463,7 @@ def installed():
     return _journal
 
 
-def emit(etype, step=None, **fields):
+def emit(etype, step=None, cause=None, **fields):
     """Append one event to the installed journal (validates the type even
     when disabled — an undeclared emit must fail in every configuration)."""
     journal = _journal
@@ -299,7 +474,7 @@ def emit(etype, step=None, **fields):
                 "obs.events.EVENT_TYPES)" % (etype,)
             )
         return None
-    return journal.emit(etype, step=step, **fields)
+    return journal.emit(etype, step=step, cause=cause, **fields)
 
 
 def uninstall():
@@ -322,10 +497,21 @@ def validate_event(record):
     record; raises ``ValueError`` on violations."""
     if not isinstance(record, dict):
         raise ValueError("journal event is not an object: %r" % (record,))
-    if record.get("schema") != SCHEMA:
+    schema = record.get("schema")
+    if schema not in ACCEPTED_SCHEMAS:
         raise ValueError(
-            "expected schema %r, got %r" % (SCHEMA, record.get("schema"))
+            "expected schema in %s, got %r" % (list(ACCEPTED_SCHEMAS), schema)
         )
+    cause = record.get("cause")
+    if cause is not None:
+        if schema == SCHEMA_V1:
+            raise ValueError(
+                "journal event carries a cause under schema %r (cause "
+                "references are v2): %r" % (schema, record))
+        try:
+            validate_cause(cause)
+        except ValueError as exc:
+            raise ValueError("journal event cause: %s" % (exc,))
     etype = record.get("type")
     if etype not in EVENT_TYPES:
         raise ValueError("undeclared journal event type %r" % (etype,))
@@ -345,18 +531,21 @@ def validate_event(record):
     return record
 
 
-#: resumable read position in one journal file: ``offset`` is the byte
-#: offset of the first unread line, ``line`` the 1-based number that line
-#: will carry in error messages, ``segment`` how many seq-restart segments
-#: have been consumed, and ``last_seq`` the seq of the last validated
-#: record (None before the first).  Immutable — each :func:`tail_journal`
-#: call returns a NEW cursor, so a caller can retry a failed poll from the
-#: old one.
+#: resumable read position in one journal: ``offset`` is the byte offset
+#: of the first unread line IN THE FILE CURRENTLY BEING READ, ``line`` the
+#: 1-based number that line will carry in error messages, ``segment`` how
+#: many seq-restart segments have been consumed, ``last_seq`` the seq of
+#: the last validated record (None before the first), and ``rotated`` how
+#: many rolled ``path.N`` files have been fully consumed (the cursor
+#: currently points into ``path.{rotated+1}`` if that file exists, else
+#: the live ``path``).  Immutable — each :func:`tail_journal` call returns
+#: a NEW cursor, so a caller can retry a failed poll from the old one.
 TailCursor = collections.namedtuple(
-    "TailCursor", ("offset", "line", "segment", "last_seq"))
+    "TailCursor", ("offset", "line", "segment", "last_seq", "rotated"),
+    defaults=(0,))
 
 #: the start-of-file cursor (segment 0, nothing consumed yet)
-TAIL_START = TailCursor(offset=0, line=1, segment=0, last_seq=None)
+TAIL_START = TailCursor(offset=0, line=1, segment=0, last_seq=None, rotated=0)
 
 
 def _validate_line(nb, line, last_seq):
@@ -407,32 +596,21 @@ def load_journal(path):
     return records
 
 
-def tail_journal(path, cursor=None):
-    """Incremental :func:`load_journal`: read + validate only the records
-    appended since ``cursor`` (a :data:`TailCursor` from a previous call;
-    None or :data:`TAIL_START` reads from the beginning).  Returns
-    ``(new_records, next_cursor)``.
-
-    The chain check continues ACROSS calls — the cursor carries the
-    (segment, seq) position, so a seq break at a poll boundary fails
-    exactly as it would in one whole-file load.  A trailing line without
-    its newline (a writer mid-append) is left for the next call rather
-    than half-parsed; a file shorter than the cursor's offset (truncated
-    or replaced behind the reader) raises.  Missing file with a
-    start-of-file cursor is an empty poll — the supervisor tails journals
-    of instances that have not opened them yet."""
-    if cursor is None:
-        cursor = TAIL_START
-    offset, nb, segment, last_seq = cursor
+def _tail_file(path, offset, nb, segment, last_seq, allow_missing,
+               finalize=False):
+    """Read + validate one physical file from ``offset`` on.  Returns
+    ``(records, offset, nb, segment, last_seq)``.  ``finalize`` marks a
+    rotated (closed) segment: a torn trailing line there is permanent
+    damage and raises instead of being deferred to the next poll."""
     records = []
     try:
         fd = open(path, "rb")
     except OSError:
-        if offset:
+        if offset or not allow_missing:
             raise ValueError(
                 "journal %r vanished behind its tail cursor (offset %d)"
                 % (path, offset))
-        return records, cursor
+        return records, offset, nb, segment, last_seq
     with fd:
         fd.seek(0, os.SEEK_END)
         size = fd.tell()
@@ -447,6 +625,11 @@ def tail_journal(path, cursor=None):
             if not line:
                 break
             if not line.endswith(b"\n"):
+                if finalize:
+                    raise ValueError(
+                        "rotated journal segment %r ends mid-line at "
+                        "offset %d: the writer can never finish it"
+                        % (path, offset))
                 break     # a writer mid-append: re-read next poll
             offset += len(line)
             stripped = line.strip()
@@ -458,8 +641,58 @@ def tail_journal(path, cursor=None):
                 last_seq = record["seq"]
                 records.append(record)
             nb += 1
+    return records, offset, nb, segment, last_seq
+
+
+def tail_journal(path, cursor=None):
+    """Incremental :func:`load_journal`: read + validate only the records
+    appended since ``cursor`` (a :data:`TailCursor` from a previous call;
+    None or :data:`TAIL_START` reads from the beginning).  Returns
+    ``(new_records, next_cursor)``.
+
+    The chain check continues ACROSS calls — the cursor carries the
+    (segment, seq) position, so a seq break at a poll boundary fails
+    exactly as it would in one whole-file load.  A trailing line without
+    its newline (a writer mid-append) is left for the next call rather
+    than half-parsed; a file shorter than the cursor's offset (truncated
+    or replaced behind the reader) raises.  Missing file with a
+    start-of-file cursor is an empty poll — the supervisor tails journals
+    of instances that have not opened them yet.
+
+    Rotation-aware: when the writer rolled the live file to ``path.N``
+    (``Journal(max_bytes=...)``), the cursor follows — it finishes the
+    rolled segment it was reading, then advances through younger segments
+    to the live file.  A rotated segment that vanished or was torn behind
+    the cursor raises (rotation must never silently drop history)."""
+    if cursor is None:
+        cursor = TAIL_START
+    offset, nb, segment, last_seq, rotated = cursor
+    records = []
+    while True:
+        rolled = "%s.%d" % (path, rotated + 1)
+        if not os.path.exists(rolled):
+            if os.path.exists("%s.%d" % (path, rotated + 2)):
+                raise ValueError(
+                    "rotated journal segment %r vanished behind its tail "
+                    "cursor (younger segments exist)" % (rolled,))
+            break
+        # the file the cursor points into was rolled to ``rolled`` (or it
+        # is an older rolled segment not yet consumed): finish it whole,
+        # then restart at the top of the next file
+        got, offset, nb, segment, last_seq = _tail_file(
+            rolled, offset, nb, segment, last_seq, allow_missing=False,
+            finalize=True)
+        records.extend(got)
+        rotated += 1
+        offset = 0
+        nb = 1
+    # a missing live file at offset 0 is an empty poll (not opened yet, or
+    # the writer is between its rotation rename and the fresh open)
+    got, offset, nb, segment, last_seq = _tail_file(
+        path, offset, nb, segment, last_seq, allow_missing=(offset == 0))
+    records.extend(got)
     return records, TailCursor(offset=offset, line=nb, segment=segment,
-                               last_seq=last_seq)
+                               last_seq=last_seq, rotated=rotated)
 
 
 def counts_by_type(records):

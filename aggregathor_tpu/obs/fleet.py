@@ -289,16 +289,23 @@ class FleetCollector:
     def journal_payload(self):
         """The ``/fleet/journal`` JSON body: every configured journal
         loaded through the validator (obs/events.py) and merged into one
-        wall-clock-ordered timeline, each event stamped with its
-        instance.  A missing/garbled journal degrades to a per-instance
-        error entry — one bad file must not hide the others' timeline."""
+        causally ordered timeline (obs/causal.py ``merge_streams``: wall
+        clock + ``(t_wall, instance)`` tie-break where no ``cause`` edge
+        says otherwise, edges respected where one does — an effect never
+        precedes its cited cause, and a wall-clock inversion between
+        hosts is reported as measured ``skew`` rather than crashed on),
+        each event stamped with its instance.  A missing/garbled journal
+        degrades to a per-instance error entry — one bad file must not
+        hide the others' timeline."""
+        from . import causal
+
         with self._lock:
             sources = [
                 (inst.name, inst.journal_path)
                 for inst in self._instances.values()
                 if inst.journal_path is not None
             ]
-        merged, per_instance = [], {}
+        streams, per_instance = {}, {}
         for name, path in sources:
             try:
                 records = obs_events.load_journal(path)
@@ -318,13 +325,14 @@ class FleetCollector:
                 "path": path, "events": len(records),
                 "by_type": obs_events.counts_by_type(records),
             }
-            for record in records:
-                merged.append(dict(record, instance=name))
-        merged.sort(key=lambda r: (r["t_wall"], r["instance"], r["seq"]))
+            streams[name] = records
+        merged, merge_report = causal.merge_streams(streams)
         return {
             "schema": obs_events.SCHEMA,
             "instances": per_instance,
             "events": merged,
+            "skew": {"pairs": merge_report["skew_pairs"],
+                     "forced_order": merge_report["forced_order"]},
         }
 
     # ------------------------------------------------------------------ #

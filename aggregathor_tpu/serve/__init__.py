@@ -64,6 +64,7 @@ from .engine import (  # noqa: F401
 )
 from .frontend import InferenceServer  # noqa: F401
 from .router import (  # noqa: F401
+    CAUSAL_HEADER,
     BackendView,
     FleetRouter,
     RouterServer,

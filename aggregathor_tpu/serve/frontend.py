@@ -47,6 +47,7 @@ import urllib.parse
 import numpy as np
 
 from ..obs import LatencyHistogram
+from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 from ..obs import trace
 from ..utils import UserException, info
@@ -475,6 +476,19 @@ class InferenceServer:
         if method == "POST" and parsed.path == "/predict":
             trace.instant("serve.request", cat="serve", bytes=len(body))
             code, payload = await self._handle_predict(body)
+            # the causal-plane echo (docs/observability.md): a valid
+            # X-Causal-Id token (the router's journal-event reference)
+            # rides back in the response, so the caller can join this
+            # answer to the routing decision that produced it; a garbled
+            # token is dropped, never a request failure
+            token = headers.get("x-causal-id")
+            if token is not None and isinstance(payload, dict):
+                try:
+                    obs_events.parse_cause(token)
+                except ValueError:
+                    pass
+                else:
+                    payload = dict(payload, causal_id=token)
             return code, "application/json", json.dumps(payload)
         if method == "GET" and parsed.path == "/healthz":
             return 200, "application/json", json.dumps(self.health_payload())

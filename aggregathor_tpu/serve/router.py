@@ -73,6 +73,15 @@ from ..utils import UserException, info
 #: keys on; requests without it are routed (and counted) but not pinned
 CLIENT_HEADER = "X-Client-Id"
 
+#: the causal-plane header (docs/observability.md "The causal plane"):
+#: a :func:`~..obs.events.format_cause` token naming the journal event
+#: that caused this forward.  The router stamps its own latest event for
+#: the dispatch (a caused ``router_route`` or a ``router_retry``) —
+#: steady-state forwards pass an inbound client token through unchanged —
+#: and backends echo the token into their ``/predict`` response, so a
+#: postmortem can join the router's decision to the backend's answer.
+CAUSAL_HEADER = "X-Causal-Id"
+
 #: request bodies above this are refused outright (mirrors the front end)
 MAX_BODY_BYTES = 64 * 1024 * 1024
 
@@ -138,7 +147,8 @@ class _Backend:
     """Router-side runtime state for one backend (lock-protected)."""
 
     __slots__ = ("name", "url", "in_flight", "known_step", "draining",
-                 "alive", "status", "dispatched", "failures")
+                 "alive", "status", "dispatched", "failures",
+                 "down_event", "drain_event")
 
     def __init__(self, name, url):
         self.name = name
@@ -150,16 +160,19 @@ class _Backend:
         self.status = {}      # last /status body seen by the scrape
         self.dispatched = 0
         self.failures = 0
+        self.down_event = None   # last router_backend_down record (cause)
+        self.drain_event = None  # last router_drain record (cause)
 
 
 class _Session:
     """One client's pin + assignment (the step-consistency state)."""
 
-    __slots__ = ("pin", "backend")
+    __slots__ = ("pin", "backend", "pin_event")
 
     def __init__(self):
         self.pin = None
         self.backend = None
+        self.pin_event = None    # last router_step_pin record (cause)
 
 
 class FleetRouter:
@@ -178,18 +191,24 @@ class FleetRouter:
         the backends' own batch wait).
       step_wait_s: how long a pinned request may wait for SOME backend to
         reach its pin during a swap window before giving up (503).
+      instance_name: this router's fleet-instance name — the ``instance``
+        field of the :data:`CAUSAL_HEADER` tokens it stamps (must match
+        the name its journal is merged under in ``/fleet/journal``).
       fetch / post / clock / sleep: injectable transports and time — the
-        synthetic-clock tests drive every path without sockets.
+        synthetic-clock tests drive every path without sockets.  ``post``
+        is ``post(url, body, timeout, headers) -> (code, body_bytes)``.
     """
 
     def __init__(self, backends, policy=None, registry=None,
                  poll_interval=0.5, down_after=3, timeout=2.0,
                  request_timeout_s=60.0, step_wait_s=5.0,
+                 instance_name="router",
                  fetch=None, post=None, clock=None, sleep=None):
         if not backends:
             raise UserException("FleetRouter wants at least one backend")
         if float(step_wait_s) < 0:
             raise UserException("step_wait_s must be >= 0")
+        self.instance_name = str(instance_name)
         self.policy = policy if policy is not None else RoutingPolicy()
         self.registry = registry if registry is not None else obs_metrics.REGISTRY
         self.poll_interval = float(poll_interval)
@@ -294,18 +313,27 @@ class FleetRouter:
         if recovered:
             obs_events.emit("router_backend_up", backend=backend.name)
         if began_drain:
-            obs_events.emit("router_drain", backend=backend.name,
-                            in_flight=in_flight)
+            record = obs_events.emit("router_drain", backend=backend.name,
+                                     in_flight=in_flight)
+            with self._lock:
+                backend.drain_event = record
 
     def _mark_down(self, backend, reason):
+        """Latch a backend out; returns the ``router_backend_down`` record
+        (None when already down or journaling is off) — the cause the
+        re-route / retry it triggers will cite."""
         with self._lock:
             was_alive = backend.alive
             backend.alive = False
             backend.failures += 1
         self._m_up.labels(backend=backend.name).set(0.0)
         if was_alive or was_alive is None:
-            obs_events.emit("router_backend_down", backend=backend.name,
-                            reason=reason)
+            record = obs_events.emit("router_backend_down",
+                                     backend=backend.name, reason=reason)
+            with self._lock:
+                backend.down_event = record
+            return record
+        return None
 
     # ------------------------------------------------------------------ #
     # views + sessions
@@ -340,37 +368,55 @@ class FleetRouter:
                 session = self._sessions[client_id] = _Session()
             return session
 
-    def _note_assignment(self, client_id, session, choice, pin):
+    def _note_assignment(self, client_id, session, choice, pin,
+                         inbound_cause=None):
         """Journal a client's backend assignment when it changes FOR A
         CAUSE (first contact, the previous backend down/draining, or the
         step pin excluding it).  Steady-state least-in-flight moves
         between equally-healthy backends are the calm case and stay off
         the timeline — the PR-15 journal discipline; a 3-backend fleet
         under closed-loop load would otherwise write hundreds of route
-        lines per second that replay nothing."""
+        lines per second that replay nothing.
+
+        Returns the emitted ``router_route`` record (None for steady-state
+        moves or with journaling off) — the latest causal event for this
+        dispatch, stamped onto the forward as :data:`CAUSAL_HEADER`.  The
+        route cites ITS cause: the down/drain event that evicted the
+        previous backend, or the step-pin advance that excluded it
+        (the inbound client token for first contact)."""
         if session is None:
-            return
+            return None
+        cause = None
         with self._lock:
             previous = session.backend
             if previous == choice:
-                return
+                return None
             session.backend = choice
             if previous is None:
                 reason = "initial"
+                cause = inbound_cause
             else:
                 old = self._backends.get(previous)
                 if old is None or not old.alive:
                     reason = "backend_down"
+                    if old is not None and old.down_event is not None:
+                        cause = obs_events.cause_of(old.down_event)
                 elif old.draining:
                     reason = "drain"
+                    if old.drain_event is not None:
+                        cause = obs_events.cause_of(old.drain_event)
                 elif pin is not None and (old.known_step is None
                                           or old.known_step < pin):
                     reason = "step_pin"
+                    if session.pin_event is not None:
+                        cause = obs_events.cause_of(session.pin_event)
                 else:
                     reason = "rebalance"
         if reason != "rebalance":
-            obs_events.emit("router_route", client=client_id, backend=choice,
-                            previous=previous, reason=reason, step_pin=pin)
+            return obs_events.emit("router_route", client=client_id,
+                                   backend=choice, previous=previous,
+                                   reason=reason, step_pin=pin, cause=cause)
+        return None
 
     def _observe_step(self, name, client_id, session, step):
         """A 200 response reported its served ``weights_step``: raise the
@@ -389,14 +435,17 @@ class FleetRouter:
                 advanced = (session.pin, step)
                 session.pin = step
         if advanced is not None:
-            obs_events.emit("router_step_pin", client=client_id,
-                            backend=name, previous=advanced[0],
-                            pin=advanced[1])
+            record = obs_events.emit("router_step_pin", client=client_id,
+                                     backend=name, previous=advanced[0],
+                                     pin=advanced[1])
+            with self._lock:
+                if session is not None:
+                    session.pin_event = record
 
     # ------------------------------------------------------------------ #
     # the request path
 
-    def handle_predict(self, body, client_id=None):
+    def handle_predict(self, body, client_id=None, causal_id=None):
         """Route one ``/predict`` body; returns ``(code, payload_dict)``.
 
         The loop either returns, excludes a backend (shed this request /
@@ -404,6 +453,13 @@ class FleetRouter:
         ``step_wait_s`` — so it terminates.  A transport death is retried
         EXACTLY once; ``/predict`` is idempotent (pure inference), so the
         re-dispatch cannot double-apply anything.
+
+        ``causal_id`` is the request's inbound :data:`CAUSAL_HEADER` token
+        (None when absent).  The forward carries the router's latest
+        journal event for this dispatch as the header — a caused
+        ``router_route`` or a ``router_retry`` — falling back to the
+        inbound token unchanged; a garbled inbound token is dropped, never
+        a request failure (observability must not shed traffic).
         """
         started = self.clock()
         session = self._session(client_id)
@@ -411,6 +467,14 @@ class FleetRouter:
         excluded = set()
         retried = False
         waited = False
+        inbound_cause = None
+        forward_token = None
+        if causal_id is not None:
+            try:
+                inbound_cause = obs_events.parse_cause(causal_id)
+                forward_token = causal_id
+            except ValueError:
+                pass
         while True:
             views = self.views(exclude=excluded)
             if not any(v.up and not v.draining for v in views):
@@ -444,7 +508,13 @@ class FleetRouter:
                 self.poll_once()
                 continue
             backend = self._backends[choice]
-            self._note_assignment(client_id, session, choice, pin)
+            route_event = self._note_assignment(client_id, session, choice,
+                                               pin, inbound_cause)
+            if route_event is not None:
+                forward_token = obs_events.format_cause(
+                    obs_events.cause_of(route_event, self.instance_name))
+            headers = ({CAUSAL_HEADER: forward_token}
+                       if forward_token is not None else {})
             with self._lock:
                 backend.in_flight += 1
                 backend.dispatched += 1
@@ -452,15 +522,16 @@ class FleetRouter:
             self._m_forwards.labels(backend=choice).inc()
             try:
                 code, payload = self._post(
-                    backend.url + "/predict", body, self.request_timeout_s
+                    backend.url + "/predict", body, self.request_timeout_s,
+                    headers,
                 )
             except (OSError, ValueError) as exc:
                 # transport death (URLError/ConnectionError/timeout are
                 # all OSError; ValueError covers a torn chunked read):
                 # latch the backend out NOW — ahead of the scrape — and
                 # re-dispatch exactly once
-                self._mark_down(backend, "request_failure: %s"
-                                % type(exc).__name__)
+                down_event = self._mark_down(
+                    backend, "request_failure: %s" % type(exc).__name__)
                 excluded.add(choice)
                 if retried:
                     return self._answer(502, {
@@ -469,9 +540,15 @@ class FleetRouter:
                     })
                 retried = True
                 self._m_retries.inc()
-                obs_events.emit("router_retry", client=client_id,
-                                backend=choice,
-                                reason=type(exc).__name__)
+                # the second attempt cites the first attempt's failure
+                retry_event = obs_events.emit(
+                    "router_retry", client=client_id, backend=choice,
+                    reason=type(exc).__name__,
+                    cause=(obs_events.cause_of(down_event)
+                           if down_event is not None else inbound_cause))
+                if retry_event is not None:
+                    forward_token = obs_events.format_cause(
+                        obs_events.cause_of(retry_event, self.instance_name))
                 continue
             finally:
                 with self._lock:
@@ -558,12 +635,14 @@ class FleetRouter:
             self.registry.unregister(name)
 
 
-def _default_post(url, body, timeout):
+def _default_post(url, body, timeout, headers=None):
     """(code, body_bytes) for a JSON POST; transport errors raise (the
-    router's retry-once path), HTTP error codes return normally."""
-    request = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/json"}
-    )
+    router's retry-once path), HTTP error codes return normally.
+    ``headers`` are extra request headers (the causal-plane stamp)."""
+    merged = {"Content-Type": "application/json"}
+    if headers:
+        merged.update(headers)
+    request = urllib.request.Request(url, data=body, headers=merged)
     try:
         with urllib.request.urlopen(request, timeout=timeout) as response:
             return response.status, response.read()
@@ -606,9 +685,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         body = self.rfile.read(length) if length else b""
         client_id = self.headers.get(CLIENT_HEADER)
+        causal_id = self.headers.get(CAUSAL_HEADER)
         try:
             code, payload = self.server.router.handle_predict(
-                body, client_id=client_id
+                body, client_id=client_id, causal_id=causal_id
             )
         except Exception as exc:  # a request must never kill the router
             code, payload = 500, {"error": "%s: %s"

@@ -28,6 +28,16 @@ Every executed action is one typed journal event
 (``supervisor_restart/quarantine/retune/rollback/observe``) carrying the
 policy's triggering evidence — the causal chain from symptom to action
 replays from the merged fleet journal (benchmarks/soak.py proves it).
+
+The causal plane (docs/observability.md): action events are emitted
+BEFORE the respawn so the freshly minted ``(run_id, seq)`` can be handed
+to the child as ``--cause INSTANCE:RUN_ID:SEQ`` (specs opt in with
+``cause_flag``); the child's ``run_start`` then cites the exact
+``supervisor_restart``/``supervisor_retune`` that spawned it, and
+``cli.postmortem`` replays the cross-process chain from the journals
+alone.  Retune events additionally cite the LAST streak-forming journal
+record of the retuned instance (the policy's evidence refs plus the
+tailed stream's current ``run_id``).
 """
 
 import json
@@ -140,19 +150,23 @@ class InstanceSpec:
     scraping entirely); ``journal`` is the instance's journal file to
     tail; ``verdict`` the sentinel verdict JSON the instance writes
     (``--slo-verdict``); ``checkpoint_dir``/``session_secret`` arm the
-    rollback path."""
+    rollback path; ``cause_flag`` opts the instance into causal-plane
+    argv injection — action-triggered respawns then carry
+    ``--cause INSTANCE:RUN_ID:SEQ`` citing the spawning action event
+    (opt-in because arbitrary argvs — crash-looper one-liners, non-CLI
+    processes — must not receive flags they never declared)."""
 
     __slots__ = ("name", "role", "argv", "env", "cwd", "url", "ready_file",
                  "ready_timeout", "journal", "verdict", "checkpoint_dir",
                  "checkpoint_base_name", "session_secret", "allow_unsigned",
-                 "retunes", "log", "stop_timeout")
+                 "retunes", "log", "stop_timeout", "cause_flag")
 
     def __init__(self, name, role, argv, env=None, cwd=None, url=None,
                  ready_file=None, ready_timeout=180.0, journal=None,
                  verdict=None, checkpoint_dir=None,
                  checkpoint_base_name="model", session_secret=None,
                  allow_unsigned=False, retunes=(), log=None,
-                 stop_timeout=20.0):
+                 stop_timeout=20.0, cause_flag=False):
         self.name = str(name)
         self.role = str(role)
         self.argv = [sys.executable if a == "{python}" else str(a)
@@ -171,6 +185,7 @@ class InstanceSpec:
         self.retunes = tuple(retunes)
         self.log = log
         self.stop_timeout = float(stop_timeout)
+        self.cause_flag = bool(cause_flag)
         if not self.argv:
             raise UserException("Instance %r has an empty argv" % (self.name,))
 
@@ -218,7 +233,7 @@ class _Managed:
     """Runtime state of one supervised instance (actuator-internal)."""
 
     __slots__ = ("spec", "proc", "url", "cursor", "verdict_stamp",
-                 "quarantined", "spawned_at", "restarts")
+                 "quarantined", "spawned_at", "restarts", "last_run_id")
 
     def __init__(self, spec):
         self.spec = spec
@@ -229,14 +244,18 @@ class _Managed:
         self.quarantined = False
         self.spawned_at = None
         self.restarts = 0
+        self.last_run_id = None       # run_id of the last tailed record
 
 
 class FleetSupervisor:
     """Spawn, watch and steer a fleet of train/serve/router instances."""
 
     def __init__(self, specs, config=None, retunes=None, down_after=3,
-                 scrape_timeout=2.0, clock=None):
+                 scrape_timeout=2.0, clock=None, instance_name="supervisor"):
         self.config = config if config is not None else SupervisorConfig()
+        #: this supervisor's name in cross-journal cause references —
+        #: children spawned by an action cite (instance_name, run_id, seq)
+        self.instance_name = str(instance_name)
         self.specs = list(specs)
         ladder_map = dict(retunes or {})
         for spec in self.specs:
@@ -254,8 +273,18 @@ class FleetSupervisor:
     # ------------------------------------------------------------------ #
     # process lifecycle
 
-    def _spawn(self, managed, wait_ready=True):
+    def _spawn(self, managed, wait_ready=True, cause_record=None):
         spec = managed.spec
+        argv = spec.argv
+        if cause_record is not None and spec.cause_flag:
+            # Causal-plane injection: the action event that decided this
+            # spawn was emitted first, so its (run_id, seq) exists to be
+            # cited.  apply_rung's KEY=VALUE grammar sets-or-replaces
+            # ``--cause`` on a COPY — spec.argv is never mutated, the
+            # injection is per-spawn.
+            token = events.format_cause(
+                events.cause_of(cause_record, self.instance_name))
+            argv = apply_rung(list(spec.argv), "cause=%s" % token)
         if spec.ready_file and os.path.exists(spec.ready_file):
             os.remove(spec.ready_file)   # a stale handshake is a lie
         log_fd = None
@@ -268,7 +297,7 @@ class FleetSupervisor:
             env.update({str(k): str(v) for k, v in spec.env.items()})
         try:
             managed.proc = subprocess.Popen(
-                spec.argv, cwd=spec.cwd, env=env,
+                argv, cwd=spec.cwd, env=env,
                 stdout=log_fd if log_fd else subprocess.DEVNULL,
                 stderr=subprocess.STDOUT if log_fd else subprocess.DEVNULL,
             )
@@ -408,6 +437,11 @@ class FleetSupervisor:
             except ValueError as exc:
                 warning("Supervisor: journal tail of %r failed: %s" % (name, exc))
                 continue
+            if records:
+                # remember the stream's current run_id so evidence seqs
+                # (policy streak refs) can be completed into full cause
+                # references (instance, run_id, seq)
+                managed.last_run_id = records[-1].get("run_id")
             new.extend((name, record) for record in records)
         return new
 
@@ -480,22 +514,50 @@ class FleetSupervisor:
             self._execute_rollback(action)
         elif isinstance(action, Observe):
             events.emit("supervisor_observe", instance=action.instance,
-                        reason=action.reason, evidence=action.evidence)
+                        reason=action.reason, evidence=action.evidence,
+                        cause=self._evidence_cause(action))
         else:
             raise UserException("Unknown supervisor action %r" % (action,))
+
+    def _evidence_cause(self, action):
+        """Complete the policy's evidence refs into a full cause reference.
+
+        Retune-path evidence carries ``events: [{"type", "seq"}, ...]`` —
+        seqs of the streak-forming records in the INSTANCE's journal.  The
+        policy is pure and never sees run_ids, so the actuator supplies
+        the tailed stream's current one; the last streak event (the one
+        that tipped the threshold) becomes the cause.  Liveness/rollback
+        evidence has no journal refs — those actions carry no cause (the
+        sentinel verdict is a file, cited via ``evidence.verdict_id``)."""
+        evidence = getattr(action, "evidence", None) or {}
+        refs = evidence.get("events")
+        if not refs:
+            return None
+        managed = self._managed.get(action.instance)
+        if managed is None or managed.last_run_id is None:
+            return None
+        seq = refs[-1].get("seq")
+        if seq is None:
+            return None
+        return {"instance": action.instance,
+                "run_id": managed.last_run_id, "seq": seq}
 
     def _execute_restart(self, action):
         managed = self._managed[action.instance]
         self._kill(managed)           # a hung process survives its judgment
-        self._spawn(managed)
+        # Emit BEFORE the respawn: the child cites this record's
+        # (run_id, seq) through the injected ``--cause`` flag, so the
+        # reference must exist before the child's run_start is minted.
+        record = events.emit(
+            "supervisor_restart", instance=action.instance,
+            reason=action.reason, attempt=action.attempt,
+            backoff_s=action.backoff_s, evidence=action.evidence,
+            cause=self._evidence_cause(action))
+        self._spawn(managed, cause_record=record)
         managed.restarts += 1
         info("Supervisor: restarted %r (%s, attempt %d, next grace %.3gs)"
              % (action.instance, action.reason, action.attempt,
                 action.backoff_s))
-        events.emit("supervisor_restart", instance=action.instance,
-                    reason=action.reason, attempt=action.attempt,
-                    backoff_s=action.backoff_s, pid=self.pid_of(action.instance),
-                    evidence=action.evidence)
 
     def _execute_quarantine(self, action):
         managed = self._managed[action.instance]
@@ -505,7 +567,8 @@ class FleetSupervisor:
                 "%d restarts" % (action.instance, action.attempts))
         events.emit("supervisor_quarantine", instance=action.instance,
                     reason=action.reason, attempts=action.attempts,
-                    evidence=action.evidence)
+                    evidence=action.evidence,
+                    cause=self._evidence_cause(action))
 
     def _execute_retune(self, action):
         managed = self._managed[action.instance]
@@ -513,16 +576,19 @@ class FleetSupervisor:
         old_argv = list(spec.argv)
         spec.argv = apply_rung(spec.argv, action.rung)
         self._kill(managed, sig=signal.SIGTERM)   # graceful: drains apply
-        self._spawn(managed)
+        # Emit before the respawn (see _execute_restart); the retune cites
+        # the streak record that tipped the threshold as its own cause.
+        record = events.emit(
+            "supervisor_retune", instance=action.instance,
+            rung=action.rung, rung_index=action.rung_index,
+            reason=action.reason,
+            argv_diff={"before": old_argv, "after": list(spec.argv)},
+            evidence=action.evidence, cause=self._evidence_cause(action))
+        self._spawn(managed, cause_record=record)
         managed.restarts += 1
         info("Supervisor: retuned %r rung %d (%s) — argv rebuilt, "
              "instance restarted" % (action.instance, action.rung_index,
                                      action.rung))
-        events.emit("supervisor_retune", instance=action.instance,
-                    rung=action.rung, rung_index=action.rung_index,
-                    reason=action.reason,
-                    argv_diff={"before": old_argv, "after": list(spec.argv)},
-                    evidence=action.evidence)
 
     def _execute_rollback(self, action):
         from ..obs.checkpoint import Checkpoints
@@ -533,7 +599,8 @@ class FleetSupervisor:
             events.emit("supervisor_observe", instance=action.instance,
                         reason="rollback_unavailable",
                         evidence=dict(action.evidence,
-                                      detail="no checkpoint_dir in spec"))
+                                      detail="no checkpoint_dir in spec"),
+                        cause=None)
             return
         checkpoints = Checkpoints(spec.checkpoint_dir,
                                   base_name=spec.checkpoint_base_name)
@@ -543,7 +610,8 @@ class FleetSupervisor:
                         reason="rollback_unavailable",
                         evidence=dict(action.evidence,
                                       detail="fewer than 2 snapshots",
-                                      steps=steps))
+                                      steps=steps),
+                        cause=None)
             return
         restore_step = steps[-2]
         verified = False
@@ -564,7 +632,8 @@ class FleetSupervisor:
                         "verification failed: %s" % (action.instance, exc))
                 events.emit("supervisor_observe", instance=action.instance,
                             reason="rollback_custody_refused",
-                            evidence=dict(action.evidence, error=str(exc)))
+                            evidence=dict(action.evidence, error=str(exc)),
+                            cause=None)
                 return
         elif not spec.allow_unsigned:
             warning("Supervisor: rollback of %r REFUSED — no session "
@@ -573,7 +642,8 @@ class FleetSupervisor:
             events.emit("supervisor_observe", instance=action.instance,
                         reason="rollback_custody_refused",
                         evidence=dict(action.evidence,
-                                      detail="unsigned and not allowed"))
+                                      detail="unsigned and not allowed"),
+                        cause=None)
             return
         discarded = checkpoints.discard_after(restore_step)
         stopped = False
@@ -591,7 +661,11 @@ class FleetSupervisor:
         info("Supervisor: rolled %r back to step %d (discarded %r, "
              "custody_verified=%r)" % (action.instance, restore_step,
                                        discarded, verified))
+        # cause=None deliberately: the trigger is a sentinel VERDICT FILE,
+        # not a journal event — the link to it is ``evidence.verdict_id``
+        # (the postmortem resolves verdict->rollback chains through it).
         events.emit("supervisor_rollback", instance=action.instance,
                     restore_step=restore_step, discarded_steps=discarded,
                     custody_verified=verified, stopped=stopped,
-                    reason=action.reason, evidence=action.evidence)
+                    reason=action.reason, evidence=action.evidence,
+                    cause=None)

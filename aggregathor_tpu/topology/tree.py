@@ -474,17 +474,22 @@ class TreeAggregator:
                     spec.nb_units[index] * spec.redundancy
                     * spec.link_bytes_per_row(self._d)
                 )
+            # per-unit conviction records of THIS level round: the
+            # reconstruction event cites the convicting timeout/forgery
+            # event as its cause (the causal plane — same journal, so the
+            # reference's instance stays None)
+            convictions = {}
             for unit in np.nonzero(verdict["timed_out"])[0]:
                 unit = int(unit)
                 excluded = unit in verdict["excluded"]
                 if self._c_timeouts is not None:
                     self._c_timeouts.labels(level=str(level)).inc()
-                events.emit(
+                convictions[unit] = events.emit(
                     "topology_level_timeout", step=int(step), level=level,
                     unit=unit,
                     window=None if verdict["window"] is None
                     else float(verdict["window"]),
-                    excluded=excluded,
+                    excluded=excluded, cause=None,
                 )
                 if self.ledger is not None:
                     self.ledger.note_subaggregator(
@@ -494,9 +499,9 @@ class TreeAggregator:
             for unit in np.nonzero(verdict["corrupt"])[0]:
                 unit = int(unit)
                 excluded = unit in verdict["excluded"]
-                events.emit(
+                convictions[unit] = events.emit(
                     "topology_corruption_verdict", step=int(step),
-                    level=level, unit=unit, excluded=excluded,
+                    level=level, unit=unit, excluded=excluded, cause=None,
                 )
                 if self.ledger is not None:
                     self.ledger.note_subaggregator(
@@ -504,19 +509,22 @@ class TreeAggregator:
                         {"excluded": excluded},
                     )
             for unit, shadow in verdict["reconstructed"].items():
-                cause = (
+                trigger = (
                     "forgery" if verdict["corrupt"][unit] else "timeout"
                 )
                 if self._c_reconstructions is not None:
                     self._c_reconstructions.labels(level=str(level)).inc()
+                conviction = convictions.get(int(unit))
                 events.emit(
                     "topology_reconstruction", step=int(step), level=level,
-                    unit=int(unit), shadow=int(shadow), cause=cause,
+                    unit=int(unit), shadow=int(shadow), trigger=trigger,
+                    cause=(events.cause_of(conviction)
+                           if conviction is not None else None),
                 )
                 if self.ledger is not None:
                     self.ledger.note_subaggregator(
                         step, level, unit, "reconstructed",
-                        {"shadow": int(shadow), "cause": cause},
+                        {"shadow": int(shadow), "cause": trigger},
                     )
             for unit in verdict["excluded"]:
                 if self._c_exclusions is not None:

@@ -1,0 +1,262 @@
+"""Deterministic causal-plane audit: a synthetic fleet incident, written
+with INJECTED clocks through the real journal writer, replayed through
+the real postmortem checker — byte-identical output on every run.
+
+benchmarks/soak.py proves the causal plane against a live fleet, but a
+live fleet's journals change with the weather (ports, pids, scheduler
+timing), so its postmortem can never be a checked-in artifact.  This
+benchmark is the other half of the bargain: the SAME code paths —
+``obs.events.Journal`` writing (clock-injected), ``obs.causal`` merging
+and auditing — over a scripted incident whose every timestamp is chosen,
+so the ``aggregathor.obs.postmortem.v1`` report it emits is reproducible
+to the byte.  The checked-in ``POSTMORTEM_r19.json`` at the repo root IS
+this benchmark's output; regenerating it must leave ``git diff`` clean.
+
+The scripted incident (4 journals: supervisor, train, serve, router):
+
+1. **spawn chain, with skew**: the supervisor liveness-restarts ``serve``
+   (``cause=None`` — the evidence is the ABSENCE of a process); the
+   respawned serve appends a resumed segment to its own journal whose
+   ``run_start`` cites the ``supervisor_restart`` across the process
+   boundary.  Serve's clock runs 0.8 s BEHIND the supervisor's, so the
+   effect carries an earlier wall clock than its cause — the merge must
+   order it after anyway and report the inversion as measured skew.
+2. **retune chain**: the supervisor cites a ``deadline_window`` event it
+   tailed from the TRAIN journal as the cause of a ``supervisor_retune``;
+   the retuned trainer's resumed-segment ``run_start`` cites the retune.
+3. **verdict rollback**: a ``supervisor_rollback`` names its sentinel
+   verdict by ``evidence.verdict_id`` (verdicts are files, not events).
+4. **router echo**: a ``router_retry`` cites the ``router_backend_down``
+   in its own journal; the respawned serve cites the router's re-route
+   (the ``X-Causal-Id`` shape) from a third journal.
+
+Then two NEGATIVE legs prove the verdict can actually flip (neither is
+part of the checked-in report):
+
+- a TORN serve journal (trailing bytes without their newline) must fail
+  the verdict with ``load_errors`` — destroyed evidence, not a smaller
+  story;
+- the respawned ``run_start`` with its ``cause`` stripped must fail with
+  ``incomplete_chains`` — a spawn nobody answers.
+
+Exit status is the overall verdict.  Example::
+
+    python benchmarks/causal_audit.py --out POSTMORTEM_r19.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+SCHEMA = "aggregathor.obs.postmortem.v1"
+
+
+def validate(doc):
+    """Shape check for round-tripping consumers (tests assert this on the
+    checked-in POSTMORTEM_r19.json)."""
+    if doc.get("schema") != SCHEMA:
+        raise ValueError("not a %s document" % SCHEMA)
+    for key in ("instances", "events_total", "edges_total", "chains",
+                "violations", "skew", "verdict", "failing"):
+        if key not in doc:
+            raise ValueError("missing %r" % key)
+    if doc["verdict"] not in ("PASS", "FAIL"):
+        raise ValueError("verdict must be PASS or FAIL: %r" % doc["verdict"])
+    for key in ("dangling_refs", "unresolvable_refs", "orphan_actions",
+                "incomplete_chains", "load_errors"):
+        if key not in doc["violations"]:
+            raise ValueError("violations missing %r" % key)
+    for key in ("pairs", "forced_order", "ambiguous_refs"):
+        if key not in doc["skew"]:
+            raise ValueError("skew missing %r" % key)
+    return doc
+
+
+def load(path):
+    with open(path) as fd:
+        return validate(json.load(fd))
+
+
+class _Clock:
+    """A deterministic clock: advances a fixed tick per reading."""
+
+    def __init__(self, start, tick):
+        self.t = float(start)
+        self.tick = float(tick)
+
+    def __call__(self):
+        value = self.t
+        self.t = round(self.t + self.tick, 6)
+        return value
+
+
+def write_fleet(workdir):
+    """Script the incident through the REAL journal writer; returns
+    ``{instance: path}``."""
+    from aggregathor_tpu.obs import events
+
+    paths = {}
+
+    def journal(name, run_id, wall_start):
+        path = os.path.join(workdir, "journal_%s.jsonl" % name)
+        paths[name] = path
+        return events.Journal(path, run_id=run_id,
+                              wall_clock=_Clock(wall_start, 0.25),
+                              mono_clock=_Clock(0.0, 0.25))
+
+    # serve's wall clock runs 0.8 s behind the supervisor's: the respawn
+    # chain below becomes a measured effect-before-cause inversion
+    supervisor = journal("supervisor", "audit-supervisor", 1000.0)
+    train = journal("train", "audit-train", 1000.1)
+    serve = journal("serve", "audit-serve", 999.2)
+    router = journal("router", "audit-router", 1000.05)
+
+    supervisor.emit("run_start", role="supervisor",
+                    instances=["router", "serve", "train"])
+    train.emit("run_start", role="trainer", experiment="digits")
+    serve.emit("run_start", role="serve", port=7000)
+    router.emit("run_start", role="router", backends=["serve"])
+
+    # --- 1. the serve death: router sees it, supervisor restarts it ----
+    down = router.emit("router_backend_down", backend="serve", misses=2)
+    router.emit("router_retry", client="client-0", backend="serve",
+                cause=events.cause_of(down))     # same-journal edge
+    restart = supervisor.emit(
+        "supervisor_restart", instance="serve", reason="exit", attempt=1,
+        backoff_s=2.0, evidence={"exit_code": -9},
+        cause=None)        # liveness: the evidence is an absent process
+    # the respawned serve: a resumed segment in the SAME file (append
+    # mode, seq restarts at 0) — exactly what a restarted process does
+    serve.close()
+    serve = journal("serve", "audit-serve", 999.65)   # still 0.8 s behind
+    serve.emit("run_start", role="serve", port=7000,
+               cause=events.cause_of(restart, "supervisor"))
+    reroute = router.emit("router_route", client="client-0",
+                          backend="serve", reason="backend_down")
+    serve.emit("serve_weight_swap", step=20,     # the X-Causal-Id shape:
+               cause=events.cause_of(reroute, "router"))  # cross-journal
+
+    # --- 2. the retune: supervisor cites what it tailed from train -----
+    ceiling = train.emit("deadline_window", window_s=0.5, at_ceiling=True)
+    retune = supervisor.emit(
+        "supervisor_retune", instance="train", rung="step-deadline*10",
+        evidence={"trigger": "deadline_ceiling",
+                  "events": [{"type": "deadline_window",
+                              "seq": ceiling["seq"]}]},
+        cause={"instance": "train", "run_id": "audit-train",
+               "seq": ceiling["seq"]})
+    train.close()
+    train = journal("train", "audit-train", 1002.6)
+    train.emit("run_start", role="trainer", experiment="digits",
+               cause=events.cause_of(retune, "supervisor"))
+
+    # --- 3. the rollback: names its sentinel verdict BY IDENTITY -------
+    supervisor.emit(
+        "supervisor_rollback", instance="train", restore_step=10,
+        discarded_steps=[20], custody_verified=True,
+        evidence={"verdict_id": "audit-verdict", "judged_at": 1003.5},
+        cause=None)        # verdicts are files, not journal events
+
+    supervisor.emit("run_end", role="supervisor")
+    for sink in (supervisor, train, serve, router):
+        sink.close()
+    return paths
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="write the postmortem report here")
+    parser.add_argument("--workdir", default=None,
+                        help="journal scratch directory "
+                             "(default: a fresh tempdir)")
+    args = parser.parse_args(argv)
+
+    import tempfile
+
+    from aggregathor_tpu.obs import causal
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="causal_audit_")
+    os.makedirs(workdir, exist_ok=True)
+    paths = write_fleet(workdir)
+
+    report = causal.run_postmortem(paths)
+    # the checked-in artifact must not embed the scratch directory
+    for entry in report["instances"].values():
+        entry["path"] = os.path.basename(entry["path"])
+    validate(report)
+
+    failures = []
+    if report["verdict"] != "PASS":
+        failures.append("verdict %s (failing: %s)"
+                        % (report["verdict"], ", ".join(report["failing"])))
+    chains = {(c["kind"], c["action"]["type"]) for c in report["chains"]}
+    for want in (("spawn", "supervisor_restart"),
+                 ("spawn", "supervisor_retune"),
+                 ("verdict_rollback", "supervisor_rollback")):
+        if want not in chains:
+            failures.append("chain %r not reconstructed" % (want,))
+    skew = report["skew"]["pairs"].get("supervisor->serve")
+    if not skew or skew["max_seconds"] <= 0.0:
+        failures.append("the injected supervisor->serve clock skew was "
+                        "not measured: %r" % (report["skew"]["pairs"],))
+
+    # --- negative leg A: a torn journal must flip the verdict ----------
+    torn_dir = os.path.join(workdir, "torn")
+    os.makedirs(torn_dir, exist_ok=True)
+    torn_paths = dict(paths)
+    torn = os.path.join(torn_dir, "journal_serve.jsonl")
+    with open(paths["serve"], "rb") as fd:
+        body = fd.read()
+    with open(torn, "wb") as fd:
+        fd.write(body[:-10])                     # mid-line, no newline
+    torn_paths["serve"] = torn
+    torn_report = causal.run_postmortem(torn_paths)
+    if torn_report["verdict"] != "FAIL" \
+            or "load_errors" not in torn_report["failing"]:
+        failures.append("torn serve journal did not flip the verdict: %r"
+                        % (torn_report["failing"],))
+
+    # --- negative leg B: an unanswered spawn must flip the verdict -----
+    mute_dir = os.path.join(workdir, "mute")
+    os.makedirs(mute_dir, exist_ok=True)
+    mute_paths = dict(paths)
+    mute = os.path.join(mute_dir, "journal_serve.jsonl")
+    with open(paths["serve"]) as fd, open(mute, "w") as out:
+        for line in fd:
+            record = json.loads(line)
+            if record["type"] == "run_start":
+                record.pop("cause", None)        # the respawn forgets
+            out.write(json.dumps(record) + "\n")
+    mute_paths["serve"] = mute
+    mute_report = causal.run_postmortem(mute_paths)
+    if mute_report["verdict"] != "FAIL" \
+            or "incomplete_chains" not in mute_report["failing"]:
+        failures.append("unanswered spawn did not flip the verdict: %r"
+                        % (mute_report["failing"],))
+
+    print("causal audit: %d event(s), %d edge(s), %d chain(s); "
+          "skew supervisor->serve %.3fs; torn->%s, mute->%s"
+          % (report["events_total"], report["edges_total"],
+             len(report["chains"]),
+             skew["max_seconds"] if skew else float("nan"),
+             torn_report["verdict"], mute_report["verdict"]))
+    if args.out:
+        with open(args.out, "w") as fd:
+            json.dump(report, fd, indent=1, sort_keys=True)
+            fd.write("\n")
+        print("report -> %s" % args.out)
+    if failures:
+        for failure in failures:
+            print("FAIL: %s" % failure)
+        return 1
+    print("verdict: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,18 +37,27 @@ run").  One driver process plays the whole story end to end:
    **journal_causal** (the supervisor journal loads EV001-clean, every
    action event carries its triggering evidence, every kill strictly
    precedes its restart event, the rollback cites the verdict it acted
-   on).  A ``supervisor_retune`` (the straggler wave pinning the deadline
+   on),
+   **postmortem_closes** (every journal the fleet wrote — supervisor,
+   trainer, both serve replicas, router — replays through the SHARED
+   causal checker (``obs/causal.py``, exactly what ``cli.postmortem``
+   runs): zero dangling cause references, zero orphan actions, every
+   supervised respawn answered by a ``run_start`` citing the
+   ``supervisor_restart``/``supervisor_retune`` that spawned it — the
+   ``--cause`` argv injection crossing the process boundary for real).
+   A ``supervisor_retune`` (the straggler wave pinning the deadline
    controller at its ceiling) is reported, and hard-required unless
    ``--no-require-retune``.
 
-Emits one ``aggregathor.soak.v1`` document (``validate``/``load`` below
+Emits one ``aggregathor.soak.v2`` document (``validate``/``load`` below
 are the round-trip the smoke and tests assert); exit status is the
 overall verdict.  The checked-in ``SOAK_r17.json`` at the repo root is a
-passing run of this benchmark on the 1-core CI box.
+passing v1 run of this benchmark (PR 17, pre-causal-plane) on the 1-core
+CI box; v2 adds the ``postmortem`` section and verdict leg.
 
 Example (CPU)::
 
-    python benchmarks/soak.py --ticks 160 --out SOAK_r17.json
+    python benchmarks/soak.py --ticks 160 --out soak.json
 """
 
 import argparse
@@ -62,7 +71,7 @@ import time
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-SCHEMA = "aggregathor.soak.v1"
+SCHEMA = "aggregathor.soak.v2"
 
 
 def validate(doc):
@@ -97,10 +106,18 @@ def validate(doc):
                 "rollback_cites_verdict"):
         if key not in journal:
             raise ValueError("journal missing %r" % key)
+    postmortem = doc.get("postmortem")
+    if not isinstance(postmortem, dict):
+        raise ValueError("missing 'postmortem'")
+    for key in ("verdict", "failing", "instances", "edges", "chains",
+                "skew_pairs"):
+        if key not in postmortem:
+            raise ValueError("postmortem missing %r" % key)
     verdict = doc["verdict"]
     for key in ("kills_recovered", "recovery_in_envelope",
                 "crash_looper_quarantined", "regress_rolled_back",
-                "zero_step_regressions", "journal_causal", "pass"):
+                "zero_step_regressions", "journal_causal",
+                "postmortem_closes", "pass"):
         if not isinstance(verdict.get(key), bool):
             raise ValueError("verdict missing bool %r" % key)
     return doc
@@ -249,6 +266,7 @@ def main(argv=None):
             ready_file=os.path.join(workdir, "ready_%s" % name),
             journal=os.path.join(workdir, "journal_%s.jsonl" % name),
             log=os.path.join(workdir, "log_%s.txt" % name),
+            cause_flag=True,        # respawns cite the restart that spawned
         )                           # ...come back on the SAME host:port
 
     def train_argv(max_step, checkpoint_delta, seed_phase=False):
@@ -309,6 +327,7 @@ def main(argv=None):
             ready_file=os.path.join(workdir, "ready_router"),
             journal=os.path.join(workdir, "journal_router.jsonl"),
             log=os.path.join(workdir, "log_router.txt"),
+            cause_flag=True,
         ),
         # the deliberate crash-looper: exits 3 forever — flap damping bait
         InstanceSpec(
@@ -329,6 +348,7 @@ def main(argv=None):
             session_secret=secret,
             retunes=("step-deadline*10",),
             log=os.path.join(workdir, "log_train.txt"),
+            cause_flag=True,        # a retune respawn cites the retune
         ),
     ]
 
@@ -550,6 +570,23 @@ def main(argv=None):
     looper_quarantines = [r for r in by_type.get("supervisor_quarantine", ())
                           if r["instance"] == "looper"]
     faulted = sorted({e["target"] for e in recovery})
+
+    # ---- the causal plane: every fleet journal through the SHARED
+    # postmortem checker (obs/causal.py — exactly what cli.postmortem
+    # runs), replacing nothing above but PROVING what the hand-written
+    # assertions can't: the cross-process edges.  The supervisor's
+    # --cause injection means every respawned serve/router/train run's
+    # run_start must cite the supervisor_restart/supervisor_retune that
+    # spawned it; the crash-looper keeps no journal so its spawn chain is
+    # unobservable by design (not a violation).
+    from aggregathor_tpu.obs import causal
+
+    pm_sources = {"supervisor": supervisor_journal}
+    for spec in specs:
+        if spec.journal:
+            pm_sources[spec.name] = spec.journal
+    postmortem = causal.run_postmortem(pm_sources)
+
     verdict = {
         "kills_recovered": bool(recovery) and all(
             e["recovered"] for e in recovery),
@@ -566,6 +603,7 @@ def main(argv=None):
         "zero_step_regressions": monotonic_clients and counts["ok"] > 0,
         "journal_causal": evidence_complete and kill_before_restart
         and rollback_cites_verdict,
+        "postmortem_closes": postmortem["verdict"] == "PASS",
     }
     retune_ok = actions_seen["retune"] >= 1
     if not args.no_require_retune:
@@ -625,6 +663,22 @@ def main(argv=None):
             "kill_before_restart": kill_before_restart,
             "rollback_cites_verdict": rollback_cites_verdict,
         },
+        "postmortem": {
+            "verdict": postmortem["verdict"],
+            "failing": postmortem["failing"],
+            "instances": {name: entry.get("events", 0) for name, entry in
+                          postmortem["instances"].items()},
+            "events": postmortem["events_total"],
+            "edges": postmortem["edges_total"],
+            "chains": [{"kind": c["kind"],
+                        "type": c["action"]["type"],
+                        "subject": c["action"].get("subject"),
+                        "seq": c["action"]["seq"]}
+                       for c in postmortem["chains"]],
+            "violations": {key: len(entries) for key, entries in
+                           postmortem["violations"].items()},
+            "skew_pairs": postmortem["skew"]["pairs"],
+        },
         "verdict": verdict,
     }
     validate(doc)
@@ -635,6 +689,11 @@ def main(argv=None):
     print("traffic: %d ok, %d shed, %d dropped; steps %r; monotone %s"
           % (counts["ok"], counts["shed"], counts["dropped"], observed,
              monotonic_clients))
+    print("postmortem: %s — %d event(s), %d edge(s), %d chain(s)%s"
+          % (postmortem["verdict"], postmortem["events_total"],
+             postmortem["edges_total"], len(postmortem["chains"]),
+             " (failing: %s)" % ", ".join(postmortem["failing"])
+             if postmortem["failing"] else ""))
     print("verdict: %s — %s"
           % (" ".join("%s=%s" % (k, v) for k, v in sorted(verdict.items())
                       if k != "pass"),

@@ -1,0 +1,168 @@
+#!/usr/bin/env bash
+# Causal-plane smoke on CPU (<30 s): the PR-19 story end to end through
+# the real CLIs (docs/observability.md "The causal plane").
+#
+#   1. train a tiny digits model WITH a journal -> one checkpoint + the
+#      trainer's own causal record
+#   2. mini-fleet: TWO cli.serve backends + ONE cli.router admission
+#      port, every process journaling (real processes, real HTTP)
+#   3. causal-header leg: a /predict through the router comes back with
+#      the routing decision's X-Causal-Id token echoed as causal_id —
+#      the token parses and names a router_route event that exists
+#   4. kill leg: SIGKILL the client's assigned backend -> the next
+#      request survives on the other backend and its echoed token is
+#      the reroute (reason backend_down) whose cause resolves, in the
+#      router's own journal, to the router_backend_down the kill caused
+#      (router_retry cites the same down event)
+#   5. postmortem leg: cli.postmortem over ALL FOUR journals merges the
+#      fleet along cause edges and the story closes — verdict PASS,
+#      exit 0, nonzero cause edges, and the markdown story spells the
+#      kill -> down -> reroute chain out loud
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-/tmp/aggregathor_postmortem_smoke}"
+rm -rf "$out"
+mkdir -p "$out"
+
+# ---- 1. train -> checkpoint + the trainer's journal
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:16 \
+  --aggregator average --nb-workers 4 --nb-devices 1 \
+  --max-step 10 --learning-rate-args initial-rate:0.05 --prefetch 0 \
+  --evaluation-delta -1 --evaluation-period -1 \
+  --checkpoint-dir "$out/ckpt" --checkpoint-delta 10 --checkpoint-period -1 \
+  --summary-delta -1 --summary-period -1 \
+  --journal "$out/journal_train.jsonl" --run-id pm-train
+
+# ---- 2. the mini-fleet, every process journaling
+start_backend() {
+  JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.serve \
+    --experiment digits --experiment-args batch-size:16 \
+    --ckpt-dir "$out/ckpt" --replicas 1 --gar none \
+    --max-batch 8 --queue-bound 256 --lanes 2 --drain-timeout 5 \
+    --port 0 --ready-file "$out/ready_$1" \
+    --journal "$out/journal_$1.jsonl" --run-id "pm-$1" \
+    > "$out/log_$1.txt" 2>&1 &
+  echo $!
+}
+pid_a=$(start_backend a)
+pid_b=$(start_backend b)
+trap 'kill -9 "$pid_a" "$pid_b" "$router_pid" 2>/dev/null || true' EXIT
+for _ in $(seq 1 90); do
+  [ -f "$out/ready_a" ] && [ -f "$out/ready_b" ] && break; sleep 0.5
+done
+[ -f "$out/ready_a" ] && [ -f "$out/ready_b" ] || {
+  echo "backends never became ready"; exit 1; }
+addr_a=$(awk '{print $1 ":" $2}' "$out/ready_a")
+addr_b=$(awk '{print $1 ":" $2}' "$out/ready_b")
+
+# a long --poll-interval on purpose: the DOWN judgment must come from the
+# request-path transport failure (the event the reroute cites), not from
+# the scrape loop winning the race
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.router \
+  --backend "a=$addr_a" --backend "b=$addr_b" \
+  --port 0 --ready-file "$out/ready_router" --poll-interval 5 \
+  --down-after 100 --journal "$out/journal_router.jsonl" \
+  --run-id pm-router > "$out/log_router.txt" 2>&1 &
+router_pid=$!
+for _ in $(seq 1 30); do [ -f "$out/ready_router" ] && break; sleep 0.5; done
+[ -f "$out/ready_router" ] || { echo "router never became ready"; exit 1; }
+
+# ---- 3+4. the causal header across the wire, then across a kill
+JAX_PLATFORMS=cpu python - "$out" "$pid_a" "$pid_b" <<'EOF'
+import json, os, signal, sys, time, urllib.request
+
+from aggregathor_tpu.obs import events
+
+out = sys.argv[1]
+pids = {"a": int(sys.argv[2]), "b": int(sys.argv[3])}
+host, port, _pid = open("%s/ready_router" % out).read().split()
+base = "http://%s:%s" % (host, port)
+body = json.dumps({"inputs": [[0.0] * 64] * 2}).encode()
+
+def predict():
+    request = urllib.request.Request(
+        base + "/predict", data=body,
+        headers={"Content-Type": "application/json", "X-Client-Id": "c0"})
+    with urllib.request.urlopen(request, timeout=30) as response:
+        return json.loads(response.read())
+
+def router_journal():
+    return events.load_journal("%s/journal_router.jsonl" % out)
+
+# causal-header leg: the first answer carries the initial route's token
+payload = predict()
+token = payload.get("causal_id")
+assert token, "no causal_id echoed through the fleet: %r" % payload
+ref = events.parse_cause(token)
+assert ref["instance"] == "router" and ref["run_id"] == "pm-router", ref
+route = [r for r in router_journal()
+         if r["type"] == "router_route" and r["seq"] == ref["seq"]]
+assert route and route[0]["reason"] == "initial", (token, route)
+routed = route[0]["backend"]
+print("causal-header leg OK: token %s names the initial route to %r"
+      % (token, routed))
+
+# kill leg: the assigned backend dies; the reroute CITES the down event
+os.kill(pids[routed], signal.SIGKILL)
+time.sleep(0.3)
+payload = predict()                   # transport failure -> retry -> 200
+token = payload.get("causal_id")
+assert token, "no causal_id echoed after the kill: %r" % payload
+ref = events.parse_cause(token)
+records = router_journal()
+by_seq = {r["seq"]: r for r in records}
+reroute = by_seq[ref["seq"]]
+assert reroute["type"] == "router_route" and \
+    reroute["reason"] == "backend_down", reroute
+assert reroute["backend"] != routed, reroute
+cause = reroute.get("cause")
+assert cause and cause.get("instance") is None, (
+    "the reroute cites nothing: %r" % reroute)
+down = by_seq[cause["seq"]]
+assert down["type"] == "router_backend_down" and \
+    down["backend"] == routed, (reroute, down)
+retries = [r for r in records if r["type"] == "router_retry"]
+assert retries and retries[0]["cause"]["seq"] == down["seq"], retries
+print("kill leg OK: reroute %s cites router_backend_down(%s); "
+      "router_retry cites the same event" % (token, routed))
+with open("%s/victim" % out, "w") as fd:
+    fd.write(routed)
+EOF
+victim=$(cat "$out/victim")
+survivor=$([ "$victim" = a ] && echo b || echo a)
+
+# ---- graceful teardown so every journal closes with run_end
+kill "$router_pid"
+eval "kill \"\$pid_$survivor\""
+for _ in $(seq 1 30); do
+  kill -0 "$router_pid" 2>/dev/null || break; sleep 0.5
+done
+
+# ---- 5. the postmortem: four journals, one verified story, exit 0
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.postmortem \
+  --journal "train=$out/journal_train.jsonl" \
+  --journal "a=$out/journal_a.jsonl" \
+  --journal "b=$out/journal_b.jsonl" \
+  --journal "router=$out/journal_router.jsonl" \
+  --report "$out/postmortem.json" --story "$out/postmortem.md" --quiet
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import json, sys
+
+out = sys.argv[1]
+with open("%s/postmortem.json" % out) as fd:
+    report = json.load(fd)
+assert report["schema"] == "aggregathor.obs.postmortem.v1", report["schema"]
+assert report["verdict"] == "PASS", report["failing"]
+assert report["edges_total"] >= 2, report["edges_total"]
+assert set(report["instances"]) == {"train", "a", "b", "router"}
+story = open("%s/postmortem.md" % out).read()
+assert "because" in story and "router_backend_down" in story, (
+    "the story does not spell the kill chain out: %r" % story[:400])
+print("postmortem leg OK: PASS over %d event(s), %d cause edge(s)"
+      % (report["events_total"], report["edges_total"]))
+EOF
+trap - EXIT
+
+echo "postmortem smoke PASSED"

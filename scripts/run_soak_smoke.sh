@@ -17,6 +17,11 @@
 #      (supervisor_rollback journaled, citing the verdict's judged_at)
 #   5. journal leg: the supervisor's own journal is EV001-clean and
 #      replays the whole story in causal order
+#   6. postmortem leg (PR 19): cli.postmortem merges the supervisor's
+#      and the backend's journals along cause edges and the story
+#      CLOSES — the respawned backend's run_start cites the
+#      supervisor_restart (the --cause argv injection), the rollback
+#      names its verdict, no dangling refs, exit 0
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -54,6 +59,7 @@ spec = {"instances": [
      "env": {"JAX_PLATFORMS": "cpu"},
      "ready_file": "ready_backend",
      "journal": "journal_backend.jsonl",
+     "cause_flag": True,
      "log": "log_backend.txt"},
     {"name": "train", "role": "trainer",
      "argv": ["{python}", "-c", "import time; time.sleep(2)"],
@@ -172,6 +178,28 @@ kills = [r["seq"] for r in restarts if r["instance"] == "backend"]
 assert kills[0] < roll["seq"], "journal order lost the causal story"
 print("journal leg OK: restart -> rollback replays in causal order "
       "(%d records)" % len(records))
+EOF
+
+# ---- 6. postmortem leg: the fleet's journals close as ONE story
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.postmortem \
+  --journal "supervisor=$out/journal_supervisor.jsonl" \
+  --journal "backend=$out/journal_backend.jsonl" \
+  --report "$out/postmortem.json" --quiet
+JAX_PLATFORMS=cpu python - "$out" <<'EOF'
+import json, sys
+
+out = sys.argv[1]
+with open("%s/postmortem.json" % out) as fd:
+    report = json.load(fd)
+assert report["verdict"] == "PASS", report["failing"]
+chains = {(c["kind"], c["action"]["type"]) for c in report["chains"]}
+assert ("spawn", "supervisor_restart") in chains, (
+    "the respawned backend's run_start does not cite its restart: %r"
+    % (report["chains"],))
+assert ("verdict_rollback", "supervisor_rollback") in chains, chains
+print("postmortem leg OK: verdict PASS, %d event(s), %d cause edge(s), "
+      "%d chain(s)" % (report["events_total"], report["edges_total"],
+                       len(report["chains"])))
 EOF
 trap - EXIT
 

@@ -156,14 +156,19 @@ EVENTS_SNIPPET = """
     from aggregathor_tpu.obs import events
 
 
-    def good(step):
+    def good(step, ref):
         events.emit("run_start", step=step)            # declared: clean
+        events.emit("guardian_rollback", step=step,
+                    cause=None)                        # action, kwarg said
+        events.emit("supervisor_retune", step=step,
+                    cause=ref)                         # action, kwarg said
 
 
     def bad(step, kind):
         events.emit("totally_new_event", step=step)    # EV001: undeclared
         events.emit(kind, step=step)                   # EV001: dynamic
         events.emit()                                  # EV001: missing
+        events.emit("supervisor_restart", step=step)   # EV002: no cause=
 """
 
 
@@ -171,10 +176,13 @@ def test_events_fixture_trips_only_events(tmp_path):
     module = snippet_module(tmp_path, "seeded_events.py", EVENTS_SNIPPET)
     results = run_ast_checkers(module)
     findings = results["events"]
-    assert sorted({f.code for f in findings}) == ["EV001"], findings
+    assert sorted({f.code for f in findings}) == ["EV001", "EV002"], findings
     assert {f.symbol for f in findings} == {
-        "totally_new_event", "<dynamic>", "<missing>"}, findings
+        "totally_new_event", "<dynamic>", "<missing>",
+        "supervisor_restart"}, findings
     assert all(f.scope == "bad" for f in findings)
+    ev002 = [f for f in findings if f.code == "EV002"]
+    assert [f.symbol for f in ev002] == ["supervisor_restart"], ev002
     assert results["retrace"] == [], results["retrace"]
     assert results["prng"] == [], results["prng"]
     assert results["concurrency"] == [], results["concurrency"]

@@ -1,0 +1,497 @@
+"""Tests for the causal plane (docs/observability.md "The causal plane"):
+schema v2 cause references (validation, wire tokens, v1 compatibility),
+journal segment rotation with the tail cursor surviving it, the
+edge-respecting deterministic fleet merge (same-instance order is law,
+skew is data), the causal DAG audit + postmortem checker and its CLI
+(exit code = verdict, a truncated journal flips it), and the
+supervisor's ``--cause`` argv injection."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from aggregathor_tpu.obs import causal, events
+
+
+@pytest.fixture(autouse=True)
+def _no_journal_leak():
+    yield
+    events.uninstall()
+
+
+# --------------------------------------------------------------------- #
+# cause references: validation + the wire token
+
+
+def test_validate_cause_rejects_malformed():
+    good = {"instance": "router", "run_id": "r1", "seq": 4}
+    assert events.validate_cause(good) is good
+    with pytest.raises(ValueError, match="not an object"):
+        events.validate_cause(["router", "r1", 4])
+    with pytest.raises(ValueError, match="exactly keys"):
+        events.validate_cause({"instance": "a", "seq": 0})
+    with pytest.raises(ValueError, match="exactly keys"):
+        events.validate_cause(dict(good, extra=1))
+    with pytest.raises(ValueError, match="seq"):
+        events.validate_cause(dict(good, seq=-1))
+    with pytest.raises(ValueError, match="seq"):
+        events.validate_cause(dict(good, seq=True))
+    with pytest.raises(ValueError, match="str or null"):
+        events.validate_cause(dict(good, run_id=7))
+    # None instance (same journal) and None run_id are both legal
+    events.validate_cause({"instance": None, "run_id": None, "seq": 0})
+
+
+def test_cause_token_round_trip():
+    for cause in (
+        {"instance": "supervisor", "run_id": "soak-supervisor", "seq": 12},
+        {"instance": None, "run_id": None, "seq": 0},
+        # run_id may contain ':' — the token splits instance off the
+        # front and seq off the back
+        {"instance": "router", "run_id": "run:2026:08", "seq": 3},
+    ):
+        token = events.format_cause(cause)
+        assert events.parse_cause(token) == cause
+    with pytest.raises(ValueError, match="may not contain"):
+        events.format_cause({"instance": "a:b", "run_id": None, "seq": 0})
+    for garbage in ("", "noseparator", "a:b:notanint", 7):
+        with pytest.raises(ValueError):
+            events.parse_cause(garbage)
+
+
+def test_cause_of_and_triple_normalization(tmp_path):
+    journal = events.Journal(str(tmp_path / "j.jsonl"), run_id="r")
+    first = journal.emit("run_start")
+    ref = events.cause_of(first, "trainer")
+    assert ref == {"instance": "trainer", "run_id": "r", "seq": 0}
+    # emit accepts a dict or an (instance, run_id, seq) triple
+    journal.emit("run_end", cause=ref)
+    journal.emit("run_start", cause=("trainer", "r", 0))
+    with pytest.raises(ValueError, match="triple"):
+        journal.emit("run_end", cause=("trainer", 0))
+    journal.close()
+    records = events.load_journal(journal.path)
+    assert records[1]["cause"] == ref and records[2]["cause"] == ref
+
+
+def test_emit_with_cause_round_trips_installed(tmp_path):
+    path = str(tmp_path / "caused.jsonl")
+    events.install(path, run_id="v2")
+    start = events.emit("run_start", role="serve")
+    events.emit("serve_weight_swap", step=3, cause=events.cause_of(start))
+    events.uninstall()
+    records = events.load_journal(path)
+    assert records[0].get("cause") is None
+    assert records[1]["cause"] == {"instance": None, "run_id": "v2", "seq": 0}
+    assert all(r["schema"] == events.SCHEMA for r in records)
+
+
+def test_v1_journals_still_load_but_may_not_carry_causes(tmp_path):
+    path = str(tmp_path / "v1.jsonl")
+    base = {"schema": events.SCHEMA_V1, "type": "run_start", "run_id": "old",
+            "seq": 0, "step": None, "t_wall": 1.0, "t_mono": 1.0}
+    with open(path, "w") as fd:
+        fd.write(json.dumps(base) + "\n")
+        fd.write(json.dumps(dict(base, type="run_end", seq=1)) + "\n")
+    records = events.load_journal(path)
+    assert [r["type"] for r in records] == ["run_start", "run_end"]
+    cause = {"instance": None, "run_id": None, "seq": 0}
+    with open(path, "a") as fd:
+        fd.write(json.dumps(dict(base, seq=0, cause=cause)) + "\n")
+    with pytest.raises(ValueError, match="v2"):
+        events.load_journal(path)
+
+
+# --------------------------------------------------------------------- #
+# journal rotation (satellite: bounded files for hours-long soaks)
+
+
+def test_journal_rotation_rolls_segments_and_loads_whole(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    journal = events.Journal(path, run_id="rot", max_bytes=300)
+    for _ in range(8):
+        journal.emit("bounded_round", deadline_s=0.25, nb_arrived=6)
+    journal.close()
+    assert journal.nb_rotations >= 2
+    for n in range(1, journal.nb_rotations + 1):
+        assert os.path.exists("%s.%d" % (path, n))
+    # every rolled segment stays under the bound (rotation fires on the
+    # crossing write, so the segment holds it)
+    records = events.load_journal(path)
+    assert len(records) == 8
+    # seq restarts at 0 in each segment; within a segment it is contiguous
+    assert records[0]["seq"] == 0
+    restarts = sum(1 for r in records if r["seq"] == 0)
+    live_segments = 1 if os.path.getsize(path) else 0
+    assert restarts == journal.nb_rotations + live_segments
+    # a fresh writer on the same path continues the numbering
+    journal2 = events.Journal(path, run_id="rot2", max_bytes=300)
+    assert journal2.nb_rotations == journal.nb_rotations
+    journal2.close()
+
+
+def test_tail_cursor_survives_rotation_mid_poll(tmp_path):
+    """The supervisor's incremental tail keeps reading across a roll: the
+    cursor finishes the rolled segment, then follows into younger segments
+    and the live file — no loss, no duplicates, same validation."""
+    path = str(tmp_path / "tailrot.jsonl")
+    journal = events.Journal(path, run_id="t", max_bytes=280)
+    journal.emit("run_start")
+    records, cursor = events.tail_journal(path)
+    assert [r["type"] for r in records] == ["run_start"]
+    # the writer rolls (twice) behind the cursor
+    for _ in range(7):
+        journal.emit("bounded_round", deadline_s=0.1, nb_arrived=4)
+    journal.close()
+    assert journal.nb_rotations >= 2
+    fresh, cursor2 = events.tail_journal(path, cursor)
+    assert len(fresh) == 7
+    assert cursor2.rotated == journal.nb_rotations
+    # the incremental read saw exactly what one whole load sees
+    assert records + fresh == events.load_journal(path)
+    # nothing new: empty poll from the post-rotation cursor
+    again, cursor3 = events.tail_journal(path, cursor2)
+    assert again == [] and cursor3 == cursor2
+    # a rolled segment vanishing behind the cursor is loud
+    os.remove(path + ".1")
+    with pytest.raises(ValueError, match="vanished"):
+        events.tail_journal(path)
+
+
+def test_load_stream_rejects_torn_tail(tmp_path):
+    """The postmortem loader is STRICT about trailing bytes: the
+    incremental readers defer a torn line to the writer's next append —
+    a postmortem has no next append."""
+    path = str(tmp_path / "torn.jsonl")
+    base = {"schema": events.SCHEMA, "type": "run_start", "run_id": None,
+            "seq": 0, "step": None, "t_wall": 1.0, "t_mono": 1.0}
+    with open(path, "w") as fd:
+        fd.write(json.dumps(base) + "\n")
+    assert len(causal.load_stream(path)) == 1
+    with open(path, "a") as fd:
+        fd.write(json.dumps(dict(base, seq=1))[:-5])   # no newline
+    with pytest.raises(ValueError, match="torn"):
+        causal.load_stream(path)
+
+
+# --------------------------------------------------------------------- #
+# the edge-respecting merge (satellite: determinism under skew)
+
+
+def _rec(seq, t_wall, etype="bounded_round", run_id="r", **extra):
+    record = {"seq": seq, "type": etype, "run_id": run_id, "t_wall": t_wall}
+    record.update(extra)
+    return record
+
+
+def test_merge_same_instance_order_never_reorders(tmp_path):
+    """Satellite: per-instance file order is LAW.  Equal wall clocks
+    across seq segments (and even a clock running backwards within one
+    instance) must never interleave that instance's own records."""
+    streams = {
+        "a": [_rec(0, 100.0), _rec(1, 100.0), _rec(0, 100.0, run_id="r2"),
+              _rec(1, 99.5, run_id="r2")],   # clock stepped BACK mid-run
+        "b": [_rec(0, 100.0), _rec(1, 100.0)],
+    }
+    merged, report = causal.merge_streams(streams)
+    for name, stream in streams.items():
+        got = [(r["run_id"], r["seq"]) for r in merged
+               if r["instance"] == name]
+        assert got == [(r["run_id"], r["seq"]) for r in stream]
+    # deterministic independent of dict insertion order
+    reversed_streams = dict(reversed(list(streams.items())))
+    merged2, _ = causal.merge_streams(reversed_streams)
+    assert merged == merged2
+    assert report["forced_order"] == 0
+
+
+def test_merge_orders_effect_after_cause_and_measures_skew():
+    """A cross-stream effect stamped EARLIER than its cause (skewed clock)
+    merges after its cause anyway; the inversion is reported as a skew
+    sample for the ordered pair — data, never a crash."""
+    cause_ref = {"instance": "supervisor", "run_id": "s", "seq": 1}
+    streams = {
+        "supervisor": [_rec(0, 100.0, run_id="s"),
+                       _rec(1, 100.5, "supervisor_restart", run_id="s",
+                            instance="serve")],
+        "serve": [_rec(0, 99.0, "run_start", run_id="v", cause=cause_ref)],
+    }
+    merged, report = causal.merge_streams(streams)
+    order = [(r["instance"], r["seq"]) for r in merged]
+    assert order.index(("serve", 0)) > order.index(("supervisor", 1))
+    assert report["skew_pairs"] == {
+        "supervisor->serve": {"samples": 1, "max_seconds": 1.5}}
+    # the supervisor record's own acted-on target survives the stamp
+    restart = [r for r in merged if r["type"] == "supervisor_restart"][0]
+    assert restart["instance"] == "supervisor"
+    assert restart["subject"] == "serve"
+
+
+def test_merge_breaks_reference_cycles_instead_of_deadlocking():
+    streams = {
+        "a": [_rec(0, 100.0,
+                   cause={"instance": "b", "run_id": "r", "seq": 0})],
+        "b": [_rec(0, 100.1,
+                   cause={"instance": "a", "run_id": "r", "seq": 0})],
+    }
+    merged, report = causal.merge_streams(streams)
+    assert len(merged) == 2
+    assert report["forced_order"] >= 1
+
+
+def test_merge_ambiguous_keys_resolve_to_first_occurrence():
+    """A resumed segment under the SAME run_id re-uses seq values: the
+    key is non-unique, references to it stay best-effort (reported, never
+    a wait that can't be satisfied)."""
+    streams = {
+        "serve": [_rec(0, 100.0), _rec(1, 100.2), _rec(0, 100.4)],
+        "supervisor": [_rec(0, 100.1, "supervisor_observe",
+                            run_id="s", evidence={"x": 1},
+                            cause={"instance": "serve", "run_id": "r",
+                                   "seq": 0})],
+    }
+    merged, report = causal.merge_streams(streams)
+    assert len(merged) == 4
+    assert report["ambiguous_refs"] == [
+        {"instance": "serve", "run_id": "r", "seq": 0}]
+
+
+# --------------------------------------------------------------------- #
+# the audit: dangling / orphan / incomplete chains
+
+
+def test_audit_dangling_vs_unresolvable():
+    streams = {
+        "a": [_rec(0, 1.0),
+              _rec(1, 1.1, cause={"instance": "a", "run_id": "r",
+                                  "seq": 9}),      # into nothing: dangling
+              _rec(2, 1.2, cause={"instance": "ghost", "run_id": "g",
+                                  "seq": 0})],     # journal not given
+    }
+    _chains, violations, edges = causal.audit(streams)
+    assert edges == 2
+    assert [v["seq"] for v in violations["dangling_refs"]] == [1]
+    assert [v["seq"] for v in violations["unresolvable_refs"]] == [2]
+
+
+def test_audit_orphan_actions_and_self_evident_exemption():
+    streams = {"s": [
+        _rec(0, 1.0, "supervisor_quarantine", instance="looper"),  # orphan
+        _rec(1, 1.1, "supervisor_quarantine", instance="looper",
+             evidence={"attempts": 3}),                 # evidence: not one
+        _rec(2, 1.2, "topology_level_timeout", level=1),  # self-evident
+    ]}
+    _chains, violations, _edges = causal.audit(streams)
+    assert [v["seq"] for v in violations["orphan_actions"]] == [0]
+
+
+def test_audit_spawn_chain_completeness():
+    restart = _rec(1, 1.1, "supervisor_restart", run_id="s",
+                   instance="serve", evidence={"exit_code": -9})
+    streams = {
+        "supervisor": [_rec(0, 1.0, "run_start", run_id="s"), restart],
+        "serve": [_rec(0, 0.9, "run_start", run_id="v")],
+    }
+    # the respawn does NOT cite the restart: incomplete
+    _chains, violations, _edges = causal.audit(streams)
+    assert len(violations["incomplete_chains"]) == 1
+    assert violations["incomplete_chains"][0]["subject"] == "serve"
+    # now it does: a spawn chain
+    streams["serve"].append(
+        _rec(1, 1.3, "run_start", run_id="v2",
+             cause={"instance": "supervisor", "run_id": "s", "seq": 1}))
+    chains, violations, _edges = causal.audit(streams)
+    assert not violations["incomplete_chains"]
+    spawn = [c for c in chains if c["kind"] == "spawn"]
+    assert len(spawn) == 1 and spawn[0]["action"]["subject"] == "serve"
+    # a spawn subject with NO journal is unobservable — not a violation
+    looper = _rec(2, 1.4, "supervisor_restart", run_id="s",
+                  instance="looper", evidence={"exit_code": 3})
+    streams["supervisor"].append(looper)
+    _chains, violations, _edges = causal.audit(streams)
+    assert not violations["incomplete_chains"]
+
+
+def test_audit_rollback_names_its_verdict():
+    bare = _rec(0, 1.0, "supervisor_rollback", run_id="s", instance="train",
+                evidence={"judged_at": 5.0})
+    streams = {"supervisor": [bare]}
+    _chains, violations, _edges = causal.audit(streams)
+    assert len(violations["incomplete_chains"]) == 1
+    assert "verdict_id" in violations["incomplete_chains"][0]["missing"]
+    streams["supervisor"] = [dict(bare, evidence={"verdict_id": "v-7"})]
+    chains, violations, _edges = causal.audit(streams)
+    assert not violations["incomplete_chains"]
+    assert chains == [{"kind": "verdict_rollback", "verdict_id": "v-7",
+                       "action": {"instance": "supervisor",
+                                  "type": "supervisor_rollback",
+                                  "run_id": "s", "seq": 0}}]
+
+
+# --------------------------------------------------------------------- #
+# the postmortem checker + CLI (exit code = verdict)
+
+
+def _write_incident(tmp_path):
+    """A real two-journal incident through the real writer (injected
+    clocks): restart -> respawn-citing-run_start, skewed serve clock."""
+    def clock(values):
+        values = iter(values)
+        return lambda: next(values)
+
+    sup_path = str(tmp_path / "supervisor.jsonl")
+    serve_path = str(tmp_path / "serve.jsonl")
+    sup = events.Journal(sup_path, run_id="s",
+                         wall_clock=clock([100.0, 100.5, 103.0]),
+                         mono_clock=clock([0.0, 0.5, 3.0]))
+    serve = events.Journal(serve_path, run_id="v",
+                           wall_clock=clock([99.8, 100.1]),
+                           mono_clock=clock([0.0, 0.3]))
+    sup.emit("run_start", role="supervisor")
+    serve.emit("run_start", role="serve")
+    restart = sup.emit("supervisor_restart", instance="serve",
+                       reason="exit", attempt=1, backoff_s=2.0,
+                       evidence={"exit_code": -9}, cause=None)
+    serve.emit("run_start", role="serve",
+               cause=events.cause_of(restart, "supervisor"))
+    sup.emit("run_end", role="supervisor")
+    sup.close()
+    serve.close()
+    return {"supervisor": sup_path, "serve": serve_path}
+
+
+def test_run_postmortem_pass_and_story(tmp_path):
+    sources = _write_incident(tmp_path)
+    report = causal.run_postmortem(sources)
+    assert report["schema"] == causal.POSTMORTEM_SCHEMA
+    assert report["verdict"] == "PASS" and report["failing"] == []
+    assert [c["kind"] for c in report["chains"]] == ["spawn"]
+    assert "supervisor->serve" in report["skew"]["pairs"]
+    story = causal.render_story(report)
+    assert "**Verdict: PASS**" in story
+    assert "supervisor_restart" in story and "run_start" in story
+
+
+def test_postmortem_cli_exit_code_is_verdict(tmp_path):
+    from aggregathor_tpu.cli import postmortem as pm_cli
+    from aggregathor_tpu.utils import UserException
+
+    sources = _write_incident(tmp_path)
+    report_path = str(tmp_path / "report.json")
+    story_path = str(tmp_path / "story.md")
+    argv = ["--journal", "supervisor=%s" % sources["supervisor"],
+            "--journal", "serve=%s" % sources["serve"],
+            "--report", report_path, "--story", story_path, "--quiet"]
+    assert pm_cli.main(argv) == 0
+    report = json.load(open(report_path))
+    assert report["verdict"] == "PASS"
+    assert "# Fleet postmortem" in open(story_path).read()
+    # ACCEPTANCE: a deliberately truncated journal flips the verdict —
+    # destroyed evidence, not a smaller story
+    with open(sources["serve"], "rb") as fd:
+        body = fd.read()
+    with open(sources["serve"], "wb") as fd:
+        fd.write(body[:-7])
+    assert pm_cli.main(argv) == 1
+    report = json.load(open(report_path))
+    assert report["verdict"] == "FAIL"
+    assert report["failing"] == ["load_errors"]
+    # malformed --journal specs are user errors
+    with pytest.raises(UserException, match="NAME=PATH"):
+        pm_cli.parse_sources(["nosep"])
+    with pytest.raises(UserException, match="twice"):
+        pm_cli.parse_sources(["a=x", "a=y"])
+
+
+def test_postmortem_missing_run_start_citation_fails(tmp_path):
+    """The spawn-chain half of the acceptance bar: the SAME incident with
+    the respawn's citation stripped must fail with incomplete_chains."""
+    sources = _write_incident(tmp_path)
+    kept = []
+    with open(sources["serve"]) as fd:
+        for line in fd:
+            record = json.loads(line)
+            record.pop("cause", None)
+            kept.append(record)
+    with open(sources["serve"], "w") as fd:
+        for record in kept:
+            fd.write(json.dumps(record) + "\n")
+    report = causal.run_postmortem(sources)
+    assert report["verdict"] == "FAIL"
+    assert report["failing"] == ["incomplete_chains"]
+
+
+def test_causal_audit_benchmark_shape():
+    """The checked-in POSTMORTEM_r19.json round-trips through the
+    benchmark's own validator and carries the scripted story."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "benchmarks"))
+    try:
+        import causal_audit
+    finally:
+        sys.path.pop(0)
+    doc = causal_audit.load(os.path.join(os.path.dirname(__file__), "..",
+                                         "POSTMORTEM_r19.json"))
+    assert doc["verdict"] == "PASS"
+    kinds = {(c["kind"], c["action"]["type"]) for c in doc["chains"]}
+    assert kinds == {("spawn", "supervisor_restart"),
+                     ("spawn", "supervisor_retune"),
+                     ("verdict_rollback", "supervisor_rollback")}
+    assert doc["skew"]["pairs"]["supervisor->serve"]["max_seconds"] > 0
+
+
+# --------------------------------------------------------------------- #
+# the supervisor's --cause argv injection (the write half of the chain)
+
+
+def test_supervisor_spawn_injects_cause_token(tmp_path):
+    from aggregathor_tpu.supervisor import FleetSupervisor, InstanceSpec
+
+    out = str(tmp_path / "argv.json")
+    script = ("import json, sys; "
+              "json.dump(sys.argv[1:], open(%r, 'w'))" % out)
+    spec = InstanceSpec("child", "aux",
+                        [sys.executable, "-c", script, "--cause", "stale"],
+                        cause_flag=True)
+    supervisor = FleetSupervisor([spec], instance_name="sup-1")
+    managed = supervisor._managed["child"]
+    record = {"run_id": "sup-run", "seq": 7}
+    proc = supervisor._spawn(managed, wait_ready=False, cause_record=record)
+    proc.wait(timeout=30)
+    argv = json.load(open(out))
+    # apply_rung REPLACED the stale value on a copy; the spec is untouched
+    assert argv == ["--cause", "sup-1:sup-run:7"]
+    assert spec.argv[-1] == "stale"
+    # without a cause record (initial start), no injection happens
+    proc = supervisor._spawn(managed, wait_ready=False)
+    proc.wait(timeout=30)
+    assert json.load(open(out)) == ["--cause", "stale"]
+    # an opted-out spec never receives the flag
+    spec_plain = InstanceSpec("plain", "aux", [sys.executable, "-c", script])
+    supervisor2 = FleetSupervisor([spec_plain])
+    proc = supervisor2._spawn(supervisor2._managed["plain"],
+                              wait_ready=False, cause_record=record)
+    proc.wait(timeout=30)
+    assert json.load(open(out)) == []
+
+
+def test_cli_causal_flags_parse_and_reject():
+    import argparse
+
+    from aggregathor_tpu import cli
+    from aggregathor_tpu.utils import UserException
+
+    parser = argparse.ArgumentParser()
+    cli.add_causal_flags(parser)
+    args = parser.parse_args(["--cause", "supervisor:run-1:4",
+                              "--journal-max-bytes", "1048576"])
+    assert cli.parse_cause_flag(args.cause) == {
+        "instance": "supervisor", "run_id": "run-1", "seq": 4}
+    assert args.journal_max_bytes == 1048576
+    args = parser.parse_args([])
+    assert args.cause is None and args.journal_max_bytes is None
+    assert cli.parse_cause_flag(None) is None
+    with pytest.raises(UserException, match="--cause"):
+        cli.parse_cause_flag("garbage")

@@ -353,17 +353,24 @@ def test_round_timeline_tracks_and_counters(tmp_path, journal):
 
 
 def test_all_obs_zero_recompiles(tmp_path):
-    """ACCEPTANCE: journal + timeline + int8:ef compression + secure +
-    momentum + stale infill + incremental folding — the whole control room
-    on — still compiles once per bounded executable (the instrumentation
-    is host-side by construction, asserted equal on and off)."""
+    """ACCEPTANCE: journal + CAUSAL PLANE + timeline + int8:ef compression
+    + secure + momentum + stale infill + incremental folding — the whole
+    control room on — still compiles once per bounded executable (the
+    instrumentation is host-side by construction, asserted equal on and
+    off).  The instrumented arm writes cause-bearing v2 events between
+    steps and replays the journal through the postmortem merge afterwards
+    — the causal plane's write AND read halves ride along."""
+    from aggregathor_tpu.obs import causal
     from conftest import assert_zero_recompiles
 
     registry = MetricsRegistry()
     baseline_counts = {}
+    journal_path = str(tmp_path / "zrc.jsonl")
     for instrumented in (False, True):
+        anchor = None
         if instrumented:
-            events.install(str(tmp_path / "zrc.jsonl"), run_id="zrc")
+            events.install(journal_path, run_id="zrc")
+            anchor = events.emit("run_start", role="train")
             trace.install(str(tmp_path / "zrc.trace.json"), run_id="zrc")
         try:
             exp, step, state = _bounded_stack(
@@ -373,8 +380,12 @@ def test_all_obs_zero_recompiles(tmp_path):
                 registry=registry if instrumented else None)
             it = exp.make_train_iterator(8, seed=3)
             try:
-                for _ in range(4):
+                for i in range(4):
                     state, metrics = step(state, next(it))
+                    if instrumented:
+                        events.emit("supervisor_observe", step=i,
+                                    instance="train", detail="zrc probe",
+                                    cause=events.cause_of(anchor))
                 assert_zero_recompiles(step)
                 baseline_counts[instrumented] = step._cache_size()
                 assert np.isfinite(
@@ -385,8 +396,15 @@ def test_all_obs_zero_recompiles(tmp_path):
             if instrumented:
                 trace.uninstall(save=False)
                 events.uninstall()
-    # identical compile counts with the control room on and off
+    # identical compile counts with the causal control room on and off
     assert baseline_counts[False] == baseline_counts[True] == 1
+    # the ridden-along journal replays as one clean causal story
+    records = causal.load_stream(journal_path)
+    merged, report = causal.merge_streams({"train": records})
+    assert len(merged) == len(records) and report["forced_order"] == 0
+    caused = [r for r in merged if r.get("cause")]
+    assert len(caused) == 4
+    assert all(r["cause"]["seq"] == 0 for r in caused)
 
 
 # --------------------------------------------------------------------- #

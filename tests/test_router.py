@@ -102,6 +102,7 @@ class _FakeBackend:
         self.die_next_posts = 0    # forwards die mid-flight, scrape fine
         self.shed_next_posts = 0   # forwards answer 429, scrape fine
         self.posts = 0
+        self.seen_headers = {}     # headers of the last forward seen
 
 
 class _FakeNet:
@@ -128,9 +129,10 @@ class _FakeNet:
             "at_ceiling": False,
         })
 
-    def post(self, url, body, timeout):
+    def post(self, url, body, timeout, headers=None):
         backend = self._named(url)
         backend.posts += 1
+        backend.seen_headers = dict(headers or {})
         if backend.dead:
             raise ConnectionError("connection refused")
         if backend.die_next_posts > 0:
@@ -590,12 +592,17 @@ class _HTTPBackend:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 self.rfile.read(length)
-                self._reply(200, json.dumps({
-                    "predictions": [backend.name],
-                    "weights_step": backend.step,
-                }))
+                token = self.headers.get("X-Causal-Id")
+                backend.seen.append(token)
+                payload = {"predictions": [backend.name],
+                           "weights_step": backend.step}
+                if token is not None:
+                    # the real frontend's causal echo (serve/frontend.py)
+                    payload["causal_id"] = token
+                self._reply(200, json.dumps(payload))
 
         self.name, self.step = name, step
+        self.seen = []                  # X-Causal-Id header per request
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
 
@@ -656,3 +663,95 @@ def test_router_server_round_trip_with_backend_kill():
         router.close()
         for backend in backends[1:]:
             backend.kill()
+
+
+def test_router_causal_header_survives_socket_round_trip(journal):
+    """Satellite: the causal plane over real sockets.  The router stamps
+    its latest journal event for the dispatch as ``X-Causal-Id``; the
+    backend echoes it into the response; a mid-flight retry's forward
+    carries the ``router_retry`` token, and that retry event cites the
+    first attempt's ``router_backend_down`` failure.  A steady-state
+    forward (no new route event) passes the client's inbound token
+    through unchanged."""
+    backends = [_HTTPBackend("a", 7), _HTTPBackend("b", 7)]
+    # down_after is huge on purpose: the scrape loop must NOT win the race
+    # to mark the killed backend down — the REQUEST failure has to, so the
+    # retry deterministically cites the request-driven down event
+    router = FleetRouter({b.name: b.address for b in backends},
+                         registry=MetricsRegistry(), poll_interval=0.2,
+                         down_after=100, step_wait_s=2.0,
+                         instance_name="router-1")
+    server = RouterServer(router)
+    router.start()
+    host, port = server.serve_background()
+    base = "http://%s:%d" % (host, port)
+
+    def post(client, causal_id=None):
+        headers = {"Content-Type": "application/json",
+                   "X-Client-Id": client}
+        if causal_id is not None:
+            headers["X-Causal-Id"] = causal_id
+        request = urllib.request.Request(base + "/predict",
+                                         data=b'{"rows": []}',
+                                         headers=headers)
+        with urllib.request.urlopen(request, timeout=10) as response:
+            return response.status, json.loads(response.read())
+
+    try:
+        # --- initial assignment: the router_route event IS the token ---
+        code, payload = post("c1")
+        assert code == 200
+        token = payload["causal_id"]
+        ref = events.parse_cause(token)
+        assert ref["instance"] == "router-1" and ref["run_id"] == "rtest"
+        routed = payload["backend"]
+        chosen = next(b for b in backends if b.name == routed)
+        assert chosen.seen[-1] == token
+
+        # --- steady state: the inbound token passes through unchanged --
+        inbound = events.format_cause(
+            {"instance": "trainer", "run_id": "ext", "seq": 9})
+        code, payload = post("c1", causal_id=inbound)
+        assert code == 200 and payload["causal_id"] == inbound
+        assert chosen.seen[-1] == inbound
+        # a garbled inbound token is dropped, never a request failure
+        code, payload = post("c1", causal_id="not a token")
+        assert code == 200 and "causal_id" not in payload
+
+        # --- the kill: the second attempt cites the first's failure ----
+        chosen.kill()
+        survivor = next(b for b in backends if b.name != routed)
+        code, payload = post("c1")
+        assert code == 200 and payload["backend"] == survivor.name
+        reroute_token = payload["causal_id"]
+        reroute_ref = events.parse_cause(reroute_token)
+        assert survivor.seen[-1] == reroute_token
+    finally:
+        server.shutdown_all()
+        router.close()
+        for backend in backends:
+            try:
+                backend.kill()
+            except Exception:
+                pass
+    events.uninstall()
+    records = events.load_journal(journal)
+    by_seq = {r["seq"]: r for r in records}
+    # the echoed tokens name real journal events of the right types
+    assert by_seq[ref["seq"]]["type"] == "router_route"
+    assert by_seq[ref["seq"]]["reason"] == "initial"
+    # the forwarded token after the death is the re-assignment event,
+    # whose cause is the failure that evicted the first backend...
+    reroute_record = by_seq[reroute_ref["seq"]]
+    assert reroute_record["type"] == "router_route"
+    assert reroute_record["reason"] == "backend_down"
+    down_ref = reroute_record["cause"]
+    assert down_ref["instance"] is None      # same journal
+    down_record = by_seq[down_ref["seq"]]
+    assert down_record["type"] == "router_backend_down"
+    assert down_record["backend"] == routed
+    assert "request_failure" in down_record["reason"]
+    # ...and the router_retry of the second attempt cites it too
+    retries = [r for r in records if r["type"] == "router_retry"]
+    assert len(retries) == 1 and retries[0]["backend"] == routed
+    assert retries[0]["cause"]["seq"] == down_record["seq"]
