@@ -260,6 +260,15 @@ def build_parser():
              "consecutive missed rounds degrades back to a NaN drop",
     )
     parser.add_argument(
+        "--stale-reweight", action="store_true",
+        help="bounded-wait v3: damp each stale carry row by its age — a "
+             "carry of age a enters aggregation scaled by 1/(1+a) (the "
+             "unbiased-estimator framing of arXiv:2505.23523) instead of "
+             "at full weight.  Requires --stale-infill; the damped row "
+             "still SPENDS the declared-f budget, and every reweighted "
+             "re-entry is a stale_reweight journal event",
+    )
+    parser.add_argument(
         "--incremental-aggregation", action="store_true",
         help="bounded-wait: fold each submission's decoded row into the "
              "aggregate-side device buffer the instant it lands instead of "
@@ -1011,13 +1020,11 @@ def main(argv=None):
         if bounded_wait:
             from ..parallel.bounded import BoundedWaitStep, HostStragglerModel
 
-            if mesh_axes is not None and mesh_axes[1] * mesh_axes[2] != 1:
-                raise UserException(
-                    "--step-deadline with --mesh needs trivial in-group axes "
-                    "(W,1,1): a (pipe x model) submesh submission is one "
-                    "collective program whose members cannot time out "
-                    "independently (docs/engine.md, protocol scope)"
-                )
+            # bounded-wait v3: nontrivial (pipe x model) submeshes are
+            # supported — engine.build_submesh_grad compiles one collective
+            # program per worker-axis submesh, so each of the W submissions
+            # carries its own deadline (docs/engine.md, "v3: submesh
+            # deadlines and age reweighting")
             if args.incremental_aggregation and mesh_axes is not None:
                 raise UserException(
                     "--incremental-aggregation folds per-WORKER rows; the "
@@ -1112,6 +1119,12 @@ def main(argv=None):
                     "--stale-infill needs --step-deadline: the synchronous "
                     "protocol never times anyone out"
                 )
+            if args.stale_reweight and not args.stale_infill:
+                raise UserException(
+                    "--stale-reweight rescales STALE CARRY rows; without "
+                    "--stale-infill every miss is a NaN drop and there is "
+                    "nothing to reweight — pass --stale-infill"
+                )
             if topology_spec is not None:
                 if mesh_axes is not None:
                     raise UserException(
@@ -1152,12 +1165,13 @@ def main(argv=None):
                 )
                 topology.schedule = chaos
         elif (args.deadline_percentile is not None or args.stale_infill
-                or args.straggler_jitter > 0 or args.incremental_aggregation):
+                or args.stale_reweight or args.straggler_jitter > 0
+                or args.incremental_aggregation):
             raise UserException(
-                "--deadline-percentile/--stale-infill/--straggler-jitter/"
-                "--incremental-aggregation are bounded-wait options; pass "
-                "--step-deadline (or --straggler-stall for the synchronous "
-                "baseline)"
+                "--deadline-percentile/--stale-infill/--stale-reweight/"
+                "--straggler-jitter/--incremental-aggregation are "
+                "bounded-wait options; pass --step-deadline (or "
+                "--straggler-stall for the synchronous baseline)"
             )
         if (exchange_codec is not None and exchange_codec.uses_ef
                 and jax.process_count() > 1):
@@ -1260,13 +1274,16 @@ def main(argv=None):
 
                 state0 = make_fresh_state()
                 if bounded_wait:
-                    # the sharded bounded-wait variant (trivial in-group
-                    # axes, validated above): per-submesh submission
-                    # streams, per-group deadlines.  The submission body
-                    # needs the GLOBAL per-worker loss — on a W,1,1 mesh
-                    # the plain loss IS the local partial, with l1/l2
-                    # folded in like the flat branch (the sharded engine's
-                    # analytic reg path belongs to the fused step body).
+                    # the sharded bounded-wait variant: per-submesh
+                    # submission streams, per-group deadlines — on a
+                    # nontrivial (pipe x model) mesh each unit is one
+                    # collective program with its own window (v3,
+                    # engine.build_submesh_grad).  The submission body
+                    # needs the GLOBAL per-worker loss — the plain loss IS
+                    # the local partial (GSPMD partitions it over the
+                    # in-group axes), with l1/l2 folded in like the flat
+                    # branch (the sharded engine's analytic reg path
+                    # belongs to the fused step body).
                     bounded_loss = make_regularized_loss(
                         experiment.loss, args.l1_regularize, args.l2_regularize)
 
@@ -1277,6 +1294,7 @@ def main(argv=None):
                         controller=deadline_controller,
                         stale_infill=args.stale_infill,
                         stale_max_age=args.stale_max_age,
+                        stale_reweight=args.stale_reweight,
                     )
                     ts.step_fn = ts.bounded_step
                 else:
@@ -1329,6 +1347,7 @@ def main(argv=None):
                         controller=deadline_controller,
                         stale_infill=args.stale_infill,
                         stale_max_age=args.stale_max_age,
+                        stale_reweight=args.stale_reweight,
                         incremental=args.incremental_aggregation,
                         # the tree rides only its own rung: an escalation
                         # that swaps the rule retires the host plane with

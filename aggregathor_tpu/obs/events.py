@@ -157,6 +157,14 @@ EVENT_TYPES = {
                                    "chain verification — NAMED as a "
                                    "(level, unit) sub-aggregator, not "
                                    "laundered into worker blame",
+    "stale_reweight": "a stale carry row re-entered aggregation damped by "
+                      "its age coefficient c(a) = 1/(1+a) (worker, age, "
+                      "coefficient — bounded-wait v3, still spends the f "
+                      "budget)",
+    "submesh_timeout": "a (pipe x model) submesh missed its bounded-wait "
+                       "window and forfeited its k logical rows as a unit "
+                       "(group, forfeited — bounded-wait v3 per-submesh "
+                       "deadlines)",
 }
 
 #: fields every event carries (plus the optional ``cause``); ``emit``

@@ -13,12 +13,18 @@ submission (OptiReduce's tail-optimal allreduce, arXiv:2310.06993;
 unified engine's bounded-wait executables:
 
 1. ``engine.build_worker_grad`` (flat) / ``engine.build_group_grad``
-   (sharded, trivial in-group mesh): ONE jitted submission executable,
-   dispatched once per SUBMISSION UNIT per step on its own thread — a
-   unit is one worker in the flat mode, one worker-axis submesh (its
-   k = n/W vmapped logical workers) in the sharded mode.  Per-unit async
-   device streams; each thread's dispatch returns immediately and the
-   submission "arrives" when its rows materialize.
+   (sharded, trivial in-group mesh) / ``engine.build_submesh_grad``
+   (sharded, NONTRIVIAL (pipe x model) submeshes — bounded-wait v3):
+   ONE jitted submission executable, dispatched once per SUBMISSION UNIT
+   per step on its own thread — a unit is one worker in the flat mode,
+   one worker-axis submesh (its k = n/W vmapped logical workers) in the
+   sharded modes.  On a nontrivial submesh the unit's pipe/model
+   collectives are INTERNAL to its program, so the W submissions stay
+   independent and each carries its own deadline: a submesh that misses
+   the window forfeits its k rows as a unit (``submesh_timeout`` on the
+   journal).  Per-unit async device streams; each thread's dispatch
+   returns immediately and the submission "arrives" when its rows
+   materialize.
 2. The host polls arrivals against a window — a fixed ``deadline``, or
    the :class:`~.deadline.DeadlineController`'s adaptive one (percentile
    of the observed arrival distribution, EMA-smoothed, floor/ceiling
@@ -183,6 +189,17 @@ class BoundedWaitStep:
       rounds — after that (or before any row ever arrived) it degrades
       back to the NaN drop.  Stale rows spend the declared-f budget
       exactly like timeouts (module docstring).
+    - ``stale_reweight``: the v3 age-reweighted stale correction — a
+      stale carry row of age a enters the aggregate scaled by
+      c(a) = 1/(1 + a) (the unbiased-estimator framing of
+      arXiv:2505.23523) instead of at full weight.  Requires
+      ``stale_infill``; a worker whose rows go stale has its EF residual
+      frozen (the arrived-mask write-back, unchanged) AND its re-entry
+      discounted, and the damped row still SPENDS the f budget — the
+      laundering accounting is not relaxed (a carried attack row damped
+      is not a carried attack row dropped).  Each reweighted re-entry is
+      a ``stale_reweight`` journal event carrying (worker, age,
+      coefficient).
     - ``incremental``: fold each submission's DECODED row into an
       aggregate-side device buffer **the instant it lands**
       (``engine.build_incremental_fold``) instead of stacking everything
@@ -199,8 +216,8 @@ class BoundedWaitStep:
 
     def __init__(self, engine, loss_fn, tx, params_template, deadline=None,
                  straggler_model=None, registry=None, controller=None,
-                 stale_infill=False, stale_max_age=4, incremental=False,
-                 topology=None):
+                 stale_infill=False, stale_max_age=4, stale_reweight=False,
+                 incremental=False, topology=None):
         if deadline is not None and deadline <= 0.0:
             raise UserException("--step-deadline must be > 0 seconds")
         if stale_infill and deadline is None and controller is None:
@@ -208,6 +225,12 @@ class BoundedWaitStep:
                 "--stale-infill needs a deadline (or the adaptive "
                 "controller): the synchronous protocol never times anyone "
                 "out, so there is nothing to infill"
+            )
+        if stale_reweight and not stale_infill:
+            raise UserException(
+                "--stale-reweight rescales STALE CARRY rows; without "
+                "--stale-infill every miss is a NaN drop and there is "
+                "nothing to reweight"
             )
         self.stale_max_age = int(stale_max_age)
         if stale_infill and self.stale_max_age < 1:
@@ -219,6 +242,7 @@ class BoundedWaitStep:
         self.deadline = deadline
         self.controller = controller
         self.stale_infill = bool(stale_infill)
+        self.stale_reweight = bool(stale_reweight)
         self.model = straggler_model
         self.momentum = engine.worker_momentum is not None
         self.secure = bool(engine.secure)
@@ -258,9 +282,19 @@ class BoundedWaitStep:
                 "never materializes"
             )
         if self.grouped:
+            from .mesh import model_axis, pipe_axis
+
             self.group_size = engine.workers_per_device
             self.nb_units = engine.nb_devices
-            self.grad_fn = engine.build_group_grad(loss_fn)
+            in_group = (engine.mesh.shape[pipe_axis]
+                        * engine.mesh.shape[model_axis])
+            if in_group != 1:
+                # bounded-wait v3: a nontrivial (pipe x model) submesh is
+                # one collective program per worker-axis group — W
+                # independent submissions, each with its own deadline
+                self.grad_fn = engine.build_submesh_grad(loss_fn)
+            else:
+                self.grad_fn = engine.build_group_grad(loss_fn)
         else:
             self.group_size = 1
             self.nb_units = self.nb_workers
@@ -268,6 +302,7 @@ class BoundedWaitStep:
         self.agg_fn = engine.build_bounded_aggregate(
             tx, params_template,
             rows_form="decoded" if self.incremental else "wire",
+            stale_reweight=self.stale_reweight,
         )
         self.pool = ThreadPoolExecutor(
             max_workers=self.nb_units, thread_name_prefix="bw-submit"
@@ -716,17 +751,26 @@ class BoundedWaitStep:
                         cat="bounded", args={"step": step_idx},
                     )
                 elif stale[w0]:
+                    span_args = {
+                        "step": step_idx,
+                        "age": int(self._carry_age[w0]),
+                    }
+                    if self.stale_reweight:
+                        span_args["coefficient"] = (
+                            1.0 / (1.0 + float(self._carry_age[w0]))
+                        )
                     tracer.complete_at(
                         "stale_infill", round_t0_us, window_us, track,
-                        cat="bounded", args={
-                            "step": step_idx,
-                            "age": int(self._carry_age[w0]),
-                        },
+                        cat="bounded", args=span_args,
                     )
                 else:
+                    span_args = {"step": step_idx}
+                    if self.grouped:
+                        # a submesh misses as a unit: all k rows forfeited
+                        span_args["forfeited"] = k
                     tracer.complete_at(
                         "timeout", round_t0_us, window_us, track,
-                        cat="bounded", args={"step": step_idx},
+                        cat="bounded", args=span_args,
                     )
             # per-round counter tracks: where a straggling round's wall
             # time went, as numbers Perfetto graphs next to the tracks
@@ -761,10 +805,36 @@ class BoundedWaitStep:
                 stale_infill=[int(w) for w in np.nonzero(stale)[0]],
                 skipped_units=sorted(int(u) for u in skipped_units),
             )
+        if was_warm and self.stale_reweight:
+            # each reweighted re-entry is its own typed event: the age and
+            # coefficient the aggregate applied (the in-graph twin is
+            # metrics["stale_reweight_coeff"])
+            for w in np.nonzero(stale)[0]:
+                age = int(self._carry_age[w])
+                events.emit(
+                    "stale_reweight", step=step_idx, worker=int(w),
+                    age=age, coefficient=1.0 / (1.0 + age),
+                )
+        if was_warm and self.grouped:
+            # a submesh that missed its window forfeited its k rows as a
+            # unit (skipped units are named by bounded_round instead: they
+            # never dispatched, so no deadline judged them)
+            for unit in range(self.nb_units):
+                if unit in skipped_units or arrived[unit * self.group_size]:
+                    continue
+                events.emit(
+                    "submesh_timeout", step=step_idx, group=int(unit),
+                    forfeited=int(self.group_size),
+                )
         if self.controller is not None and was_warm:
             # feed the controller only rounds the deadline governed (the
-            # compile round's arrivals measure XLA, not the fleet)
-            self.controller.observe_round(arrival_seconds, step=step_idx)
+            # compile round's arrivals measure XLA, not the fleet); a
+            # grouped round's arrivals are per-UNIT decisions, so the
+            # percentile votes over units, not over duplicated members
+            self.controller.observe_round(
+                arrival_seconds, step=step_idx,
+                unit_size=self.group_size if self.grouped else 1,
+            )
         if self._c_folds is not None:
             self._c_folds.inc(nb_folds)
             self._c_overlapped.inc(nb_overlapped)
@@ -783,6 +853,12 @@ class BoundedWaitStep:
         import jax.numpy as jnp
 
         extras = {}
+        if self.stale_reweight:
+            # the (n,) age vector the aggregate's traced coefficient reads
+            # — ages tick host-side, the operand shape/dtype never moves
+            extras["stale_age"] = jnp.asarray(
+                self._carry_age.astype(np.int32)
+            )
         if self.momentum:
             extras["momentum"] = jnp.stack(mom_rows)
         if self.ef:

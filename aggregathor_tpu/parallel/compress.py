@@ -43,6 +43,14 @@ available there), and an infeasible ``topk`` budget refuses when ``d``
 is known.  ``wire_roundtrip`` is THE one place owning the precision-loss
 semantics of rows that cross the wire (forged rows are squeezed through
 it exactly like honest ones — parallel/engine.py's three call sites).
+
+Composition with bounded-wait v3's age reweighting (``--stale-reweight``):
+a stale carry row is stored ENCODED (the wire payload the aggregator last
+received), and the reweight coefficient c(a) = 1/(1+a) is applied by the
+aggregate AFTER this module's decode — the quantization scale and the age
+discount compose as two traced scalars on the decoded f32 row, so neither
+the codec nor the EF residual ever sees a damped value (a stale worker's
+residual is frozen by the arrived-mask write-back, engine.py).
 """
 
 import numpy as np

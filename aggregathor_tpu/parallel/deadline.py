@@ -156,7 +156,7 @@ class DeadlineController:
         ceiling-patience streak far past its documented length."""
         return self._demand_at_ceiling
 
-    def observe_round(self, arrival_seconds, step=None):
+    def observe_round(self, arrival_seconds, step=None, unit_size=1):
         """Feed one completed round; returns the updated window.
 
         ``arrival_seconds`` is the (n,) per-worker arrival vector: seconds
@@ -166,6 +166,13 @@ class DeadlineController:
         — emitted only when the window MOVES materially, censors, or flips
         its at-ceiling verdict, so the journal stays a decision timeline,
         not a per-round metrics mirror.
+
+        ``unit_size`` (bounded-wait v3): the number of logical workers per
+        SUBMISSION UNIT.  A grouped round's k members share one arrival
+        instant by construction (the submesh arrives — or forfeits — as a
+        whole), so the percentile votes over the W per-unit arrivals
+        (every k-th entry) instead of k duplicated copies; the per-worker
+        histograms keep their full labels.
         """
         arrivals = np.asarray(arrival_seconds, np.float64).reshape(-1)
         finite = np.isfinite(arrivals)
@@ -174,6 +181,15 @@ class DeadlineController:
                 self._h_arrival.labels(worker=str(int(worker))).observe(
                     float(arrivals[worker])
                 )
+        unit_size = int(unit_size)
+        if unit_size > 1:
+            if arrivals.size % unit_size:
+                raise UserException(
+                    "observe_round: %d arrivals do not group into units "
+                    "of %d" % (arrivals.size, unit_size)
+                )
+            arrivals = arrivals[::unit_size]
+            finite = finite[::unit_size]
         censored = np.sort(np.where(finite, arrivals, np.inf))
         # linear-interpolated percentile, computed by hand so a censored
         # (+inf) upper neighbor reads as "censored" instead of an inf-inf

@@ -2252,16 +2252,17 @@ class RobustEngine:
     # that absorbs workers missing the deadline as NaN rows — the chaos
     # straggler model as the ACTUAL protocol, not a simulation.
 
-    def _check_bounded_wait_supported(self):
+    def _check_bounded_wait_supported(self, allow_submesh=False):
         if self.sharded:
             in_group = self.mesh.shape[pipe_axis] * self.mesh.shape[model_axis]
-            if in_group != 1:
+            if in_group != 1 and not allow_submesh:
                 raise UserException(
-                    "sharded bounded-wait needs trivial in-group axes "
+                    "build_group_grad needs trivial in-group axes "
                     "(--mesh W,1,1): a (pipe x model) submesh submission is "
                     "one collective program whose members cannot time out "
-                    "independently — per-submesh collective timeouts are a "
-                    "different protocol (docs/engine.md, protocol scope)"
+                    "independently — per-SUBMESH collective timeouts are "
+                    "build_submesh_grad's protocol (docs/engine.md, "
+                    "'v3: submesh deadlines')"
                 )
             if self.granularity != "global":
                 raise UserException(
@@ -2441,7 +2442,54 @@ class RobustEngine:
             "group_grad.dispatch", jax.jit(group_fn), cat="train"
         )
 
-    def build_bounded_aggregate(self, tx, params_template, rows_form="wire"):
+    def build_submesh_grad(self, loss_fn):
+        """The bounded-wait v3 submission executable for NONTRIVIAL
+        (pipe x model) submeshes: one jitted program per WORKER-AXIS
+        SUBMESH whose pipe/model collectives are INTERNAL to the program
+        — ``submesh_fn(params, group_batch, rng, step, gidx) ->
+        {loss: (k,), row: (k, d)[, digest: (k, 4)]}``.
+
+        Where ``build_group_grad`` requires the submesh to be a single
+        device, this builder embraces the collectives: the params stay
+        committed to their (pipe, model) shardings, GSPMD partitions the
+        per-worker gradient across the submesh's in-group devices, and
+        the OUTPUTS are pinned replicated (``out_shardings``) so the
+        host-side stack of W independent submissions commits one layout
+        every round.  Each of the W dispatches is then one self-contained
+        collective program: its in-group members finish or miss the
+        deadline TOGETHER, so a submesh that misses the window forfeits
+        its k = n/W logical rows as a unit into the same declared-f
+        budget (parallel/bounded.py, ``submesh_timeout``).  The group
+        index is a traced operand — one compiled signature, W dispatches
+        per round, zero steady-state recompiles.  Momentum stays refused
+        sharded and the codec exchange stays flat-engine-only, so the
+        body never sees those operands."""
+        self._check_bounded_wait_supported(allow_submesh=True)
+        if not self.sharded:
+            raise UserException(
+                "build_submesh_grad is the sharded-mode submission builder "
+                "(per-submesh collective programs); the flat engine "
+                "dispatches build_worker_grad"
+            )
+        body = self._bounded_submission_body(loss_fn)
+        k = self.workers_per_device
+
+        def submesh_fn(params, group_batch, rng, step, gidx):
+            def one(j, worker_batch):
+                # momentum is refused sharded and the codec exchange is
+                # flat-engine-only, so the body sees neither operand
+                return body(params, worker_batch, rng, step, gidx * k + j,
+                            None, None, None)
+
+            return jax.vmap(one)(jnp.arange(k), group_batch)
+
+        jitted = jax.jit(
+            submesh_fn, out_shardings=NamedSharding(self.mesh, P())
+        )
+        return trace.traced("submesh_grad.dispatch", jitted, cat="train")
+
+    def build_bounded_aggregate(self, tx, params_template, rows_form="wire",
+                                stale_reweight=False):
         """The aggregator side of the bounded-wait protocol: ``agg(state,
         rows, losses, arrived, stale, extras) -> (state, metrics)``, jitted
         once (``params_template`` fixes the flatten/inflate layout).
@@ -2476,8 +2524,19 @@ class RobustEngine:
         signs/verifies one dispatch behind, secure/submit.py).
         Omniscient attacks, quarantine, reputation, the health probe and
         the flight recorder ride the same shared code paths as the fused
-        step (``_prepare_rows`` / ``_finalize_step``)."""
-        self._check_bounded_wait_supported()
+        step (``_prepare_rows`` / ``_finalize_step``).
+
+        ``stale_reweight=True`` is the v3 age-reweighted stale correction
+        (the unbiased-estimator framing of arXiv:2505.23523): a stale
+        carry row of age a is scaled by the traced coefficient
+        c(a) = 1/(1 + a) — ``extras["stale_age"]`` carries the host's
+        (n,) age vector — instead of re-entering at full weight.  The
+        discount composes with the codec as two traced scalars (decode
+        first, then reweight; parallel/compress.py), and it does NOT
+        relax the f-accounting: a reweighted stale row still SPENDS the
+        declared-f budget (the carry may hold a Byzantine worker's
+        attack row — damping it is not dropping it)."""
+        self._check_bounded_wait_supported(allow_submesh=True)
         if rows_form not in ("wire", "decoded"):
             raise UserException(
                 "rows_form must be 'wire' or 'decoded' (got %r)" % (rows_form,)
@@ -2509,6 +2568,18 @@ class RobustEngine:
                 # the dtype twin's wire image (no-op on the f32 wire; the
                 # codec/decoded forms already ARE the wire image)
                 rows = wire_roundtrip(rows, dtype=self.exchange_dtype)
+            reweight_coeff = None
+            if stale_reweight:
+                # v3 age reweighting: damp each stale carry row by
+                # c(a) = 1/(1+a) — traced, so steady state never
+                # recompiles as ages tick.  Applied AFTER decode and the
+                # wire image (the coefficient scales what the rule sees,
+                # not what crossed the wire) and BEFORE _prepare_rows
+                # (reputation/quarantine judge the damped row, exactly
+                # what enters the aggregate).
+                ages = extras["stale_age"].astype(jnp.float32)
+                reweight_coeff = jnp.where(stale, 1.0 / (1.0 + ages), 1.0)
+                rows = rows * reweight_coeff[:, None]
             rows, raw_rows = self._prepare_rows(rows, key, state.reputation)
             dist2 = None
             if self.gar.needs_distances:
@@ -2592,6 +2663,8 @@ class RobustEngine:
             metrics["stale_infill"] = stale
             metrics["nb_timeouts"] = jnp.sum((~arrived).astype(jnp.int32))
             metrics["nb_stale"] = jnp.sum(stale.astype(jnp.int32))
+            if reweight_coeff is not None:
+                metrics["stale_reweight_coeff"] = reweight_coeff
             return new_state, metrics
 
         jitted = jax.jit(agg_fn, donate_argnums=(0,))
@@ -2609,7 +2682,7 @@ class RobustEngine:
         ``(fold, fresh)`` where ``fresh()`` allocates the round's zeroed
         buffer (content under never-written slots is irrelevant: the
         aggregate masks non-arrived, non-stale slots to NaN)."""
-        self._check_bounded_wait_supported()
+        self._check_bounded_wait_supported(allow_submesh=True)
         codec, dt = self.codec, self.exchange_dtype
         if codec is not None:
             codec.validate_d(d)

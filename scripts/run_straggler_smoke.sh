@@ -7,8 +7,11 @@
 # moved.  (Leg 2, adaptive v2, <30 s CPU) the same coalition under the
 # DEADLINE CONTROLLER with stale infill and heavy-tail jitter, asserting
 # the window converged BELOW the fixed deadline, nonzero
-# stale_infill_rows_total, and the stragglers still named.  (Leg 3) the
-# straggler-sweep v2 schema round-trips on a micro sweep.
+# stale_infill_rows_total, and the stragglers still named.  (Leg 3,
+# bounded-wait v3) the adaptive protocol + int8:ef wire + --stale-reweight
+# under a persistent coalition, asserting finite decreasing loss and
+# nonzero typed stale_reweight events on the --journal.  (Leg 4) the
+# straggler-sweep v3 schema round-trips on a micro sweep.
 # The CI-sized version of benchmarks/straggler_sweep.py (docs/engine.md).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -127,21 +130,68 @@ print("straggler smoke: adaptive leg OK (window %.3fs < 0.3s fixed deadline)"
       % window)
 EOF
 
-# ---- leg 3: the sweep v2 schema round-trips on a micro sweep ---------- #
+# ---- leg 3: age-reweighted stale correction (bounded-wait v3) --------- #
+# the adaptive protocol + compressed wire + --stale-reweight under the
+# same persistent coalition: stale carries re-enter DAMPED by c(a) =
+# 1/(1+a), each re-entry a typed stale_reweight event on the journal
+JAX_PLATFORMS=cpu python -m aggregathor_tpu.cli.runner \
+  --experiment digits --experiment-args batch-size:8 \
+  --aggregator krum --nb-workers 8 --nb-decl-byz-workers 2 \
+  --max-step 12 --platform cpu --learning-rate-args initial-rate:0.05 \
+  --step-deadline 0.3 --straggler-stall 0.8 \
+  --deadline-percentile 70 --deadline-floor 0.02 --deadline-ema 0.5 \
+  --stale-infill --stale-max-age 6 --stale-reweight \
+  --exchange int8:ef \
+  --chaos "0:straggle=1.0" --chaos-args straggle-workers:2 \
+  --evaluation-delta 0 --summary-delta 4 \
+  --journal "$out/reweight.journal.jsonl" \
+  --summary-dir "$out/summaries_reweight"
+
+python - "$out" <<'EOF'
+import glob, json, os, sys
+
+out = sys.argv[1]
+
+losses = []
+for path in glob.glob(os.path.join(out, "summaries_reweight", "*.jsonl")):
+    for line in open(path):
+        event = json.loads(line)
+        if "total_loss" in event:
+            losses.append(float(event["total_loss"]))
+assert losses and all(l == l and abs(l) != float("inf") for l in losses), losses
+assert losses[-1] < losses[0], losses  # damped carries still make progress
+
+sys.path.insert(0, ".")
+from aggregathor_tpu.obs import events
+
+records = events.load_journal(os.path.join(out, "reweight.journal.jsonl"))
+reweights = [r for r in records if r["type"] == "stale_reweight"]
+assert reweights, "no stale_reweight events on the journal"
+for rec in reweights:
+    assert rec["worker"] in (0, 1), rec
+    expected = 1.0 / (1.0 + rec["age"])
+    assert abs(rec["coefficient"] - expected) < 1e-9, rec
+
+print("straggler smoke: reweight leg OK (%d damped re-entries journaled)"
+      % len(reweights))
+EOF
+
+# ---- leg 4: the sweep v3 schema round-trips on a micro sweep ---------- #
 JAX_PLATFORMS=cpu python benchmarks/straggler_sweep.py \
-  --steps 4 --regimes steady --deadline 0.15 --stall 0.5 \
-  --out "$out/sweep.json"
+  --steps 4 --rates 1.0 --gars average-nan --exchanges int8:ef --ages 4 \
+  --ef-ages 4 --deadline 0.15 --stall 0.5 --skip-submesh \
+  --out "$out/sweep.json" || true  # micro verdict may not PASS; schema must
 
 python - "$out/sweep.json" <<'EOF'
 import sys
 sys.path.insert(0, "benchmarks")
 from straggler_sweep import load
 
-doc = load(sys.argv[1])  # validates the v2 schema
+doc = load(sys.argv[1])  # validates the v3 schema
 assert doc["verdict"]["breakdown_holds"], doc["verdict"]
-assert any(c["mode"] == "adaptive" and c["stale_total"] > 0
+assert any(c["arm"] == "reweight" and c["stale_total"] > 0
            for c in doc["cells"]), doc["cells"]
-print("straggler smoke: sweep v2 schema round-trips, verdict %s"
+print("straggler smoke: sweep v3 schema round-trips, verdict %s"
       % ("PASS" if doc["verdict"]["pass"] else "partial"))
 EOF
 

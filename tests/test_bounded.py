@@ -1,10 +1,14 @@
 """Bounded-wait aggregation tests (ISSUE 10 tentpole, parallel/bounded.py;
-ISSUE 12: adaptive deadlines, stale infill, momentum/secure/sharded scope):
-deadline-closed rounds, NaN-row absorption within the declared-f budget,
-the n=8/f=2 breakdown property under real timeouts AND under stale-infilled
-attack rows, zero steady-state recompiles with every v2 feature enabled,
-straggler forensics evidence, close() hardening, and the guardian's
-sustained-timeout escalation input."""
+ISSUE 12: adaptive deadlines, stale infill, momentum/secure/sharded scope;
+ISSUE 20 v3: per-submesh collective timeouts + age-reweighted stale
+correction): deadline-closed rounds, NaN-row absorption within the
+declared-f budget, the n=8/f=2 breakdown property under real timeouts AND
+under stale-infilled attack rows (naive and age-reweighted), the reweight
+coefficient math c(a) = 1/(1+a) pinned without wall-clock sleeps,
+forfeit-as-a-unit over a nontrivial (pipe x model) submesh, zero
+steady-state recompiles with every feature enabled, straggler forensics
+evidence, close() hardening, and the guardian's sustained-timeout
+escalation input."""
 
 import time
 
@@ -179,8 +183,15 @@ def test_bounded_wait_rejects_unsupported_modes():
     tp = RobustEngine(make_mesh(nb_workers=1, model_parallelism=2),
                       gars.instantiate("krum", 4, 1), 4,
                       sharding="sharded", granularity="global")
-    with pytest.raises(UserException):
+    with pytest.raises(UserException, match="build_submesh_grad"):
         tp.build_group_grad(lambda p, b: 0.0)
+    # ...which the v3 per-SUBMESH program supports: one collective program
+    # per worker-axis submesh, each with its own deadline
+    assert callable(tp.build_submesh_grad(lambda p, b: 0.0))
+    # the submesh builder is sharded-only (a flat engine has per-worker
+    # submissions already — nothing to group)
+    with pytest.raises(UserException):
+        RobustEngine(mesh, gar, 4).build_submesh_grad(lambda p, b: 0.0)
     # ... and no worker momentum: the sharded TrainState.momentum is a
     # per-leaf pytree, not the flat (n, d) buffer the submissions index
     mom = RobustEngine(make_mesh(nb_workers=1),
@@ -345,6 +356,110 @@ def test_stale_f_accounting_boundary():
     assert not (np.isfinite(over_f).all() and over_f[-1] < over_f[0]), over_f
 
 
+def test_stale_reweight_coefficient_math():
+    """ACCEPTANCE (no wall-clock sleeps): the v3 aggregate's reweight
+    coefficient is exactly c(a) = 1/(1+a) on stale rows and 1 elsewhere,
+    the damped rows are what the rule sees (average over [1, 2, 3, 100]
+    with the last row stale at age 3 is 7.75, not 26.5), the ages are
+    TRACED (steady state never recompiles as they tick), and a reweighted
+    stale row still spends the budget (it stays flagged stale_infill)."""
+    n, f = 4, 1
+    exp = models.instantiate("digits", ["batch-size:8"])
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    engine = RobustEngine(make_mesh(nb_workers=1),
+                          gars.instantiate("average-nan", n, f), n)
+    state = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+    template = jax.device_get(state.params)
+    d = sum(int(np.prod(np.shape(leaf)))
+            for leaf in jax.tree.leaves(template))
+
+    def rows_of(values):
+        return jnp.broadcast_to(
+            jnp.asarray(values, jnp.float32)[:, None], (n, d))
+
+    losses = jnp.zeros((n,), jnp.float32)
+    arrived = jnp.asarray([True, True, True, False])
+    stale = jnp.asarray([False, False, False, True])
+
+    def agg_norm(stale_reweight, ages):
+        agg = engine.build_bounded_aggregate(
+            tx, template, stale_reweight=stale_reweight)
+        extras = ({"stale_age": jnp.asarray(ages, jnp.int32)}
+                  if stale_reweight else {})
+        st = engine.init_state(exp.init(jax.random.PRNGKey(0)), tx, seed=1)
+        st, m = agg(st, rows_of([1.0, 2.0, 3.0, 100.0]), losses,
+                    arrived, stale, extras)
+        return agg, st, jax.device_get(m)
+
+    agg, st, m = agg_norm(True, [0, 0, 0, 3])
+    # coefficient: 1 on every fresh row, 1/(1+3) on the stale one
+    np.testing.assert_allclose(np.asarray(m["stale_reweight_coeff"]),
+                               [1.0, 1.0, 1.0, 0.25])
+    # the rule averaged the DAMPED row: (1 + 2 + 3 + 100/4) / 4 = 7.75
+    np.testing.assert_allclose(float(m["grad_norm"]),
+                               7.75 * np.sqrt(d), rtol=1e-5)
+    # budget accounting unchanged: the reweighted row is still stale spend
+    assert bool(np.asarray(m["stale_infill"])[3])
+    assert int(m["nb_stale"]) == 1 and int(m["nb_timeouts"]) == 1
+    # ages are data: a different age vector re-uses the same executable
+    st, m2 = agg(st, rows_of([1.0, 2.0, 3.0, 100.0]), losses,
+                 arrived, stale, {"stale_age": jnp.asarray([0, 0, 0, 1],
+                                                           jnp.int32)})
+    m2 = jax.device_get(m2)
+    np.testing.assert_allclose(np.asarray(m2["stale_reweight_coeff"]),
+                               [1.0, 1.0, 1.0, 0.5])
+    np.testing.assert_allclose(float(m2["grad_norm"]),
+                               14.0 * np.sqrt(d), rtol=1e-5)
+    assert agg._cache_size() == 1
+    # the naive twin re-enters the carry at full weight: mean 26.5
+    agg_naive, _, m_naive = agg_norm(False, None)
+    np.testing.assert_allclose(float(m_naive["grad_norm"]),
+                               26.5 * np.sqrt(d), rtol=1e-5)
+    assert "stale_reweight_coeff" not in m_naive
+
+
+def test_stale_reweight_requires_stale_infill():
+    """--stale-reweight rescales STALE CARRY rows; without stale infill
+    every miss is a NaN drop and there is nothing to reweight — the
+    constructor refuses loudly (the CLI twin lives in test_cli.py)."""
+    gar = gars.instantiate("krum", 4, 1)
+    with pytest.raises(UserException, match="stale-infill"):
+        BoundedWaitStep(RobustEngine(make_mesh(nb_workers=1), gar, 4),
+                        lambda p, b: 0.0, None, {}, deadline=0.2,
+                        stale_reweight=True)
+
+
+def test_stale_f_accounting_boundary_with_reweight():
+    """ACCEPTANCE (n=8, f=2): age reweighting does NOT move the laundering
+    boundary.  The coalition attacks AND straggles so its DAMPED attack
+    rows re-enter through the carry: at r = f both rules still hold, and
+    at r = f + 1 trimmed-mean still breaks — c(a) never exceeds 1, but a
+    deviation-10000 row damped by 1/(1+a) is still a poison row, so the
+    budget must price reweighted stale rows exactly like naive ones."""
+    def run(gar_name, r, steps=4):
+        exp, engine, step, state = make_stack(
+            gar_name, deadline=0.12, stall=1.0, rate=1.0, nb_eligible=r,
+            attack="gaussian", attack_args=("deviation:10000.0",),
+            nb_real_byz=r, stale_infill=True, stale_max_age=100,
+            stale_reweight=True)
+        it = exp.make_train_iterator(8, seed=3)
+        losses = []
+        try:
+            for _ in range(steps):
+                state, m = step(state, next(it))
+                losses.append(float(jax.device_get(m["total_loss"])))
+        finally:
+            step.close()
+        return losses
+
+    at_f_krum = run("krum", 2)
+    assert np.isfinite(at_f_krum).all() and at_f_krum[-1] < at_f_krum[0]
+    at_f = run("trimmed-mean", 2)
+    assert np.isfinite(at_f).all() and at_f[-1] < at_f[0]
+    over_f = run("trimmed-mean", 3)
+    assert not (np.isfinite(over_f).all() and over_f[-1] < over_f[0]), over_f
+
+
 def test_bounded_wait_all_features_zero_recompiles():
     """ACCEPTANCE: the adaptive controller, stale infill, worker momentum
     and --secure digests all enabled at once — still exactly ONE compile
@@ -490,8 +605,11 @@ def test_raising_submission_surfaces_at_barrier():
         step.close()
 
 
+@pytest.mark.slow
 def test_late_submission_failure_surfaces_next_dispatch():
-    """A submission that outlives its round and then hits a REAL failure
+    """(slow tier: three 0.8 s stalled submissions ride the wall clock —
+    demoted to pay for the v3 submesh/reweight coverage in tier 1.)
+    A submission that outlives its round and then hits a REAL failure
     is booked a timeout for ITS round but raises at the NEXT dispatch —
     never silently re-booked as a straggler forever.  The donation-shaped
     twin (deleted/donated-buffer error) stays a benign race filter."""
@@ -578,6 +696,81 @@ def test_sharded_group_mode_bounded_wait():
     assert_zero_recompiles(step)
 
 
+def test_submesh_bounded_wait_nontrivial_mesh(tmp_path):
+    """ACCEPTANCE (v3 tentpole): bounded-wait over a NONTRIVIAL
+    (pipe x model) mesh — (4, 2, 1), where v2 refused loudly.  One
+    collective program per worker-axis submesh (build_submesh_grad), so
+    the straggling submesh's k = 2 logical workers forfeit their rows AS A
+    UNIT (never one without the other), the age-reweighted carries re-enter
+    within the budget, the typed journal names both decisions
+    (submesh_timeout with the forfeited count, stale_reweight with the
+    coefficient), and the steady state never recompiles."""
+    from jax.sharding import PartitionSpec as P
+
+    from aggregathor_tpu.obs import events
+
+    exp = models.instantiate("digits", ["batch-size:8"])
+    tx = build_optimizer("sgd", build_schedule("fixed", ["initial-rate:0.05"]))
+    n, f, W, pipe = 8, 2, 4, 2
+    engine = RobustEngine(
+        make_mesh(nb_workers=W, pipeline_parallelism=pipe),
+        gars.instantiate("krum", n, f), n,
+        sharding="sharded", granularity="global")
+    k = engine.workers_per_device
+    assert k == 2
+    specs = jax.tree.map(lambda _: P(), exp.init(jax.random.PRNGKey(0)))
+    state = engine.init_state(exp.init, specs, tx, seed=1)
+    model = HostStragglerModel(n, 0.6, rate=1.0, nb_eligible=k)
+    events.install(str(tmp_path / "submesh.jsonl"), run_id="submesh-test")
+    try:
+        step = BoundedWaitStep(
+            engine, exp.loss, tx, jax.device_get(state.params),
+            deadline=0.15, straggler_model=model,
+            stale_infill=True, stale_max_age=8, stale_reweight=True)
+        assert step.nb_units == W and step.group_size == k
+        it = exp.make_train_iterator(n, seed=3)
+        losses = []
+        try:
+            for _ in range(4):
+                state, m = step(state, next(it))
+                m = jax.device_get(m)
+                losses.append(float(m["total_loss"]))
+            tmo = np.asarray(m["straggler_timeout"])
+            stale = np.asarray(m["stale_infill"])
+            coeff = np.asarray(m["stale_reweight_coeff"])
+            totals = np.asarray(step.timeouts_total)
+        finally:
+            step.close()
+    finally:
+        events.uninstall()
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # forfeit-as-a-unit: submesh 0's members miss together, every round,
+    # and nobody else ever does
+    np.testing.assert_array_equal(tmo, [True] * k + [False] * (n - k))
+    np.testing.assert_array_equal(stale, tmo)
+    assert totals[:k].min() == totals[:k].max() > 0
+    assert totals[k:].sum() == 0
+    # the carry ages tick together too: both rows damped by the same c(a)
+    assert coeff[0] == coeff[1] < 1.0
+    np.testing.assert_allclose(coeff[k:], 1.0)
+    from conftest import assert_zero_recompiles
+
+    assert_zero_recompiles(step)
+    # the journal carries both v3 decisions, typed and attributed
+    records = events.load_journal(str(tmp_path / "submesh.jsonl"))
+    by_type = {}
+    for rec in records:
+        by_type.setdefault(rec["type"], []).append(rec)
+    forfeits = by_type.get("submesh_timeout", [])
+    assert forfeits and all(rec["group"] == 0 and rec["forfeited"] == k
+                            for rec in forfeits)
+    reweights = by_type.get("stale_reweight", [])
+    assert {rec["worker"] for rec in reweights} == set(range(k))
+    for rec in reweights:
+        np.testing.assert_allclose(rec["coefficient"],
+                                   1.0 / (1.0 + rec["age"]))
+
+
 def test_host_straggler_model_jitter_heavy_tail():
     """jitter=SIGMA: a late worker's stall becomes lognormal (median =
     stall), deterministic per (seed, step, worker); reachable both as the
@@ -631,12 +824,15 @@ def test_forensics_stale_infill_evidence_and_excused_distance():
     assert ledger2.report()["suspects"] == [0]
 
 
-def test_straggler_sweep_v2_schema_roundtrip():
-    """The checked-in STRAGGLER_r12.json validates under the v2 schema and
-    carries the acceptance claims: the adaptive controller beats BOTH sync
-    and fixed-deadline on steps/s under at least one drifting/heavy-tail
-    regime with no-worse loss, and the n=8/f=2 budget boundary holds under
-    stale infill (r=f converges, r=f+1 does not)."""
+def test_straggler_sweep_v3_schema_roundtrip():
+    """The checked-in STRAGGLER_r20.json validates under the v3 schema and
+    carries the acceptance claims: the age-reweighted arm beats naive
+    stale infill at the top straggle rate on the averaging-family pairs
+    (where the carried attack row enters the estimate), the laundering
+    boundary holds at r = f WITH reweighting and breaks at r = f + 1, the
+    EF compounding break age is a measured point of the scan, and the
+    nontrivial (4,2,1) submesh cell completed with per-submesh timeouts at
+    zero steady-state recompiles."""
     import json
     import os
     import sys
@@ -647,19 +843,28 @@ def test_straggler_sweep_v2_schema_roundtrip():
         from straggler_sweep import SCHEMA, load, validate
     finally:
         sys.path.pop(0)
-    doc = load(os.path.join(root, "STRAGGLER_r12.json"))
-    assert doc["schema"] == SCHEMA == "aggregathor.straggler.sweep.v2"
+    doc = load(os.path.join(root, "STRAGGLER_r20.json"))
+    assert doc["schema"] == SCHEMA == "aggregathor.straggler.sweep.v3"
     assert doc["verdict"]["pass"]
-    assert doc["verdict"]["adaptive_beats_both"]
+    assert doc["verdict"]["reweight_beats_naive"]
     assert doc["breakdown"]["at_f_krum_ok"]
+    assert doc["breakdown"]["at_f_trimmed_ok"]
     assert doc["breakdown"]["over_f_broken"]
-    assert doc["winning_regimes"]
+    assert doc["submesh"]["completed"]
+    assert doc["submesh"]["unit_forfeit_ok"]
+    assert doc["submesh"]["compile_count_ok"]
+    # every top-rate averaging-family pair is a reweight win
+    top = max(doc["config"]["rates"])
+    verdict_gars = set(doc["config"]["verdict_gars"])
+    top_pairs = [p for p in doc["pairs"]
+                 if p["rate"] == top and p["gar"] in verdict_gars]
+    assert top_pairs and all(p["reweight_wins"] for p in top_pairs)
     # a mutated document must be rejected
     bad = json.loads(json.dumps(doc))
-    bad["cells"][0]["mode"] = "bogus"
+    bad["cells"][0]["arm"] = "bogus"
     with pytest.raises(ValueError):
         validate(bad)
     bad2 = json.loads(json.dumps(doc))
-    del bad2["breakdown"]["over_f_broken"]
+    del bad2["verdict"]["reweight_beats_naive"]
     with pytest.raises(ValueError):
         validate(bad2)

@@ -365,6 +365,22 @@ def test_runner_rejects_orphan_jitter_and_dead_microbatches():
              "--microbatches", "2", "--max-step", "1"])
 
 
+def test_runner_rejects_orphan_stale_reweight():
+    """--stale-reweight rescales STALE CARRY rows: without --stale-infill
+    there is no carry to reweight, and outside bounded-wait mode entirely
+    the flag is an orphan — both are parse-time refusals, never silently
+    ignored (ISSUE 20 v3)."""
+    base = ["--experiment", "digits", "--aggregator", "krum",
+            "--nb-workers", "4", "--nb-decl-byz-workers", "1",
+            "--max-step", "1"]
+    # bounded-wait mode, but no stale infill: nothing to reweight
+    with pytest.raises(UserException, match="stale-infill"):
+        run(base + ["--step-deadline", "0.3", "--stale-reweight"])
+    # no bounded-wait mode at all: the orphan-flag refusal names the flag
+    with pytest.raises(UserException, match="stale-reweight"):
+        run(base + ["--stale-reweight"])
+
+
 def test_runner_sharded_mesh_rejections():
     """--mesh surface validation: W != n, unsupported experiment."""
     base = ["--aggregator", "median", "--nb-workers", "2"]

@@ -87,6 +87,40 @@ def test_controller_censored_percentile_votes_ceiling():
     assert not ctl2.at_ceiling and ctl2.censored_rounds == 0
 
 
+def test_controller_unit_size_votes_per_submission_unit():
+    """bounded-wait v3: a grouped round's k submesh members share one
+    arrival instant by construction, so with ``unit_size=k`` the
+    percentile votes over the W per-UNIT arrivals instead of k duplicated
+    copies — a censored submesh is ONE censored vote, not k, and the
+    censoring bound moves from workers to units."""
+    # 8 workers in 4 units of 2; unit 3 (workers 6,7) censored
+    trace = np.repeat([0.02, 0.03, 0.04, np.inf], 2)
+    ctl = DeadlineController(0.1, percentile=60.0, floor=0.001, ceiling=0.4,
+                             ema=1.0)
+    ctl.observe_round(trace, unit_size=2)
+    # p60 over the per-unit [0.02, 0.03, 0.04, inf]: rank 1.8 interpolates
+    # 0.2 * 0.03 + 0.8 * 0.04 inside the finite mass -> the window tracks
+    # the honest units, not the ceiling
+    np.testing.assert_allclose(ctl.window, 0.038, rtol=1e-6)
+    assert not ctl.at_ceiling and ctl.censored_rounds == 0
+    # the same trace read per-WORKER (unit_size=1) lands a different
+    # target: the duplicated copies shift rank 4.2 onto the 0.04 pair
+    ctl1 = DeadlineController(0.1, percentile=60.0, floor=0.001, ceiling=0.4,
+                              ema=1.0)
+    ctl1.observe_round(trace)
+    np.testing.assert_allclose(ctl1.window, 0.04, rtol=1e-6)
+    # one censored UNIT among four is ONE censored vote: p80's per-unit
+    # rank 2.4 touches the inf neighbor and the round votes the ceiling
+    ctl2 = DeadlineController(0.1, percentile=80.0, floor=0.001, ceiling=0.4,
+                              ema=1.0)
+    ctl2.observe_round(trace, unit_size=2)
+    assert ctl2.window == 0.4 and ctl2.at_ceiling
+    assert ctl2.censored_rounds == 1
+    # arrivals that do not group into whole units are a loud refusal
+    with pytest.raises(UserException, match="units"):
+        ctl2.observe_round(np.zeros(7), unit_size=2)
+
+
 def test_controller_at_ceiling_is_demand_not_ema_asymptote():
     """The escalation signal must fire the ROUND the tail outgrows the
     budget: the EMA'd window only asymptotically approaches the ceiling
